@@ -1,0 +1,20 @@
+"""maskclustering_tpu — a TPU-native open-vocabulary 3D instance segmentation framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the MaskClustering (CVPR 2024)
+pipeline (reference: /root/reference). The reference is a CUDA/torch/Open3D
+script collection; this framework maps the same capability onto TPU hardware:
+
+- per-frame mask backprojection   -> vmapped projective association (models/backprojection.py)
+- mask-graph statistics           -> one MXU boolean matmul (models/graph.py)
+- iterative view-consensus merge  -> jitted lax.scan + min-label propagation (models/clustering.py)
+- post-processing + export        -> segment math + host C++ DBSCAN (models/postprocess.py)
+- ScanNet AP protocol             -> evaluation/ap.py
+- open-vocab semantics            -> semantics/ (CLIP pooling in jnp)
+- multi-chip scale-out            -> parallel/ (Mesh + shard_map + collectives)
+"""
+
+__version__ = "0.1.0"
+
+from maskclustering_tpu.config import PipelineConfig, load_config
+
+__all__ = ["PipelineConfig", "load_config", "__version__"]

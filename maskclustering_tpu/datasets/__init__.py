@@ -1,0 +1,46 @@
+"""Dataset registry.
+
+Mirrors the reference's factory (utils/config.py:28-42) but with registered
+classes instead of an if/elif chain.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from maskclustering_tpu.datasets.base import BaseDataset, SceneTensors
+
+_REGISTRY: Dict[str, Callable[..., BaseDataset]] = {}
+
+
+def register_dataset(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def get_dataset(dataset: str, seq_name: str, data_root: str = "./data") -> BaseDataset:
+    # lazy imports keep optional deps (cv2 etc.) out of library import time
+    if not _REGISTRY:
+        _populate()
+    if dataset not in _REGISTRY:
+        raise KeyError(f"unknown dataset {dataset!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[dataset](seq_name, data_root=data_root)
+
+
+def _populate():
+    from maskclustering_tpu.datasets.matterport import MatterportDataset
+    from maskclustering_tpu.datasets.scannet import DemoDataset, ScanNetDataset
+    from maskclustering_tpu.datasets.scannetpp import ScanNetPPDataset
+    from maskclustering_tpu.datasets.tasmap import TASMapDataset
+
+    _REGISTRY.setdefault("scannet", ScanNetDataset)
+    _REGISTRY.setdefault("demo", DemoDataset)
+    _REGISTRY.setdefault("scannetpp", ScanNetPPDataset)
+    _REGISTRY.setdefault("matterport3d", MatterportDataset)
+    _REGISTRY.setdefault("tasmap", TASMapDataset)
+
+
+__all__ = ["BaseDataset", "SceneTensors", "get_dataset", "register_dataset"]

@@ -1,0 +1,11 @@
+from maskclustering_tpu.io.ply import read_ply_points, write_ply_points
+from maskclustering_tpu.io.image import read_depth_png, read_rgb, read_mask_png, resize_nearest
+
+__all__ = [
+    "read_ply_points",
+    "write_ply_points",
+    "read_depth_png",
+    "read_rgb",
+    "read_mask_png",
+    "resize_nearest",
+]

@@ -1,0 +1,81 @@
+"""Host-side image decode helpers (I/O layer, not compute).
+
+Depth PNGs are 16-bit; segmentation id-maps are uint8/uint16 PNGs where the
+resize to depth resolution must be INTER_NEAREST to keep ids intact
+(reference dataset/scannet.py:66-73). cv2 is used when present for exact
+INTER_NEAREST alignment; PIL is the fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import cv2
+
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover
+    cv2 = None
+    _HAS_CV2 = False
+
+from PIL import Image
+
+
+def read_depth_png(path: str, depth_scale: float = 1000.0) -> np.ndarray:
+    """Read a 16-bit depth PNG and convert to metres (float32)."""
+    if _HAS_CV2:
+        raw = cv2.imread(path, cv2.IMREAD_UNCHANGED)
+        if raw is None:
+            raise FileNotFoundError(path)
+    else:
+        raw = np.asarray(Image.open(path))
+    return (raw.astype(np.float64) / depth_scale).astype(np.float32)
+
+
+def read_rgb(path: str) -> np.ndarray:
+    """Read an RGB image as (H,W,3) uint8 in RGB channel order."""
+    if _HAS_CV2:
+        bgr = cv2.imread(path)
+        if bgr is None:
+            raise FileNotFoundError(path)
+        return cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+    return np.asarray(Image.open(path).convert("RGB"))
+
+
+def read_mask_png(path: str) -> np.ndarray:
+    """Read a segmentation id-map PNG unchanged (uint8 or uint16)."""
+    if _HAS_CV2:
+        seg = cv2.imread(path, cv2.IMREAD_UNCHANGED)
+        if seg is None:
+            raise FileNotFoundError(path)
+        return seg
+    return np.asarray(Image.open(path))
+
+
+def write_mask_png(path: str, ids: np.ndarray) -> None:
+    ids = np.asarray(ids)
+    if ids.max(initial=0) > 255:
+        ids = ids.astype(np.uint16)
+    else:
+        ids = ids.astype(np.uint8)
+    Image.fromarray(ids).save(path)
+
+
+def resize_nearest(img: np.ndarray, size_wh: tuple[int, int]) -> np.ndarray:
+    """Nearest-neighbor resize to (width, height), id-preserving.
+
+    Matches cv2.resize(..., interpolation=cv2.INTER_NEAREST) semantics, which
+    is what aligns segmentation maps with depth maps in the reference
+    (dataset/scannet.py:71-72).
+    """
+    w, h = size_wh
+    if img.shape[0] == h and img.shape[1] == w:
+        return img
+    if _HAS_CV2:
+        return cv2.resize(img, (w, h), interpolation=cv2.INTER_NEAREST)
+    # cv2 INTER_NEAREST samples src_idx = floor(dst_idx * scale)
+    sy = img.shape[0] / h
+    sx = img.shape[1] / w
+    yi = np.minimum((np.arange(h) * sy).astype(np.int64), img.shape[0] - 1)
+    xi = np.minimum((np.arange(w) * sx).astype(np.int64), img.shape[1] - 1)
+    return img[yi[:, None], xi[None, :]]

@@ -1,0 +1,127 @@
+"""Minimal self-contained PLY point-cloud I/O.
+
+The reference reads scene clouds through Open3D's C++ PLY reader
+(reference dataset/scannet.py:87-90). Open3D is not a dependency here, so
+this module implements the subset of PLY needed by the datasets: vertex
+positions (+ optional colors) in binary-little-endian or ascii format.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_PLY_TO_NP = {
+    "char": "i1", "int8": "i1",
+    "uchar": "u1", "uint8": "u1",
+    "short": "i2", "int16": "i2",
+    "ushort": "u2", "uint16": "u2",
+    "int": "i4", "int32": "i4",
+    "uint": "u4", "uint32": "u4",
+    "float": "f4", "float32": "f4",
+    "double": "f8", "float64": "f8",
+}
+
+
+def _parse_header(f):
+    """Parse a PLY header. Returns (format, elements, header_end_offset).
+
+    elements is a list of (name, count, [(prop_name, np_dtype_str), ...]).
+    List properties (e.g. face vertex_indices) are recorded with dtype None
+    and a (count_type, item_type) tuple instead.
+    """
+    magic = f.readline().strip()
+    if magic != b"ply":
+        raise ValueError("not a PLY file")
+    fmt = None
+    elements = []
+    while True:
+        line = f.readline()
+        if not line:
+            raise ValueError("unexpected EOF in PLY header")
+        tokens = line.decode("ascii", errors="replace").strip().split()
+        if not tokens or tokens[0] == "comment" or tokens[0] == "obj_info":
+            continue
+        if tokens[0] == "format":
+            fmt = tokens[1]
+        elif tokens[0] == "element":
+            elements.append((tokens[1], int(tokens[2]), []))
+        elif tokens[0] == "property":
+            if tokens[1] == "list":
+                elements[-1][2].append((tokens[4], None, (_PLY_TO_NP[tokens[2]], _PLY_TO_NP[tokens[3]])))
+            else:
+                elements[-1][2].append((tokens[2], _PLY_TO_NP[tokens[1]], None))
+        elif tokens[0] == "end_header":
+            break
+    return fmt, elements
+
+
+def read_ply_points(path: str, return_colors: bool = False):
+    """Read vertex x/y/z (and optionally r/g/b) from a PLY file.
+
+    Returns (N,3) float64 positions, or a (positions, colors_uint8) tuple.
+    """
+    with open(path, "rb") as f:
+        fmt, elements = _parse_header(f)
+        endian = "<" if fmt in ("binary_little_endian", "ascii") else ">"
+        verts = None
+        colors = None
+        for name, count, props in elements:
+            has_list = any(p[1] is None for p in props)
+            if fmt == "ascii":
+                if name == "vertex":
+                    names = [p[0] for p in props]
+                    rows = [f.readline().split() for _ in range(count)]
+                    arr = np.array(rows, dtype=np.float64)
+                    ix = [names.index(c) for c in ("x", "y", "z")]
+                    verts = arr[:, ix]
+                    if return_colors and all(c in names for c in ("red", "green", "blue")):
+                        ic = [names.index(c) for c in ("red", "green", "blue")]
+                        colors = arr[:, ic].astype(np.uint8)
+                else:
+                    for _ in range(count):
+                        f.readline()
+            else:
+                if has_list:
+                    # ragged element (faces): must walk it item by item to skip
+                    for _ in range(count):
+                        for _, dt, list_dt in props:
+                            if dt is None:
+                                ct, it = list_dt
+                                n = int(np.frombuffer(f.read(np.dtype(ct).itemsize), dtype=endian + ct)[0])
+                                f.read(n * np.dtype(it).itemsize)
+                            else:
+                                f.read(np.dtype(dt).itemsize)
+                    continue
+                dtype = np.dtype([(p[0], endian + p[1]) for p in props])
+                data = np.frombuffer(f.read(count * dtype.itemsize), dtype=dtype)
+                if name == "vertex":
+                    verts = np.stack([data["x"], data["y"], data["z"]], axis=1).astype(np.float64)
+                    if return_colors and all(c in dtype.names for c in ("red", "green", "blue")):
+                        colors = np.stack([data["red"], data["green"], data["blue"]], axis=1).astype(np.uint8)
+    if verts is None:
+        raise ValueError(f"no vertex element found in {path}")
+    if return_colors:
+        return verts, colors
+    return verts
+
+
+def write_ply_points(path: str, points: np.ndarray, colors: np.ndarray | None = None) -> None:
+    """Write an (N,3) point cloud as binary-little-endian PLY."""
+    points = np.asarray(points, dtype=np.float32)
+    n = len(points)
+    fields = [("x", "<f4"), ("y", "<f4"), ("z", "<f4")]
+    if colors is not None:
+        fields += [("red", "u1"), ("green", "u1"), ("blue", "u1")]
+    rec = np.empty(n, dtype=np.dtype(fields))
+    rec["x"], rec["y"], rec["z"] = points[:, 0], points[:, 1], points[:, 2]
+    if colors is not None:
+        colors = np.asarray(colors, dtype=np.uint8)
+        rec["red"], rec["green"], rec["blue"] = colors[:, 0], colors[:, 1], colors[:, 2]
+    header = ["ply", "format binary_little_endian 1.0", f"element vertex {n}"]
+    header += [f"property float {c}" for c in ("x", "y", "z")]
+    if colors is not None:
+        header += [f"property uchar {c}" for c in ("red", "green", "blue")]
+    header.append("end_header")
+    with open(path, "wb") as f:
+        f.write(("\n".join(header) + "\n").encode("ascii"))
+        f.write(rec.tobytes())

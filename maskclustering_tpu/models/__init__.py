@@ -1,0 +1,39 @@
+from maskclustering_tpu.models.backprojection import (
+    FrameAssociation,
+    SceneAssociation,
+    associate_frame,
+    associate_scene,
+)
+from maskclustering_tpu.models.clustering import ClusterResult, iterative_clustering
+from maskclustering_tpu.models.graph import (
+    GraphStats,
+    MaskTable,
+    build_mask_table,
+    compute_graph_stats,
+    observer_schedule,
+)
+from maskclustering_tpu.models.pipeline import SceneResult, run_scene
+from maskclustering_tpu.models.postprocess import (
+    SceneObjects,
+    export_artifacts,
+    postprocess_scene,
+)
+
+__all__ = [
+    "FrameAssociation",
+    "SceneAssociation",
+    "associate_frame",
+    "associate_scene",
+    "ClusterResult",
+    "iterative_clustering",
+    "GraphStats",
+    "MaskTable",
+    "build_mask_table",
+    "compute_graph_stats",
+    "observer_schedule",
+    "SceneResult",
+    "run_scene",
+    "SceneObjects",
+    "export_artifacts",
+    "postprocess_scene",
+]

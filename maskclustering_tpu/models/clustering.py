@@ -1,0 +1,100 @@
+"""Iterative view-consensus clustering, fully on-device.
+
+The reference alternates GPU affinity matmuls with a host roundtrip to
+networkx connected_components every iteration (reference
+graph/iterative_clustering.py:17-32), materializing Python Node objects as
+it goes (graph/node.py:24-37). Here the whole schedule runs as one
+lax.scan with no host sync:
+
+- cluster state is a single assignment vector ``a[m] -> representative mask
+  index`` over a fixed M_pad slot space (no object churn, no recompiles);
+- per-iteration node features are re-aggregated from the original mask
+  features by a one-hot matmul (segment-OR on the MXU), replacing
+  Node.create_node_from_list;
+- the observer/supporter affinities are V V^T and C C^T exactly as in the
+  reference (iterative_clustering.py:20-23) — bf16 operands, f32
+  accumulation, exact for 0/1 data;
+- connected components is min-label propagation run to fixpoint inside a
+  lax.while_loop, replacing networkx (iterative_clustering.py:32);
+- the dynamic-length threshold schedule is padded with +inf: an inf
+  threshold disconnects every pair, so padded iterations are no-ops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ClusterResult(NamedTuple):
+    assignment: jnp.ndarray  # (M_pad,) int32: final representative per mask
+    node_visible: jnp.ndarray  # (M_pad, F) bool: per-rep aggregated visible_frame
+    node_active: jnp.ndarray  # (M_pad,) bool: slot is a live representative
+
+
+def _connected_components(adj: jnp.ndarray) -> jnp.ndarray:
+    """Min-label propagation to fixpoint. adj must be symmetric (M, M) bool."""
+    m = adj.shape[0]
+    sentinel = jnp.int32(m)
+    init = jnp.arange(m, dtype=jnp.int32)
+
+    def cond(state):
+        labels, changed = state
+        return changed
+
+    def body(state):
+        labels, _ = state
+        neigh = jnp.where(adj, labels[None, :], sentinel)
+        best = jnp.minimum(labels, jnp.min(neigh, axis=1))
+        # two hops per sweep (pointer jumping) to cut iteration count
+        best = jnp.minimum(best, best[best])
+        return (best, jnp.any(best != labels))
+
+    labels, _ = jax.lax.while_loop(cond, body, (init, jnp.bool_(True)))
+    return labels
+
+
+@functools.partial(jax.jit, static_argnames=("view_consensus_threshold",))
+def iterative_clustering(
+    visible: jnp.ndarray,  # (M_pad, F) bool mask-level visible_frame
+    contained: jnp.ndarray,  # (M_pad, M_pad) bool mask-level contained_mask
+    active: jnp.ndarray,  # (M_pad,) bool: valid & not undersegmented
+    schedule: jnp.ndarray,  # (T,) f32 observer thresholds, +inf padded
+    *,
+    view_consensus_threshold: float = 0.9,
+) -> ClusterResult:
+    m_pad = visible.shape[0]
+    arange = jnp.arange(m_pad, dtype=jnp.int32)
+    eye = jnp.eye(m_pad, dtype=bool)
+    vis_m = (visible & active[:, None])
+    con_m = (contained & active[:, None])
+
+    def aggregate(assign):
+        """Segment-OR of mask features into representative slots (MXU)."""
+        onehot = (assign[None, :] == arange[:, None]) & active[None, :]  # (rep, member)
+        oh = onehot.astype(jnp.bfloat16)
+        v = jnp.dot(oh, vis_m.astype(jnp.bfloat16), preferred_element_type=jnp.float32) > 0
+        c = jnp.dot(oh, con_m.astype(jnp.bfloat16), preferred_element_type=jnp.float32) > 0
+        rep_active = jnp.any(onehot, axis=1)
+        return v, c, rep_active
+
+    def step(assign, threshold):
+        v, c, rep_active = aggregate(assign)
+        vb = v.astype(jnp.bfloat16)
+        cb = c.astype(jnp.bfloat16)
+        observers = jnp.dot(vb, vb.T, preferred_element_type=jnp.float32)
+        supporters = jnp.dot(cb, cb.T, preferred_element_type=jnp.float32)
+        rate = supporters / (observers + 1e-7)
+        adj = (rate >= view_consensus_threshold) & (observers >= threshold)
+        adj = adj & ~eye & rep_active[:, None] & rep_active[None, :]
+        labels = _connected_components(adj)
+        # non-representative slots keep their label; members follow their rep
+        new_assign = labels[assign]
+        return new_assign, None
+
+    assignment, _ = jax.lax.scan(step, arange, schedule)
+    v, _, rep_active = aggregate(assignment)
+    return ClusterResult(assignment=assignment, node_visible=v, node_active=rep_active)

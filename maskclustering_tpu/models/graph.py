@@ -1,0 +1,232 @@
+"""Mask-graph statistics as one MXU matmul.
+
+The reference computes, for every mask, a per-frame histogram of which other
+masks its points fall into — a serial numpy bincount loop over all masks
+(reference graph/construction.py:98-158, "hot loop 2"). The key observation
+is that every quantity that loop produces is a slice of one co-occurrence
+matrix:
+
+    c[m, m'] = #points of mask m (minus global boundary points)
+               that carry mask id m' in frame(m')
+
+which is exactly ``c = A_tilde^T @ W`` for two {0,1} matrices over points:
+A_tilde[p, m] = "p is a non-boundary point of m", W[p, m'] = "p carries id
+of m' in frame(m')". On TPU this is a bf16 matmul with f32 accumulation —
+bit-exact for 0/1 operands up to 2^24 — so the entire mask-statistics pass
+rides the systolic array. From c:
+
+- visible-count per (mask, frame):   n_vis = c @ onehot(frame-of-mask)
+  (masks within a frame are disjoint, construction.py:24)
+- total valid points per mask:       n_tot = diag(c)
+- "contained-by" top mask per frame: segmented argmax of c over each
+  frame's masks (construction.py:122-128)
+- undersegmentation verdicts and their undo (construction.py:132,163-169)
+  become boolean tensor algebra.
+
+The observer-count percentile schedule (construction.py:80-96) is computed
+device-side with one sort (no host roundtrip of the O(M^2) matrix).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MaskTable(NamedTuple):
+    """Host-side compact index of valid masks, padded to a static M_pad.
+
+    Padding entries have frame = F (out of range) and id = -1 so they can
+    never match a point. Masks are ordered by (frame, id) — ascending and
+    contiguous per frame, which the segmented argmax relies on.
+    """
+
+    frame: np.ndarray  # (M_pad,) int32
+    mask_id: np.ndarray  # (M_pad,) int32, -1 for padding
+    valid: np.ndarray  # (M_pad,) bool
+    num_masks: int
+    num_frames: int
+    k_max: int
+
+    @property
+    def m_pad(self) -> int:
+        return int(self.frame.shape[0])
+
+
+def build_mask_table(mask_valid: np.ndarray, pad_multiple: int = 256) -> MaskTable:
+    """Compact (frame, id) table of valid masks from (F, K_max+1) validity."""
+    mask_valid = np.asarray(mask_valid)
+    f_idx, k_idx = np.nonzero(mask_valid)
+    num = len(f_idx)
+    m_pad = max(pad_multiple, int(np.ceil(max(num, 1) / pad_multiple)) * pad_multiple)
+    frame = np.full(m_pad, mask_valid.shape[0], dtype=np.int32)
+    mask_id = np.full(m_pad, -1, dtype=np.int32)
+    frame[:num] = f_idx
+    mask_id[:num] = k_idx
+    valid = np.zeros(m_pad, dtype=bool)
+    valid[:num] = True
+    return MaskTable(frame=frame, mask_id=mask_id, valid=valid, num_masks=num,
+                     num_frames=int(mask_valid.shape[0]), k_max=int(mask_valid.shape[1]) - 1)
+
+
+class GraphStats(NamedTuple):
+    """Everything the clustering stage needs, all (M_pad, ...) device arrays."""
+
+    visible: jnp.ndarray  # (M_pad, F) bool — reference visible_frames (post-undo)
+    contained: jnp.ndarray  # (M_pad, M_pad) bool — reference contained_masks (post-undo)
+    undersegment: jnp.ndarray  # (M_pad,) bool
+    n_tot: jnp.ndarray  # (M_pad,) f32 valid-point count per mask
+    sorted_observers: jnp.ndarray  # (M_pad^2,) f32 ascending observer counts (exact ints)
+    observers_positive: jnp.ndarray  # () int32: count of positive entries
+
+
+def _cooccurrence(mask_of_point: jnp.ndarray, boundary: jnp.ndarray,
+                  mask_frame: jnp.ndarray, mask_id: jnp.ndarray, point_chunk: int):
+    """c[m, m'] via chunked bf16 matmuls with f32 accumulation.
+
+    mask_of_point: (F, N) int32; boundary: (N,) bool.
+    """
+    f, n = mask_of_point.shape
+    m_pad = mask_frame.shape[0]
+    n_chunks = max(1, -(-n // point_chunk))
+    n_padded = n_chunks * point_chunk
+    mop = jnp.pad(mask_of_point, ((0, 0), (0, n_padded - n)))  # pad points with id 0
+    bnd = jnp.pad(boundary, (0, n_padded - n), constant_values=True)
+    # guard the frame gather for padding entries (frame == F)
+    safe_frame = jnp.minimum(mask_frame, f - 1)
+
+    def body(carry, pchunk_start):
+        c_acc, ntot_acc = carry
+        mc = jax.lax.dynamic_slice(mop, (0, pchunk_start), (f, point_chunk))  # (F, Nc)
+        bc = jax.lax.dynamic_slice(bnd, (pchunk_start,), (point_chunk,))
+        # (Nc, M_pad): does point p carry mask m's id in m's frame?
+        ids = mc[safe_frame, :].T  # (Nc, M_pad)
+        w_right = (ids == mask_id[None, :])
+        w_left = w_right & ~bc[:, None]
+        cw = jnp.dot(w_left.astype(jnp.bfloat16).T, w_right.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+        return (c_acc + cw, ntot_acc + jnp.sum(w_left, axis=0).astype(jnp.float32)), None
+
+    init = (jnp.zeros((m_pad, m_pad), jnp.float32), jnp.zeros((m_pad,), jnp.float32))
+    (c, n_tot), _ = jax.lax.scan(body, init, jnp.arange(n_chunks) * point_chunk)
+    return c, n_tot
+
+
+@functools.partial(jax.jit, static_argnames=("k_max", "point_chunk", "mask_visible_threshold",
+                                             "contained_threshold", "undersegment_filter_threshold",
+                                             "big_mask_point_count"))
+def compute_graph_stats(
+    mask_of_point: jnp.ndarray,  # (F, N) int32, boundary-zeroed
+    boundary: jnp.ndarray,  # (N,) bool global boundary points
+    mask_frame: jnp.ndarray,  # (M_pad,) int32
+    mask_id: jnp.ndarray,  # (M_pad,) int32
+    mask_active: jnp.ndarray,  # (M_pad,) bool
+    *,
+    k_max: int = 127,
+    point_chunk: int = 8192,
+    mask_visible_threshold: float = 0.3,
+    contained_threshold: float = 0.8,
+    undersegment_filter_threshold: float = 0.3,
+    big_mask_point_count: int = 500,
+) -> GraphStats:
+    f, n = mask_of_point.shape
+    m_pad = mask_frame.shape[0]
+
+    c, n_tot = _cooccurrence(mask_of_point, boundary, mask_frame, mask_id, point_chunk)
+
+    # ---- per-(mask, frame) visible counts: masks of a frame are disjoint ----
+    frame_onehot = (mask_frame[:, None] == jnp.arange(f)[None, :]).astype(jnp.float32)
+    n_vis = jnp.dot(c, frame_onehot)  # f32 matmul of exact integer counts
+
+    # ---- segmented max over each frame's masks: who contains me? ----
+    # frame_slot[j, k-1] = global index of mask (j, k), or m_pad (sentinel).
+    # Padding table entries have frame == F (out of bounds) -> dropped.
+    slot = jnp.full((f, k_max), m_pad, dtype=jnp.int32)
+    slot = slot.at[mask_frame, jnp.clip(mask_id - 1, 0, k_max - 1)].set(
+        jnp.arange(m_pad, dtype=jnp.int32), mode="drop")
+    c_ext = jnp.concatenate([c, jnp.full((m_pad, 1), -1.0)], axis=1)  # sentinel col
+    c_by_frame = jnp.take(c_ext, slot.reshape(-1), axis=1).reshape(m_pad, f, k_max)
+    cmax = jnp.max(c_by_frame, axis=2)  # (M_pad, F)
+    argk = jnp.argmax(c_by_frame, axis=2)  # (M_pad, F)
+    top_global = slot[jnp.arange(f)[None, :], argk]  # (M_pad, F) global mask index
+
+    # ---- visibility / containment / undersegmentation logic ----
+    safe_tot = jnp.maximum(n_tot, 1.0)[:, None]
+    vis_ratio = n_vis / safe_tot
+    visible_test = ((vis_ratio >= mask_visible_threshold) | (n_vis >= big_mask_point_count)) \
+        & (n_vis > 0) & mask_active[:, None]
+    contained_ratio = cmax / jnp.maximum(n_vis, 1.0)
+    passes = contained_ratio > contained_threshold
+    visible = visible_test & passes  # reference sets visible_frame only on pass
+    split = visible_test & ~passes
+    visible_num = jnp.sum(visible_test, axis=1)
+    split_num = jnp.sum(split, axis=1)
+    undersegment = mask_active & (
+        (visible_num == 0)
+        | (split_num > undersegment_filter_threshold * visible_num)
+    )
+
+    # contained[m, m*] = 1 where m* is the argmax mask of a visible frame
+    rows = jnp.broadcast_to(jnp.arange(m_pad)[:, None], (m_pad, f))
+    contained = jnp.zeros((m_pad, m_pad), dtype=bool)
+    safe_top = jnp.where(visible, top_global, m_pad)  # m_pad index dropped
+    contained = contained.at[rows.reshape(-1), safe_top.reshape(-1)].set(True, mode="drop")
+
+    # ---- undo undersegmented observers (construction.py:163-169) ----
+    u_cols = undersegment[None, :] & contained  # supporters of undersegmented masks
+    zap = jnp.dot(u_cols.astype(jnp.float32), frame_onehot.astype(jnp.float32)) > 0
+    visible = visible & ~zap
+    contained = contained & ~undersegment[None, :]
+
+    # ---- observer-count distribution for the percentile schedule ----
+    # The sort runs on device; the fractional percentile interpolation runs
+    # on host in float64 (observer_schedule) so thresholds match np.percentile
+    # exactly — an f32 lerp can land epsilon above an integer count and flip
+    # an `observers >= threshold` decision.
+    vis_f = visible.astype(jnp.bfloat16)
+    observers = jnp.dot(vis_f, vis_f.T, preferred_element_type=jnp.float32)
+    flat = jnp.sort(observers.reshape(-1))
+    cnt_pos = jnp.sum(flat > 0).astype(jnp.int32)
+
+    return GraphStats(visible=visible, contained=contained, undersegment=undersegment,
+                      n_tot=n_tot, sorted_observers=flat, observers_positive=cnt_pos)
+
+
+def observer_schedule(sorted_observers, observers_positive, max_len: int = 20) -> np.ndarray:
+    """Observer-count percentile schedule from the device-sorted distribution.
+
+    Reference semantics (construction.py:80-96): np.percentile (linear
+    interpolation, float64) of the positive observer counts at 95..0 step
+    -5; a value <= 1 becomes 1 while the percentile is >= 50 and terminates
+    the schedule once below 50. Padded to `max_len` with +inf (an inert
+    clustering iteration merges nothing).
+
+    Only O(max_len) elements are pulled from the device array.
+    """
+    total = int(sorted_observers.shape[0])
+    cnt_pos = int(observers_positive)
+    out = []
+    if cnt_pos > 0:
+        qs = list(range(95, -5, -5))
+        pos = (total - cnt_pos) + (cnt_pos - 1) * (np.asarray(qs) / 100.0)  # float64
+        lo = np.minimum(np.floor(pos).astype(np.int64), total - 1)
+        hi = np.minimum(lo + 1, total - 1)
+        # one gather, one device->host transfer for all 2*len(qs) elements
+        vals = np.asarray(sorted_observers[np.concatenate([lo, hi])]).astype(np.float64)
+        v_lo, v_hi = vals[: len(qs)], vals[len(qs):]
+        frac = pos - lo
+        interp = v_lo * (1.0 - frac) + np.where(hi > lo, v_hi, v_lo) * frac
+        for q, val in zip(qs, interp):
+            val = float(val)
+            if val <= 1:
+                if q < 50:
+                    break
+                val = 1.0
+            out.append(val)
+    sched = np.full(max_len, np.inf, dtype=np.float32)
+    sched[: len(out)] = out[:max_len]
+    return sched

@@ -1,0 +1,116 @@
+"""Per-scene end-to-end pipeline: association -> graph -> clustering -> export.
+
+The TPU analog of the reference's per-scene entry (main.py:9-21). Device
+stages run under jit with static, bucket-padded shapes; the two host sync
+points are (a) the mask table (compact indices of valid masks) and (b) the
+observer schedule (a 20-float transfer), mirroring where the reference
+crosses to numpy.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from maskclustering_tpu.config import PipelineConfig
+from maskclustering_tpu.datasets.base import SceneTensors
+from maskclustering_tpu.models.backprojection import associate_scene_tensors
+from maskclustering_tpu.models.clustering import ClusterResult, iterative_clustering
+from maskclustering_tpu.models.graph import (
+    GraphStats,
+    MaskTable,
+    build_mask_table,
+    compute_graph_stats,
+    observer_schedule,
+)
+from maskclustering_tpu.models.postprocess import SceneObjects, export_artifacts, postprocess_scene
+
+log = logging.getLogger("maskclustering_tpu")
+
+
+class SceneResult(NamedTuple):
+    objects: SceneObjects
+    table: MaskTable
+    assignment: np.ndarray
+    timings: Dict[str, float]
+
+
+def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: int = 127,
+              seq_name: Optional[str] = None, export: bool = False,
+              object_dict_dir: Optional[str] = None,
+              prediction_root: str = "data/prediction") -> SceneResult:
+    """Cluster one scene. Returns objects + artifacts (optionally written)."""
+    if cfg.use_exact_ball_query:
+        raise NotImplementedError(
+            "exact ball-query association is not wired into run_scene yet; "
+            "ops/neighbor.py provides the kernel")
+    timings: Dict[str, float] = {}
+    t0 = time.perf_counter()
+
+    assoc = associate_scene_tensors(tensors, cfg, k_max=k_max)
+    mask_valid_host = np.asarray(assoc.mask_valid)
+    timings["associate"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    table = build_mask_table(mask_valid_host, pad_multiple=cfg.mask_pad_multiple)
+    stats = compute_graph_stats(
+        assoc.mask_of_point,
+        assoc.boundary,
+        jnp.asarray(table.frame),
+        jnp.asarray(table.mask_id),
+        jnp.asarray(table.valid),
+        k_max=k_max,
+        point_chunk=cfg.point_chunk,
+        mask_visible_threshold=cfg.mask_visible_threshold,
+        contained_threshold=cfg.contained_threshold,
+        undersegment_filter_threshold=cfg.undersegment_filter_threshold,
+        big_mask_point_count=cfg.big_mask_point_count,
+    )
+    schedule = observer_schedule(stats.sorted_observers, stats.observers_positive,
+                                 max_len=cfg.max_cluster_iterations)
+    timings["graph"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    active = jnp.asarray(table.valid) & ~stats.undersegment
+    result = iterative_clustering(
+        stats.visible, stats.contained, active, jnp.asarray(schedule),
+        view_consensus_threshold=cfg.view_consensus_threshold,
+    )
+    assignment = np.asarray(result.assignment)
+    timings["cluster"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    objects = postprocess_scene(
+        np.asarray(tensors.scene_points),
+        np.asarray(assoc.first_id),
+        np.asarray(assoc.last_id),
+        np.asarray(assoc.point_visible),
+        table.frame,
+        table.mask_id,
+        np.asarray(active),
+        assignment,
+        np.asarray(result.node_visible),
+        tensors.frame_ids,
+        k_max=k_max,
+        point_filter_threshold=cfg.point_filter_threshold,
+        dbscan_eps=cfg.dbscan_split_eps,
+        dbscan_min_points=cfg.dbscan_split_min_points,
+        overlap_merge_ratio=cfg.overlap_merge_ratio,
+        min_masks_per_object=cfg.min_masks_per_object,
+    )
+    timings["postprocess"] = time.perf_counter() - t0
+
+    if export:
+        if seq_name is None or object_dict_dir is None:
+            raise ValueError("export=True requires seq_name and object_dict_dir")
+        export_artifacts(objects, seq_name, cfg.config_name, object_dict_dir,
+                         prediction_root=prediction_root,
+                         top_k_repre=cfg.num_representative_masks)
+
+    log.info("scene %s: %d objects, timings %s", seq_name, len(objects.point_ids_list),
+             {k: round(v, 3) for k, v in timings.items()})
+    return SceneResult(objects=objects, table=table, assignment=assignment, timings=timings)

@@ -1,0 +1,238 @@
+"""Cluster post-processing and artifact export.
+
+Reproduces the reference's post pipeline (utils/post_process.py:173-195):
+per node with >= 2 masks, (i) DBSCAN-split the node's point cloud into
+spatially connected objects, (ii) drop points whose detection ratio within
+the node is below threshold (OVIR-3D filter), (iii) drop objects with < 2
+assigned masks, then (iv) merge objects with > 0.8 point overlap, and
+export the class-agnostic npz + object_dict artifacts bit-compatibly with
+the reference's evaluator contract (post_process.py:131-170).
+
+This stage is off the hot path (a few hundred objects, reference's own
+implementation is host numpy), so it runs on host with vectorized numpy
+over the COO structures produced by the device stages; DBSCAN dispatches to
+the native C++ extension when built, else sklearn.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, NamedTuple, Sequence, Tuple
+
+import numpy as np
+
+from maskclustering_tpu.ops.dbscan import dbscan_labels
+
+
+class SceneObjects(NamedTuple):
+    """Final per-scene objects plus the artifacts' raw ingredients."""
+
+    point_ids_list: List[np.ndarray]
+    mask_list: List[List[Tuple]]  # per object: [(frame_id, mask_id, coverage), ...]
+    num_points: int
+
+
+def _claims_coo(first: np.ndarray, last: np.ndarray, gmap: np.ndarray):
+    """COO arrays (global_mask, point, frame) of every (point, mask) claim.
+
+    first/last: (F, N) int32 claiming ids per point per frame (0 = none).
+    gmap: (F, K+1) -> global mask index or -1.
+    """
+    coords = []
+    for arr in (first, last):
+        f_idx, p_idx = np.nonzero(arr)
+        m = gmap[f_idx, arr[f_idx, p_idx]]
+        ok = m >= 0
+        coords.append(np.stack([m[ok], p_idx[ok], f_idx[ok]], axis=1))
+    coo = np.concatenate(coords, axis=0)
+    coo = np.unique(coo, axis=0)  # dedupe first==last duplicates
+    return coo[:, 0], coo[:, 1], coo[:, 2]
+
+
+def postprocess_scene(
+    scene_points: np.ndarray,  # (N, 3)
+    first: np.ndarray,  # (F, N) int32
+    last: np.ndarray,  # (F, N) int32
+    point_visible: np.ndarray,  # (F, N) bool
+    mask_frame: np.ndarray,  # (M_pad,) int32
+    mask_id: np.ndarray,  # (M_pad,) int32
+    mask_active: np.ndarray,  # (M_pad,) bool — valid & not undersegmented
+    assignment: np.ndarray,  # (M_pad,) int32 final cluster representative
+    node_visible: np.ndarray,  # (M_pad, F) bool aggregated per representative
+    frame_ids: Sequence,  # original frame identifiers, len F
+    *,
+    k_max: int = 127,
+    point_filter_threshold: float = 0.5,
+    dbscan_eps: float = 0.1,
+    dbscan_min_points: int = 4,
+    overlap_merge_ratio: float = 0.8,
+    min_masks_per_object: int = 2,
+) -> SceneObjects:
+    f, n = first.shape
+    m_pad = mask_frame.shape[0]
+
+    gmap = np.full((f, k_max + 2), -1, dtype=np.int64)
+    act_idx = np.nonzero(mask_active)[0]
+    gmap[mask_frame[act_idx], mask_id[act_idx]] = act_idx
+
+    m_coo, p_coo, f_coo = _claims_coo(first, last, gmap)
+    rep_coo = assignment[m_coo]
+
+    # per-mask point sets (sorted by mask)
+    order = np.argsort(m_coo, kind="stable")
+    m_sorted, p_by_mask = m_coo[order], p_coo[order]
+    mask_starts = np.searchsorted(m_sorted, np.arange(m_pad + 1))
+
+    def mask_points(m):
+        return p_by_mask[mask_starts[m]: mask_starts[m + 1]]
+
+    # node sizes: count of active member masks per representative
+    sizes = np.bincount(assignment[mask_active], minlength=m_pad)
+    reps = np.nonzero(sizes >= min_masks_per_object)[0]
+
+    # node point sets: unique (rep, point)
+    rp = np.unique(np.stack([rep_coo, p_coo], axis=1), axis=0)
+    rp_starts = np.searchsorted(rp[:, 0], np.arange(m_pad + 1))
+
+    # node claimed (rep, point, frame) triples, deduped
+    rpf = np.unique(np.stack([rep_coo, p_coo, f_coo], axis=1), axis=0)
+    rpf_starts = np.searchsorted(rpf[:, 0], np.arange(m_pad + 1))
+
+    members_by_rep: Dict[int, np.ndarray] = {}
+    for m in act_idx:
+        members_by_rep.setdefault(int(assignment[m]), []).append(int(m))
+
+    total_point_ids: List[np.ndarray] = []
+    total_bboxes: List[Tuple[np.ndarray, np.ndarray]] = []
+    total_masks: List[List[Tuple]] = []
+
+    pv = point_visible  # (F, N)
+    for rep in reps:
+        node_pts = rp[rp_starts[rep]: rp_starts[rep + 1], 1]
+        if len(node_pts) == 0:
+            continue
+        node_frames = np.nonzero(node_visible[rep])[0]
+        if len(node_frames) == 0:
+            continue
+
+        # ---- detection ratio over the node's frames ----
+        # denominator: #node frames where the point is visible at all
+        # (np.ix_ selects the node's own points before materializing)
+        den = pv[np.ix_(node_frames, node_pts)].sum(axis=0).astype(np.float64)
+        # numerator: #node frames where the point is claimed by a node mask
+        tri = rpf[rpf_starts[rep]: rpf_starts[rep + 1]]
+        tri = tri[np.isin(tri[:, 2], node_frames)]
+        pos = np.searchsorted(node_pts, tri[:, 1])
+        num = np.bincount(pos, minlength=len(node_pts)).astype(np.float64)
+        ratio_ok = num / (den + 1e-6) > point_filter_threshold
+
+        # ---- DBSCAN split into spatially connected objects ----
+        labels = dbscan_labels(scene_points[node_pts], eps=dbscan_eps,
+                               min_points=dbscan_min_points)
+        groups = labels + 1  # group 0 = noise, kept as its own candidate object
+        # (the reference keeps the noise group too, post_process.py:109-123)
+
+        # ---- assign each member mask to its best-overlapping object ----
+        group_ids = np.unique(groups)
+        group_sets = {g: node_pts[groups == g] for g in group_ids}
+        obj_masks: Dict[int, List[Tuple]] = {g: [] for g in group_ids}
+        for m in members_by_rep.get(int(rep), []):
+            mp = mask_points(m)
+            best_g, best_inter = -1, 0
+            best_cov = 0.0
+            for g in group_ids:
+                inter = np.intersect1d(mp, group_sets[g], assume_unique=False).size
+                if inter > best_inter:
+                    best_g, best_inter = g, inter
+                    best_cov = inter / len(group_sets[g])
+            if best_inter > 0:
+                obj_masks[best_g].append(
+                    (frame_ids[mask_frame[m]], int(mask_id[m]), float(best_cov))
+                )
+
+        for g in group_ids:
+            sel = groups == g
+            obj_pts_all = node_pts[sel]
+            obj_pts = obj_pts_all[ratio_ok[sel]]
+            if len(obj_pts) == 0 or len(obj_masks[g]) < min_masks_per_object:
+                continue
+            pts3d = scene_points[obj_pts_all]
+            total_point_ids.append(obj_pts)
+            total_bboxes.append((pts3d.min(axis=0), pts3d.max(axis=0)))
+            total_masks.append(obj_masks[g])
+
+    point_ids_list, mask_list = _merge_overlapping(
+        total_point_ids, total_bboxes, total_masks, overlap_merge_ratio
+    )
+    return SceneObjects(point_ids_list=point_ids_list, mask_list=mask_list, num_points=n)
+
+
+def _merge_overlapping(point_ids_list, bbox_list, mask_list, overlap_ratio: float):
+    """Greedy pairwise overlap suppression (reference post_process.py:7-37).
+
+    Scan order and the "first passing test wins" asymmetry are preserved:
+    if |i∩j|/|i| > r, object i dies; elif |i∩j|/|j| > r, object j dies.
+    """
+    num = len(point_ids_list)
+    dead = np.zeros(num, dtype=bool)
+    sets = [frozenset(p.tolist()) for p in point_ids_list]
+    for i in range(num):
+        if dead[i]:
+            continue
+        for j in range(i + 1, num):
+            if dead[j]:
+                continue
+            (imin, imax), (jmin, jmax) = bbox_list[i], bbox_list[j]
+            if np.any(imin > jmax) or np.any(jmin > imax):
+                continue
+            inter = len(sets[i] & sets[j])
+            if inter / max(len(sets[i]), 1) > overlap_ratio:
+                dead[i] = True
+                # no break: the reference keeps scanning j with dead i, and a
+                # later j can still die via the elif branch
+            elif inter / max(len(sets[j]), 1) > overlap_ratio:
+                dead[j] = True
+    keep = [k for k in range(num) if not dead[k]]
+    return [point_ids_list[k] for k in keep], [mask_list[k] for k in keep]
+
+
+def representative_masks(mask_info_list: List[Tuple], top_k: int = 5) -> List[Tuple]:
+    """Top-k masks by object coverage (reference post_process.py:126-128)."""
+    return sorted(mask_info_list, key=lambda t: t[2], reverse=True)[:top_k]
+
+
+def export_artifacts(objects: SceneObjects, seq_name: str, config_name: str,
+                     object_dict_dir: str, prediction_root: str = "data/prediction",
+                     top_k_repre: int = 5) -> Dict[str, str]:
+    """Write the class-agnostic npz + object_dict.npy artifact pair.
+
+    Formats match the reference exactly (post_process.py:131-170) so the
+    evaluation protocol and the semantics stage read either framework's
+    output interchangeably.
+    """
+    num_instance = len(objects.point_ids_list)
+    masks = np.zeros((objects.num_points, max(num_instance, 0)), dtype=bool)
+    object_dict = {}
+    for i, (pids, mlist) in enumerate(zip(objects.point_ids_list, objects.mask_list)):
+        masks[pids, i] = True
+        object_dict[i] = {
+            "point_ids": np.asarray(pids),
+            "mask_list": mlist,
+            "repre_mask_list": representative_masks(mlist, top_k_repre),
+        }
+
+    ca_dir = os.path.join(prediction_root, config_name + "_class_agnostic")
+    os.makedirs(ca_dir, exist_ok=True)
+    npz_path = os.path.join(ca_dir, f"{seq_name}.npz")
+    np.savez(
+        npz_path,
+        pred_masks=masks,
+        pred_score=np.ones(num_instance),
+        pred_classes=np.zeros(num_instance, dtype=np.int32),
+    )
+
+    od_dir = os.path.join(object_dict_dir, config_name)
+    os.makedirs(od_dir, exist_ok=True)
+    od_path = os.path.join(od_dir, "object_dict.npy")
+    np.save(od_path, object_dict, allow_pickle=True)
+    return {"npz": npz_path, "object_dict": od_path}

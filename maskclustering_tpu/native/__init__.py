@@ -1,0 +1,107 @@
+"""Native C++ host-side runtime components (ctypes bindings).
+
+Provides grid-accelerated DBSCAN, union-find connected components, and
+statistical-outlier removal as a shared library for the host-side parts of
+the pipeline (the reference gets these from Open3D's C++ core). Build with
+``python -m maskclustering_tpu.native.build``; all entry points degrade
+gracefully to Python/sklearn fallbacks when the library isn't built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "libmc_native.so")
+    if not os.path.exists(path):
+        return None
+    lib = ctypes.CDLL(path)
+    lib.mc_dbscan.restype = ctypes.c_int
+    lib.mc_dbscan.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.c_double, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.mc_connected_components.restype = ctypes.c_int
+    lib.mc_connected_components.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.mc_statistical_outliers.restype = ctypes.c_int
+    lib.mc_statistical_outliers.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.c_int, ctypes.c_double, ctypes.POINTER(ctypes.c_uint8),
+    ]
+    _LIB = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_dbscan(points: np.ndarray, eps: float, min_points: int) -> np.ndarray:
+    """Grid-accelerated DBSCAN; labels with -1 noise, clusters ordered by
+    first-seen core point (matches Open3D's contract)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built")
+    points = np.ascontiguousarray(points, dtype=np.float64)
+    n = len(points)
+    labels = np.empty(n, dtype=np.int64)
+    rc = lib.mc_dbscan(
+        points.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n,
+        ctypes.c_double(eps), ctypes.c_int(min_points),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc != 0:
+        raise RuntimeError(f"mc_dbscan failed with code {rc}")
+    return labels
+
+
+def native_connected_components(edges_a: np.ndarray, edges_b: np.ndarray,
+                                num_nodes: int) -> np.ndarray:
+    """Union-find connected components over an edge list."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built")
+    edges_a = np.ascontiguousarray(edges_a, dtype=np.int64)
+    edges_b = np.ascontiguousarray(edges_b, dtype=np.int64)
+    out = np.empty(num_nodes, dtype=np.int64)
+    rc = lib.mc_connected_components(
+        edges_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        edges_b.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(edges_a), num_nodes,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc != 0:
+        raise RuntimeError(f"mc_connected_components failed with code {rc}")
+    return out
+
+
+def native_statistical_outliers(points: np.ndarray, nb_neighbors: int = 20,
+                                std_ratio: float = 2.0) -> np.ndarray:
+    """Inlier mask per Open3D remove_statistical_outlier semantics."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built")
+    points = np.ascontiguousarray(points, dtype=np.float64)
+    n = len(points)
+    keep = np.empty(n, dtype=np.uint8)
+    rc = lib.mc_statistical_outliers(
+        points.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n,
+        ctypes.c_int(nb_neighbors), ctypes.c_double(std_ratio),
+        keep.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if rc != 0:
+        raise RuntimeError(f"mc_statistical_outliers failed with code {rc}")
+    return keep.astype(bool)

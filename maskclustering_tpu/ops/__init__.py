@@ -1,0 +1,19 @@
+from maskclustering_tpu.ops.geometry import (
+    bbox_of,
+    bboxes_overlap,
+    invert_se3,
+    project_points,
+    transform_points,
+    unproject_depth,
+    voxel_downsample_np,
+)
+
+__all__ = [
+    "bbox_of",
+    "bboxes_overlap",
+    "invert_se3",
+    "project_points",
+    "transform_points",
+    "unproject_depth",
+    "voxel_downsample_np",
+]

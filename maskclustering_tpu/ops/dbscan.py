@@ -1,0 +1,71 @@
+"""DBSCAN clustering — host dispatch (native C++ or sklearn) + jittable core.
+
+Two call sites in the pipeline, both off the XLA hot path (reference uses
+Open3D's C++ cluster_dbscan at eps 0.04/0.1, utils/geometry.py:10 and
+utils/post_process.py:109). `dbscan_labels` dispatches to the native C++
+extension (maskclustering_tpu/native) when built, else sklearn.
+
+`dbscan_fixed_jax` is a bounded-iteration, static-shape DBSCAN usable inside
+jit for the exact-parity backprojection path where per-mask denoising runs
+on-device (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    from maskclustering_tpu.native import native_available, native_dbscan
+
+    _HAS_NATIVE = native_available()
+except Exception:  # pragma: no cover
+    native_dbscan = None
+    _HAS_NATIVE = False
+
+
+def dbscan_labels(points: np.ndarray, eps: float, min_points: int) -> np.ndarray:
+    """Standard DBSCAN labels; -1 = noise (Open3D cluster_dbscan contract).
+
+    min_points counts the point itself, matching Open3D and sklearn.
+    """
+    points = np.ascontiguousarray(np.asarray(points, dtype=np.float64))
+    if len(points) == 0:
+        return np.zeros(0, dtype=np.int64)
+    if _HAS_NATIVE:
+        return native_dbscan(points, eps, min_points)
+    from sklearn.cluster import DBSCAN
+
+    return DBSCAN(eps=eps, min_samples=min_points).fit(points).labels_.astype(np.int64)
+
+
+def dbscan_fixed_jax(points, valid, eps: float, min_points: int, max_iters: int = 64):
+    """Static-shape DBSCAN inside jit: core-point expansion by label propagation.
+
+    points: (P, 3); valid: (P,) bool (padding rows excluded).
+    Returns (P,) int32 labels, -1 for noise/padding. Border points attach to
+    the lowest-labeled neighboring core cluster (deterministic, unlike
+    scan-order-dependent classic DBSCAN — only tie-breaking differs).
+    O(P^2) distances — intended for per-mask point sets (P <= a few k).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    p = points.shape[0]
+    d2 = jnp.sum((points[:, None, :] - points[None, :, :]) ** 2, axis=-1)
+    near = (d2 <= eps * eps) & valid[:, None] & valid[None, :]
+    degree = jnp.sum(near, axis=1)  # includes self
+    core = (degree >= min_points) & valid
+
+    core_adj = near & core[:, None] & core[None, :]
+    labels = jnp.where(core, jnp.arange(p, dtype=jnp.int32), p)
+
+    def body(i, lab):
+        neigh = jnp.where(core_adj, lab[None, :], p)
+        return jnp.where(core, jnp.minimum(lab, jnp.min(neigh, axis=1)), lab)
+
+    labels = jax.lax.fori_loop(0, max_iters, body, labels)
+    # border points: lowest neighboring core label
+    border_lab = jnp.min(jnp.where(near & core[None, :], labels[None, :], p), axis=1)
+    labels = jnp.where(core, labels, jnp.where(valid & (border_lab < p), border_lab, p))
+    # compact: noise/padding -> -1
+    return jnp.where(labels >= p, -1, labels)

@@ -1,0 +1,3 @@
+from maskclustering_tpu.semantics.vocab import get_vocab
+
+__all__ = ["get_vocab"]

@@ -1,0 +1,30 @@
+"""Benchmark label vocabularies (ScanNet / Matterport3D / ScanNet++).
+
+These are fixed benchmark label lists (data, not logic), stored as JSON under
+``vocab_data/`` rather than inlined in code. Sources: the ScanNet 200/..
+benchmark vocabulary, Matterport3D categories, and the ScanNet++ class list
+(reference evaluation/constants.py holds the same data as Python literals).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import List, Tuple
+
+_VOCAB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "vocab_data")
+
+_ALIASES = {"matterport": "matterport3d", "demo": "scannet"}
+
+
+@functools.lru_cache(maxsize=None)
+def get_vocab(dataset: str) -> Tuple[List[str], List[int]]:
+    """Return (labels, ids) for a dataset's benchmark vocabulary."""
+    dataset = _ALIASES.get(dataset, dataset)
+    path = os.path.join(_VOCAB_DIR, f"{dataset}.json")
+    if not os.path.exists(path):
+        raise KeyError(f"no vocabulary for dataset {dataset!r}")
+    with open(path) as f:
+        d = json.load(f)
+    return d["labels"], d["ids"]

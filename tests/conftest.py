@@ -1,0 +1,28 @@
+"""Test environment: force an 8-virtual-device CPU mesh.
+
+All device-code tests run on a CPU mesh standing in for a TPU slice; the
+same pjit/shard_map code paths compile identically (SURVEY.md §4's
+CPU-device test strategy).
+
+Note: the environment preloads jax with a TPU ('axon') platform via
+sitecustomize, so JAX_PLATFORMS set here is too late — the platform must be
+switched through jax.config before any backend initialization.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

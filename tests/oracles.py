@@ -1,0 +1,128 @@
+"""Reference-semantics oracles for A/B testing the tensorized stages.
+
+Set/dict/bincount implementations that follow the reference algorithms
+literally (graph/construction.py, graph/iterative_clustering.py), used to
+verify that the dense MXU formulations in maskclustering_tpu.models produce
+identical decisions. Deliberately slow and simple.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Set, Tuple
+
+import networkx as nx
+import numpy as np
+
+
+def oracle_graph_stats(
+    point_in_mask: np.ndarray,  # (F, N) int zeroed at boundary
+    mask_sets: Dict[Tuple[int, int], Set[int]],  # (frame, id) -> point ids (incl boundary)
+    boundary: Set[int],
+    mask_visible_threshold: float,
+    contained_threshold: float,
+    undersegment_filter_threshold: float,
+    big_mask_point_count: int = 500,
+):
+    """Reference construction.py:80-171 semantics on explicit sets."""
+    masks = sorted(mask_sets.keys())  # (frame, id) ascending — matches table order
+    idx = {mk: i for i, mk in enumerate(masks)}
+    f_num = point_in_mask.shape[0]
+    m_num = len(masks)
+    visible = np.zeros((m_num, f_num), dtype=bool)
+    contained = np.zeros((m_num, m_num), dtype=bool)
+    undersegment = np.zeros(m_num, dtype=bool)
+
+    for mi, (mf, mid) in enumerate(masks):
+        valid_pts = sorted(mask_sets[(mf, mid)] - boundary)
+        info = point_in_mask[:, valid_pts]  # (F, P)
+        n_tot = len(valid_pts)
+        visible_num = 0
+        split_num = 0
+        for j in range(f_num):
+            if n_tot == 0:
+                continue
+            col = info[j]
+            n_vis = int(np.sum(col > 0))
+            if n_vis == 0:
+                continue
+            if (n_vis / n_tot) < mask_visible_threshold and n_vis < big_mask_point_count:
+                continue
+            visible_num += 1
+            counts = np.bincount(col[col > 0])
+            top = int(np.argmax(counts))
+            if counts[top] / n_vis > contained_threshold:
+                visible[mi, j] = True
+                contained[mi, idx[(j, top)]] = True
+            else:
+                split_num += 1
+        if visible_num == 0 or split_num / visible_num > undersegment_filter_threshold:
+            undersegment[mi] = True
+
+    # undo undersegmented observers (construction.py:163-169)
+    for mi in np.nonzero(undersegment)[0]:
+        mf, _ = masks[mi]
+        supporters = np.nonzero(contained[:, mi])[0]
+        contained[:, mi] = False
+        visible[supporters, mf] = False
+
+    return masks, visible, contained, undersegment
+
+
+def oracle_observer_thresholds(visible: np.ndarray) -> List[float]:
+    """Reference construction.py:80-96."""
+    v = visible.astype(np.float64)
+    obs = v @ v.T
+    flat = obs.flatten()
+    flat = flat[flat > 0]
+    out = []
+    for percentile in range(95, -5, -5):
+        val = float(np.percentile(flat, percentile)) if len(flat) else 0.0
+        if val <= 1:
+            if percentile < 50:
+                break
+            val = 1.0
+        out.append(val)
+    return out
+
+
+def oracle_clustering(
+    visible: np.ndarray,  # (M, F) bool — only active masks' rows meaningful
+    contained: np.ndarray,  # (M, M) bool
+    active: np.ndarray,  # (M,) bool
+    thresholds: Sequence[float],
+    view_consensus_threshold: float,
+) -> np.ndarray:
+    """Reference iterative_clustering.py via explicit node lists + networkx.
+
+    Returns a partition label per mask (label = min member index), inactive
+    masks keep their own index.
+    """
+    nodes: List[Dict] = [
+        {"members": [i], "visible": visible[i].copy(), "contained": contained[i].copy()}
+        for i in np.nonzero(active)[0]
+    ]
+    for thr in thresholds:
+        if not nodes:
+            break
+        v = np.stack([n["visible"] for n in nodes]).astype(np.float64)
+        c = np.stack([n["contained"] for n in nodes]).astype(np.float64)
+        observers = v @ v.T
+        supporters = c @ c.T
+        rate = supporters / (observers + 1e-7)
+        disconnect = np.eye(len(nodes), dtype=bool) | (observers < thr)
+        adj = (rate >= view_consensus_threshold) & ~disconnect
+        graph = nx.from_numpy_array(adj)
+        new_nodes = []
+        for comp in nx.connected_components(graph):
+            members = sorted(m for ni in comp for m in nodes[ni]["members"])
+            new_nodes.append({
+                "members": members,
+                "visible": np.any([nodes[ni]["visible"] for ni in comp], axis=0),
+                "contained": np.any([nodes[ni]["contained"] for ni in comp], axis=0),
+            })
+        nodes = new_nodes
+
+    labels = np.arange(visible.shape[0])
+    for n in nodes:
+        labels[n["members"]] = min(n["members"])
+    return labels

@@ -1,0 +1,144 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maskclustering_tpu.models.backprojection import associate_frame, associate_scene
+from tests.synthetic import make_scene
+
+# looser-than-real thresholds sized for the synthetic scene's point spacing
+DT = 0.03
+COV = 0.3
+
+
+def _assoc_frame(scene, f, **kw):
+    args = dict(
+        k_max=15, window=1, distance_threshold=DT, depth_trunc=20.0,
+        few_points_threshold=25, coverage_threshold=COV,
+    )
+    args.update(kw)
+    return associate_frame(
+        jnp.asarray(scene.scene_points),
+        jnp.asarray(scene.depths[f]),
+        jnp.asarray(scene.segmentations[f]),
+        jnp.asarray(scene.intrinsics[f]),
+        jnp.asarray(scene.cam_to_world[f]),
+        jnp.asarray(scene.frame_valid[f]),
+        **args,
+    )
+
+
+@pytest.fixture(scope="module")
+def scene():
+    return make_scene(num_boxes=4, num_frames=8, seed=3)
+
+
+def test_points_land_on_their_own_object(scene):
+    fa = _assoc_frame(scene, 0)
+    mop = np.asarray(fa.mask_of_point)
+    obj_of_mask = scene.object_of_mask[0]
+    claimed = mop > 0
+    # a healthy fraction of box points should be claimed in a frame seeing them
+    assert claimed.sum() > 500
+    # claimed points must overwhelmingly carry their own gt object's mask id
+    got_obj = obj_of_mask[mop[claimed]]
+    agree = (got_obj == scene.gt_instance[claimed]).mean()
+    assert agree > 0.97, f"agreement {agree}"
+
+
+def test_floor_points_unclaimed(scene):
+    fa = _assoc_frame(scene, 0)
+    mop = np.asarray(fa.mask_of_point)
+    floor = scene.gt_instance == 0
+    # floor is background (seg id 0) so floor points must stay unclaimed
+    assert (mop[floor] > 0).mean() < 0.01
+
+
+def test_occluded_points_not_claimed(scene):
+    """Points on the far side of a box (occluded) must not be claimed."""
+    fa = _assoc_frame(scene, 0)
+    mop = np.asarray(fa.mask_of_point)
+    # world points more than 2*DT behind the rendered depth at their pixel
+    from maskclustering_tpu.ops.geometry import invert_se3
+
+    w2c = np.asarray(invert_se3(jnp.asarray(scene.cam_to_world[0])))
+    cam = scene.scene_points @ w2c[:3, :3].T + w2c[:3, 3]
+    h, w = scene.depths[0].shape
+    fx, fy = scene.intrinsics[0][0, 0], scene.intrinsics[0][1, 1]
+    cx, cy = scene.intrinsics[0][0, 2], scene.intrinsics[0][1, 2]
+    u = np.round(cam[:, 0] / cam[:, 2] * fx + cx).astype(int)
+    v = np.round(cam[:, 1] / cam[:, 2] * fy + cy).astype(int)
+    inb = (u >= 0) & (u < w) & (v >= 0) & (v < h) & (cam[:, 2] > 0)
+    d = np.zeros(len(cam))
+    d[inb] = scene.depths[0][v[inb], u[inb]]
+    occluded = inb & (cam[:, 2] > d + 4 * DT) & (d > 0)
+    assert occluded.sum() > 50  # scene has occlusions at all
+    assert (mop[occluded] > 0).mean() < 0.02
+
+
+def test_ghost_mask_rejected_by_coverage():
+    """A mask over geometry missing from the scene cloud must be dropped."""
+    scene = make_scene(num_boxes=3, num_frames=6, seed=5, ghost_box=True)
+    ghost_obj = 4  # boxes are objects 1..3, ghost is 4
+    hits = 0
+    for f in range(6):
+        fa = _assoc_frame(scene, f)
+        valid = np.asarray(fa.mask_valid)
+        ghost_mask_id = np.nonzero(scene.object_of_mask[f] == ghost_obj)[0]
+        real_ids = np.nonzero((scene.object_of_mask[f] > 0) & (scene.object_of_mask[f] != ghost_obj))[0]
+        npix = np.asarray(fa.n_pixels)
+        if len(ghost_mask_id) and npix[ghost_mask_id[0]] > 100:
+            hits += 1
+            assert not valid[ghost_mask_id[0]], f"ghost mask survived in frame {f}"
+        # at least some real masks valid
+        assert valid[real_ids].sum() >= 1
+    assert hits >= 2  # the ghost was actually visible in several frames
+
+
+def test_tiny_mask_rejected(scene):
+    fa = _assoc_frame(scene, 0, few_points_threshold=10 ** 9)
+    assert not np.asarray(fa.mask_valid).any()
+
+
+def test_boundary_points_zeroed_but_tracked():
+    """Points claimed by two masks are boundary: id 0 but first/last kept."""
+    scene = make_scene(num_boxes=4, num_frames=8, seed=3)
+    out = associate_scene(
+        jnp.asarray(scene.scene_points),
+        jnp.asarray(scene.depths),
+        jnp.asarray(scene.segmentations),
+        jnp.asarray(scene.intrinsics),
+        jnp.asarray(scene.cam_to_world),
+        jnp.asarray(scene.frame_valid),
+        k_max=15, window=1, distance_threshold=DT,
+        few_points_threshold=25, coverage_threshold=COV,
+    )
+    first = np.asarray(out.first_id)
+    last = np.asarray(out.last_id)
+    mop = np.asarray(out.mask_of_point)
+    bnd_ff = first != last
+    # wherever first != last the matrix entry must be zeroed
+    assert (mop[bnd_ff] == 0).all()
+    # wherever a unique claim exists the matrix carries it
+    uniq = (first == last) & (first > 0)
+    assert (mop[uniq] == first[uniq]).all()
+    # global boundary = any frame boundary
+    np.testing.assert_array_equal(np.asarray(out.boundary), bnd_ff.any(axis=0))
+    # visibility = claimed by >= 1 valid mask
+    np.testing.assert_array_equal(np.asarray(out.point_visible), first > 0)
+
+
+def test_invalid_frame_produces_nothing(scene):
+    fa = _assoc_frame(scene, 0)
+    fa_invalid = associate_frame(
+        jnp.asarray(scene.scene_points),
+        jnp.asarray(scene.depths[0]),
+        jnp.asarray(scene.segmentations[0]),
+        jnp.asarray(scene.intrinsics[0]),
+        jnp.asarray(scene.cam_to_world[0]),
+        jnp.asarray(False),
+        k_max=15, window=1, distance_threshold=DT,
+        few_points_threshold=25, coverage_threshold=COV,
+    )
+    assert np.asarray(fa.mask_valid).any()
+    assert not np.asarray(fa_invalid.mask_valid).any()
+    assert (np.asarray(fa_invalid.mask_of_point) == 0).all()

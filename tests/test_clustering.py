@@ -1,0 +1,94 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maskclustering_tpu.models.clustering import _connected_components, iterative_clustering
+from tests.oracles import oracle_clustering
+
+
+def _canon(labels, active):
+    """Canonicalize a partition for comparison: map each label to the min
+    active member index of its group."""
+    labels = np.asarray(labels)
+    out = np.full_like(labels, -1)
+    for lab in np.unique(labels[active]):
+        members = np.nonzero((labels == lab) & active)[0]
+        out[members] = members.min()
+    return out
+
+
+def test_connected_components_vs_networkx():
+    import networkx as nx
+
+    rng = np.random.default_rng(5)
+    for n, p in [(16, 0.1), (64, 0.03), (128, 0.01)]:
+        adj = rng.random((n, n)) < p
+        adj = adj | adj.T
+        np.fill_diagonal(adj, False)
+        labels = np.asarray(_connected_components(jnp.asarray(adj)))
+        g = nx.from_numpy_array(adj)
+        for comp in nx.connected_components(g):
+            comp = sorted(comp)
+            assert len({labels[i] for i in comp}) == 1
+            assert labels[comp[0]] == min(comp)
+
+
+def _random_problem(rng, m, f):
+    visible = rng.random((m, f)) < 0.4
+    contained = rng.random((m, m)) < 0.15
+    np.fill_diagonal(contained, True)
+    active = rng.random(m) < 0.85
+    thresholds = sorted(rng.integers(1, max(2, f // 2), size=4).tolist(), reverse=True)
+    return visible, contained, active, thresholds
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_iterative_clustering_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    m, f = 96, 12
+    visible, contained, active, thresholds = _random_problem(rng, m, f)
+    # inactive masks contribute nothing, mirroring init_nodes exclusion
+    o_labels = oracle_clustering(visible, contained, active, thresholds, 0.9)
+
+    sched = np.full(8, np.inf, dtype=np.float32)
+    sched[: len(thresholds)] = thresholds
+    res = iterative_clustering(
+        jnp.asarray(visible), jnp.asarray(contained), jnp.asarray(active),
+        jnp.asarray(sched), view_consensus_threshold=0.9)
+    got = np.asarray(res.assignment)
+
+    np.testing.assert_array_equal(_canon(got, active), _canon(o_labels, active))
+    # inactive masks must remain singletons
+    inactive = ~active
+    np.testing.assert_array_equal(got[inactive], np.arange(m)[inactive])
+
+
+def test_clustering_inf_schedule_is_identity():
+    rng = np.random.default_rng(9)
+    m, f = 32, 6
+    visible, contained, active, _ = _random_problem(rng, m, f)
+    sched = jnp.full((5,), jnp.inf, dtype=jnp.float32)
+    res = iterative_clustering(jnp.asarray(visible), jnp.asarray(contained),
+                               jnp.asarray(active), sched)
+    np.testing.assert_array_equal(np.asarray(res.assignment), np.arange(m))
+
+
+def test_node_visible_aggregates_members():
+    m, f = 8, 4
+    visible = np.zeros((m, f), dtype=bool)
+    visible[0, 0] = visible[1, 1] = True
+    visible[0, 2] = visible[1, 2] = True  # both see frame 2 -> observers=1? no: 2 shared
+    contained = np.eye(m, dtype=bool)
+    contained[0, 1] = contained[1, 0] = True
+    active = np.zeros(m, dtype=bool)
+    active[:2] = True
+    # observers(0,1) = shared visible frames = 1 (frame 2); supporters = 2
+    sched = jnp.asarray(np.array([1.0, np.inf, np.inf], dtype=np.float32))
+    res = iterative_clustering(jnp.asarray(visible), jnp.asarray(contained),
+                               jnp.asarray(active), sched, view_consensus_threshold=0.9)
+    a = np.asarray(res.assignment)
+    assert a[0] == a[1] == 0
+    nv = np.asarray(res.node_visible)
+    np.testing.assert_array_equal(nv[0], visible[0] | visible[1])
+    assert np.asarray(res.node_active)[0]
+    assert not np.asarray(res.node_active)[1]

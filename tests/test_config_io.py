@@ -1,0 +1,76 @@
+import numpy as np
+import pytest
+
+from maskclustering_tpu.config import PipelineConfig, load_config
+from maskclustering_tpu.io.ply import read_ply_points, write_ply_points
+from maskclustering_tpu.io.image import resize_nearest
+from maskclustering_tpu.semantics.vocab import get_vocab
+
+
+def test_load_config_known():
+    cfg = load_config("scannet")
+    assert cfg.dataset == "scannet"
+    assert cfg.step == 10
+    assert cfg.view_consensus_threshold == 0.9
+
+
+def test_load_config_per_dataset_thresholds():
+    cfg = load_config("scannetpp")
+    assert cfg.view_consensus_threshold == 1.0
+    assert cfg.contained_threshold == 0.9
+    assert cfg.step == 2
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        PipelineConfig(mask_visible_threshold=2.0)
+    with pytest.raises(ValueError):
+        PipelineConfig(step=0)
+
+
+def test_config_override():
+    cfg = load_config("demo", step=5, backend="cpu")
+    assert cfg.step == 5
+    assert cfg.backend == "cpu"
+
+
+def test_ply_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(100, 3)).astype(np.float32)
+    colors = rng.integers(0, 255, size=(100, 3)).astype(np.uint8)
+    path = str(tmp_path / "cloud.ply")
+    write_ply_points(path, pts, colors)
+    rp, rc = read_ply_points(path, return_colors=True)
+    np.testing.assert_allclose(rp, pts, atol=1e-6)
+    np.testing.assert_array_equal(rc, colors)
+
+
+def test_ply_ascii(tmp_path):
+    path = str(tmp_path / "a.ply")
+    with open(path, "w") as f:
+        f.write("ply\nformat ascii 1.0\nelement vertex 2\n"
+                "property float x\nproperty float y\nproperty float z\nend_header\n"
+                "0 1 2\n3 4 5\n")
+    pts = read_ply_points(path)
+    np.testing.assert_allclose(pts, [[0, 1, 2], [3, 4, 5]])
+
+
+def test_resize_nearest_preserves_ids():
+    ids = np.arange(12, dtype=np.uint16).reshape(3, 4)
+    out = resize_nearest(ids, (8, 6))
+    assert out.shape == (6, 8)
+    assert set(np.unique(out)) <= set(np.unique(ids))
+
+
+def test_vocab():
+    labels, ids = get_vocab("scannet")
+    assert len(labels) == len(ids) > 100
+    labels2, _ = get_vocab("demo")  # alias
+    assert labels2 == labels
+    with pytest.raises(KeyError):
+        get_vocab("nope")
+
+
+def test_load_config_missing_raises():
+    with pytest.raises(FileNotFoundError):
+        load_config("scannet_typo")

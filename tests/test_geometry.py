@@ -1,0 +1,88 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maskclustering_tpu.ops.geometry import (
+    invert_se3,
+    project_points,
+    transform_points,
+    unproject_depth,
+    voxel_downsample_np,
+)
+
+
+def random_pose(rng):
+    # random rotation via QR
+    q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(q) < 0:
+        q[:, 0] *= -1
+    pose = np.eye(4)
+    pose[:3, :3] = q
+    pose[:3, 3] = rng.normal(size=3)
+    return pose
+
+
+def test_invert_se3_roundtrip():
+    rng = np.random.default_rng(1)
+    pose = random_pose(rng)
+    inv = np.asarray(invert_se3(jnp.asarray(pose)))
+    np.testing.assert_allclose(inv @ pose, np.eye(4), atol=1e-6)
+
+
+def test_unproject_matches_manual():
+    rng = np.random.default_rng(2)
+    h, w = 12, 16
+    depth = rng.uniform(0.5, 3.0, size=(h, w)).astype(np.float32)
+    intr = np.array([[20.0, 0, 7.5], [0, 21.0, 5.5], [0, 0, 1]])
+    pose = random_pose(rng)
+    pts, valid = unproject_depth(jnp.asarray(depth), jnp.asarray(intr), jnp.asarray(pose))
+    pts = np.asarray(pts)
+    assert bool(np.all(np.asarray(valid)))
+    u, v = 9, 4
+    z = depth[v, u]
+    cam = np.array([(u - 7.5) * z / 20.0, (v - 5.5) * z / 21.0, z])
+    expect = pose[:3, :3] @ cam + pose[:3, 3]
+    np.testing.assert_allclose(pts[v, u], expect, atol=1e-5)
+
+
+def test_unproject_respects_trunc_and_zero():
+    depth = np.array([[0.0, 5.0], [25.0, 1.0]], dtype=np.float32)
+    intr = np.eye(3)
+    _, valid = unproject_depth(jnp.asarray(depth), jnp.asarray(intr), jnp.asarray(np.eye(4)),
+                               depth_trunc=20.0)
+    np.testing.assert_array_equal(np.asarray(valid), [[False, True], [False, True]])
+
+
+def test_project_unproject_roundtrip():
+    rng = np.random.default_rng(3)
+    h, w = 10, 14
+    depth = rng.uniform(1.0, 4.0, size=(h, w)).astype(np.float32)
+    intr = np.array([[30.0, 0, 6.5], [0, 30.0, 4.5], [0, 0, 1]])
+    pose = random_pose(rng)
+    pts, _ = unproject_depth(jnp.asarray(depth), jnp.asarray(intr), jnp.asarray(pose))
+    uv, z = project_points(pts.reshape(-1, 3), jnp.asarray(intr), invert_se3(jnp.asarray(pose)))
+    vv, uu = np.mgrid[0:h, 0:w]
+    np.testing.assert_allclose(np.asarray(uv[:, 0]), uu.ravel(), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(uv[:, 1]), vv.ravel(), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(z), depth.ravel(), atol=1e-4)
+
+
+def test_transform_points_matches_matmul():
+    rng = np.random.default_rng(4)
+    pose = random_pose(rng)
+    pts = rng.normal(size=(17, 3))
+    out = np.asarray(transform_points(jnp.asarray(pts), jnp.asarray(pose)))
+    expect = pts @ pose[:3, :3].T + pose[:3, 3]
+    np.testing.assert_allclose(out, expect, atol=1e-6)
+
+
+def test_voxel_downsample_merges_within_voxel():
+    pts = np.array([
+        [0.001, 0.001, 0.001],
+        [0.004, 0.004, 0.004],  # same 1cm voxel as above
+        [0.5, 0.5, 0.5],
+    ])
+    out = voxel_downsample_np(pts, 0.01)
+    assert out.shape == (2, 3)
+    merged = out[np.argmin(np.linalg.norm(out, axis=1))]
+    np.testing.assert_allclose(merged, [0.0025, 0.0025, 0.0025], atol=1e-9)

@@ -1,0 +1,138 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maskclustering_tpu.models.backprojection import associate_scene
+from maskclustering_tpu.models.graph import build_mask_table, compute_graph_stats, observer_schedule
+from tests.oracles import oracle_graph_stats, oracle_observer_thresholds
+from tests.synthetic import make_scene
+
+DT = 0.03
+K_MAX = 15
+
+
+@pytest.fixture(scope="module")
+def assoc_and_scene():
+    scene = make_scene(num_boxes=4, num_frames=8, seed=7)
+    out = associate_scene(
+        jnp.asarray(scene.scene_points),
+        jnp.asarray(scene.depths),
+        jnp.asarray(scene.segmentations),
+        jnp.asarray(scene.intrinsics),
+        jnp.asarray(scene.cam_to_world),
+        jnp.asarray(scene.frame_valid),
+        k_max=K_MAX, window=1, distance_threshold=DT,
+        few_points_threshold=25, coverage_threshold=0.3,
+    )
+    return scene, out
+
+
+def _mask_sets_from_assoc(first, last, mask_valid):
+    """Per-mask point sets incl. boundary points, from the claim tensors."""
+    sets = {}
+    f_num = first.shape[0]
+    for f in range(f_num):
+        for k in np.nonzero(mask_valid[f])[0]:
+            pts = set(np.nonzero(first[f] == k)[0].tolist())
+            pts |= set(np.nonzero(last[f] == k)[0].tolist())
+            if pts:
+                sets[(f, int(k))] = pts
+    return sets
+
+
+THRESH = dict(mask_visible_threshold=0.3, contained_threshold=0.8,
+              undersegment_filter_threshold=0.3, big_mask_point_count=500)
+
+
+def test_graph_stats_match_oracle(assoc_and_scene):
+    scene, out = assoc_and_scene
+    first = np.asarray(out.first_id)
+    last = np.asarray(out.last_id)
+    mop = np.asarray(out.mask_of_point)
+    boundary = set(np.nonzero(np.asarray(out.boundary))[0].tolist())
+    mask_valid = np.asarray(out.mask_valid)
+
+    mask_sets = _mask_sets_from_assoc(first, last, mask_valid)
+    o_masks, o_visible, o_contained, o_under = oracle_graph_stats(
+        mop, mask_sets, boundary, **THRESH)
+
+    table = build_mask_table(mask_valid, pad_multiple=64)
+    # table must enumerate the same masks in the same (frame, id) order
+    got_masks = list(zip(table.frame[: table.num_masks].tolist(),
+                         table.mask_id[: table.num_masks].tolist()))
+    assert got_masks == o_masks
+
+    stats = compute_graph_stats(
+        jnp.asarray(mop), jnp.asarray(out.boundary),
+        jnp.asarray(table.frame), jnp.asarray(table.mask_id), jnp.asarray(table.valid),
+        k_max=K_MAX, point_chunk=1024, **THRESH)
+
+    m = table.num_masks
+    np.testing.assert_array_equal(np.asarray(stats.undersegment)[:m], o_under)
+    np.testing.assert_array_equal(np.asarray(stats.visible)[:m], o_visible)
+    np.testing.assert_array_equal(np.asarray(stats.contained)[:m, :m], o_contained)
+    # padding rows must stay silent
+    assert not np.asarray(stats.visible)[m:].any()
+    assert not np.asarray(stats.contained)[m:].any()
+    assert not np.asarray(stats.undersegment)[m:].any()
+
+    # n_tot = |mask set minus boundary|
+    for mi, mk in enumerate(o_masks):
+        expect = len(mask_sets[mk] - boundary)
+        assert int(np.asarray(stats.n_tot)[mi]) == expect
+
+    # observer percentile schedule matches np.percentile (f64) exactly
+    o_thresholds = oracle_observer_thresholds(o_visible)
+    sched = observer_schedule(stats.sorted_observers, stats.observers_positive)
+    np.testing.assert_allclose(sched[: len(o_thresholds)],
+                               np.asarray(o_thresholds, dtype=np.float32), rtol=0)
+    assert np.isinf(sched[len(o_thresholds):]).all()
+
+
+def test_graph_stats_random_claims():
+    """Adversarial random claim matrices (not geometrically consistent)."""
+    rng = np.random.default_rng(11)
+    f_num, n, kk = 6, 400, 5
+    for trial in range(3):
+        first = np.zeros((f_num, n), dtype=np.int32)
+        last = np.zeros((f_num, n), dtype=np.int32)
+        for f in range(f_num):
+            claims = rng.integers(0, kk + 1, size=n)
+            second = np.where((rng.random(n) < 0.15) & (claims > 0),
+                              rng.integers(1, kk + 1, size=n), claims)
+            first[f] = np.minimum(claims, second)
+            last[f] = np.maximum(claims, second)
+        mop = np.where(first == last, first, 0)
+        boundary_arr = (first != last).any(axis=0)
+        boundary = set(np.nonzero(boundary_arr)[0].tolist())
+        mask_valid = np.zeros((f_num, K_MAX + 1), dtype=bool)
+        for f in range(f_num):
+            for k in range(1, kk + 1):
+                mask_valid[f, k] = ((first[f] == k) | (last[f] == k)).sum() >= 5
+
+        # zero claims of invalid masks the way associate_frame would
+        for f in range(f_num):
+            inv_f = ~mask_valid[f]
+            kill_first = inv_f[first[f]]
+            kill_last = inv_f[last[f]]
+            nf = np.where(kill_first, np.where(kill_last, 0, last[f]), first[f])
+            nl = np.where(kill_last, np.where(kill_first, 0, first[f]), last[f])
+            first[f], last[f] = nf, nl
+        mop = np.where((first == last), first, 0)
+        boundary_arr = ((first != last) & (first > 0)).any(axis=0)
+        boundary = set(np.nonzero(boundary_arr)[0].tolist())
+
+        mask_sets = _mask_sets_from_assoc(first, last, mask_valid)
+        if not mask_sets:
+            continue
+        o_masks, o_visible, o_contained, o_under = oracle_graph_stats(
+            mop, mask_sets, boundary, **THRESH)
+        table = build_mask_table(mask_valid, pad_multiple=64)
+        stats = compute_graph_stats(
+            jnp.asarray(mop), jnp.asarray(boundary_arr),
+            jnp.asarray(table.frame), jnp.asarray(table.mask_id), jnp.asarray(table.valid),
+            k_max=K_MAX, point_chunk=128, **THRESH)
+        m = table.num_masks
+        np.testing.assert_array_equal(np.asarray(stats.undersegment)[:m], o_under, err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(np.asarray(stats.visible)[:m], o_visible, err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(np.asarray(stats.contained)[:m, :m], o_contained, err_msg=f"trial {trial}")

@@ -1,0 +1,93 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from maskclustering_tpu.ops.neighbor import ball_query, ball_query_brute
+
+
+def _random_problem(rng, b=3, p=40, s=70):
+    query = rng.uniform(0, 1, size=(b, p, 3)).astype(np.float32)
+    cand = rng.uniform(0, 1, size=(b, s, 3)).astype(np.float32)
+    ql = rng.integers(1, p + 1, size=b)
+    cl = rng.integers(1, s + 1, size=b)
+    return query, cand, ql, cl
+
+
+@pytest.mark.parametrize("seed,k,radius", [(0, 5, 0.2), (1, 3, 0.1), (2, 20, 0.35)])
+def test_ball_query_matches_brute(seed, k, radius):
+    rng = np.random.default_rng(seed)
+    query, cand, ql, cl = _random_problem(rng)
+    got = np.asarray(ball_query(jnp.asarray(query), jnp.asarray(cand),
+                                jnp.asarray(ql), jnp.asarray(cl),
+                                k=k, radius=radius, query_chunk=16))
+    want = ball_query_brute(query, cand, ql, cl, k, radius)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ball_query_padding_rows_are_minus_one():
+    rng = np.random.default_rng(3)
+    query, cand, ql, cl = _random_problem(rng)
+    ql[:] = 5
+    got = np.asarray(ball_query(jnp.asarray(query), jnp.asarray(cand),
+                                jnp.asarray(ql), jnp.asarray(cl), k=4, radius=0.3))
+    assert (got[:, 5:, :] == -1).all()
+
+
+def test_native_dbscan_matches_sklearn():
+    from maskclustering_tpu.native import native_available
+
+    if not native_available():
+        from maskclustering_tpu.native.build import build
+
+        build()
+    from maskclustering_tpu.native import native_dbscan
+    from sklearn.cluster import DBSCAN
+
+    rng = np.random.default_rng(4)
+    for trial in range(3):
+        centers = rng.uniform(-3, 3, size=(4, 3))
+        pts = np.concatenate(
+            [c + rng.normal(0, 0.08, (rng.integers(30, 120), 3)) for c in centers]
+            + [rng.uniform(-6, 6, (15, 3))]
+        )
+        for eps, mp in [(0.3, 4), (0.25, 8)]:
+            lab = native_dbscan(pts, eps, mp)
+            sk = DBSCAN(eps=eps, min_samples=mp).fit(pts).labels_
+            # compare partitions over core-deterministic structure: noise sets
+            # equal, and cluster memberships identical up to relabeling
+            assert set(np.nonzero(lab == -1)[0]) == set(np.nonzero(sk == -1)[0])
+            for l in np.unique(lab[lab >= 0]):
+                members = lab == l
+                assert len(np.unique(sk[members])) == 1
+
+
+def test_native_connected_components_vs_networkx():
+    import networkx as nx
+
+    from maskclustering_tpu.native import native_available, native_connected_components
+
+    if not native_available():
+        pytest.skip("native lib not built")
+    rng = np.random.default_rng(5)
+    n = 200
+    edges = rng.integers(0, n, size=(300, 2))
+    labels = native_connected_components(edges[:, 0], edges[:, 1], n)
+    g = nx.Graph()
+    g.add_nodes_from(range(n))
+    g.add_edges_from(edges.tolist())
+    for comp in nx.connected_components(g):
+        comp = sorted(comp)
+        assert all(labels[c] == comp[0] for c in comp)
+
+
+def test_native_outlier_removal():
+    from maskclustering_tpu.native import native_available, native_statistical_outliers
+
+    if not native_available():
+        pytest.skip("native lib not built")
+    rng = np.random.default_rng(6)
+    cloud = rng.normal(0, 0.1, size=(500, 3))
+    outliers = np.array([[5, 5, 5.0], [-4, 6, 2.0]])
+    keep = native_statistical_outliers(np.concatenate([cloud, outliers]), 20, 2.0)
+    assert not keep[-1] and not keep[-2]
+    assert keep[:-2].mean() > 0.9

@@ -1,0 +1,86 @@
+import numpy as np
+import pytest
+
+from maskclustering_tpu.config import PipelineConfig
+from maskclustering_tpu.models.pipeline import run_scene
+from tests.synthetic import make_scene, to_scene_tensors, visibility_count
+
+
+def _config():
+    return PipelineConfig(
+        config_name="synthetic", dataset="demo", backend="cpu",
+        distance_threshold=0.03, step=1, mask_pad_multiple=64,
+        point_chunk=2048,
+    )
+
+
+def _iou(pred_ids, gt_mask):
+    pred = np.zeros_like(gt_mask)
+    pred[pred_ids] = True
+    inter = (pred & gt_mask).sum()
+    union = (pred | gt_mask).sum()
+    return inter / max(union, 1)
+
+
+@pytest.fixture(scope="module")
+def result_and_scene():
+    scene = make_scene(num_boxes=4, num_frames=10, seed=21)
+    cfg = _config()
+    res = run_scene(to_scene_tensors(scene), cfg, k_max=15)
+    return scene, res
+
+
+def test_pipeline_recovers_objects(result_and_scene):
+    scene, res = result_and_scene
+    objs = res.objects
+    n_gt = scene.gt_instance.max()
+    assert len(objs.point_ids_list) == n_gt, (
+        f"expected {n_gt} objects, got {len(objs.point_ids_list)}"
+    )
+    # the pipeline can only segment observed geometry: compare against the
+    # gt restricted to points visible in at least one frame
+    visible = visibility_count(scene) >= 1
+    matched = set()
+    for gt in range(1, n_gt + 1):
+        gt_mask = (scene.gt_instance == gt) & visible
+        ious = [_iou(p, gt_mask) for p in objs.point_ids_list]
+        best = int(np.argmax(ious))
+        assert max(ious) > 0.8, f"gt {gt}: best IoU {max(ious):.3f}"
+        assert best not in matched
+        matched.add(best)
+
+
+def test_pipeline_mask_lists(result_and_scene):
+    scene, res = result_and_scene
+    for mlist in res.objects.mask_list:
+        assert len(mlist) >= 2
+        for frame_id, mask_id, cov in mlist:
+            assert frame_id in scene.frame_ids
+            assert 0 < cov <= 1.0
+            # the mask id must map to a real object in that frame
+            assert scene.object_of_mask[frame_id, mask_id] > 0
+
+
+def test_export_artifacts(tmp_path, result_and_scene):
+    from maskclustering_tpu.models.postprocess import export_artifacts
+
+    scene, res = result_and_scene
+    paths = export_artifacts(
+        res.objects, "synth0", "synthetic",
+        object_dict_dir=str(tmp_path / "object"),
+        prediction_root=str(tmp_path / "prediction"),
+    )
+    data = np.load(paths["npz"])
+    n_inst = len(res.objects.point_ids_list)
+    assert data["pred_masks"].shape == (len(scene.gt_instance), n_inst)
+    assert data["pred_masks"].dtype == bool
+    np.testing.assert_array_equal(data["pred_score"], np.ones(n_inst))
+    np.testing.assert_array_equal(data["pred_classes"], np.zeros(n_inst, dtype=np.int32))
+
+    od = np.load(paths["object_dict"], allow_pickle=True).item()
+    assert set(od.keys()) == set(range(n_inst))
+    for i in range(n_inst):
+        np.testing.assert_array_equal(np.sort(od[i]["point_ids"]),
+                                      np.nonzero(data["pred_masks"][:, i])[0])
+        assert od[i]["repre_mask_list"] == sorted(
+            od[i]["mask_list"], key=lambda t: t[2], reverse=True)[:5]
