@@ -1,0 +1,88 @@
+"""End-of-round benchmark: per-scene mask-clustering wall time on one chip.
+
+Measures the full per-scene pipeline (projective association -> mask-graph
+stats -> iterative clustering -> post-process/export math) on a synthetic
+posed-RGB-D scene at ScanNet-like scale (~200k points, 150 frames stride-10
+equivalent, ~2k masks). The reference's published cost for this exact stage
+is 6.5 GPU-h for 311 ScanNet-val scenes on an RTX 3090 ~= 75 s/scene
+(reference README.md:205); vs_baseline = 75 / measured_s_per_scene.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--frames", type=int, default=150)
+    p.add_argument("--points", type=int, default=196608)  # 192k, ScanNet-ish
+    p.add_argument("--boxes", type=int, default=12)
+    p.add_argument("--image-h", type=int, default=240)
+    p.add_argument("--image-w", type=int, default=320)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--k-max", type=int, default=63)
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+
+    from maskclustering_tpu.config import PipelineConfig
+    from maskclustering_tpu.models.pipeline import run_scene
+    from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+    print(f"[bench] generating synthetic scene: F={args.frames} "
+          f"N={args.points} boxes={args.boxes} {args.image_h}x{args.image_w}",
+          file=sys.stderr)
+    t0 = time.time()
+    scene = make_scene(num_boxes=args.boxes, num_frames=args.frames,
+                       image_hw=(args.image_h, args.image_w), spacing=0.02, seed=0)
+    tensors = to_scene_tensors(scene)
+    # pad/trim the cloud to the requested static size (tile = harmless dups)
+    pts = tensors.scene_points
+    n = args.points
+    if pts.shape[0] < n:
+        pts = np.tile(pts, (-(-n // pts.shape[0]), 1))[:n]
+    else:
+        pts = pts[np.random.default_rng(0).choice(pts.shape[0], n, replace=False)]
+    tensors.scene_points = np.ascontiguousarray(pts, dtype=np.float32)
+    print(f"[bench] scene ready in {time.time()-t0:.1f}s "
+          f"({len(jax.devices())}x {jax.devices()[0].device_kind})", file=sys.stderr)
+
+    cfg = PipelineConfig(config_name="bench", dataset="demo",
+                         distance_threshold=0.03, few_points_threshold=25,
+                         point_chunk=8192)
+
+    # warm-up (compile)
+    t0 = time.time()
+    run_scene(tensors, cfg, k_max=args.k_max)
+    print(f"[bench] warm-up (incl. compile): {time.time()-t0:.1f}s", file=sys.stderr)
+
+    times = []
+    for i in range(args.repeats):
+        t0 = time.time()
+        result = run_scene(tensors, cfg, k_max=args.k_max)
+        times.append(time.time() - t0)
+        print(f"[bench] run {i}: {times[-1]:.2f}s "
+              f"({len(result.objects.point_ids_list)} objects, "
+              f"timings {['%s=%.2f' % kv for kv in result.timings.items()]})",
+              file=sys.stderr)
+
+    s_per_scene = float(np.median(times))
+    baseline = 75.0  # reference: 6.5 GPU-h / 311 ScanNet-val scenes (README.md:205)
+    print(json.dumps({
+        "metric": f"mask-clustering s/scene (synthetic scene: {args.frames}fr x "
+                  f"{args.points // 1024}k pts x {args.boxes} objects)",
+        "value": round(s_per_scene, 3),
+        "unit": "s/scene",
+        "vs_baseline": round(baseline / s_per_scene, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -196,6 +196,42 @@ def compute_graph_stats(
                       n_tot=n_tot, sorted_observers=flat, observers_positive=cnt_pos)
 
 
+def observer_schedule_device(sorted_observers: jnp.ndarray, observers_positive: jnp.ndarray,
+                             max_len: int = 20) -> jnp.ndarray:
+    """Jittable (f32) observer-percentile schedule for the fused device path.
+
+    Same semantics as `observer_schedule` (reference construction.py:80-96)
+    but computed in f32 on device so the whole pipeline can stay inside one
+    jit (the multi-chip fused step, parallel/sharded.py). Entries past the
+    reference's early-termination point (percentile < 50 and value <= 1)
+    become +inf, which makes those clustering iterations inert. Host parity
+    runs use `observer_schedule` (float64 interpolation).
+    """
+    total = sorted_observers.shape[0]
+    cnt = observers_positive.astype(jnp.int32)
+    qs_i = jnp.arange(95, -5, -5, dtype=jnp.int32)[:max_len]
+    qs = qs_i.astype(jnp.float32)
+    # rank position = (total - cnt) + (cnt - 1) * q / 100, split into an
+    # exact integer part and a fractional remainder so f32 rounding cannot
+    # shift the rank at M_pad^2 > 2^24 scale (cnt*q would overflow i32, so
+    # split cnt-1 = 100*d + r: (cnt-1)*q/100 = d*q + r*q/100).
+    cm1 = jnp.maximum(cnt - 1, 0)
+    d, r = cm1 // 100, cm1 % 100
+    rq = r * qs_i  # <= 99*95, exact
+    lo = (total - cnt) + d * qs_i + rq // 100
+    frac = (rq % 100).astype(jnp.float32) / 100.0
+    lo = jnp.clip(lo, 0, total - 1)
+    hi = jnp.minimum(lo + 1, total - 1)
+    v_lo = jnp.take(sorted_observers, lo)
+    v_hi = jnp.take(sorted_observers, hi)
+    interp = v_lo * (1.0 - frac) + jnp.where(hi > lo, v_hi, v_lo) * frac
+    le1 = interp <= 1.0
+    clipped = jnp.where(le1, 1.0, interp)
+    dead = (le1 & (qs < 50)) | (observers_positive == 0)
+    stopped = jnp.cumsum(dead.astype(jnp.int32)) > 0
+    return jnp.where(stopped, jnp.inf, clipped)
+
+
 def observer_schedule(sorted_observers, observers_positive, max_len: int = 20) -> np.ndarray:
     """Observer-count percentile schedule from the device-sorted distribution.
 

@@ -22,6 +22,7 @@ from typing import Dict, List, NamedTuple, Sequence, Tuple
 import numpy as np
 
 from maskclustering_tpu.ops.dbscan import dbscan_labels
+from maskclustering_tpu.ops.geometry import bboxes_overlap
 
 
 class SceneObjects(NamedTuple):
@@ -183,7 +184,7 @@ def _merge_overlapping(point_ids_list, bbox_list, mask_list, overlap_ratio: floa
             if dead[j]:
                 continue
             (imin, imax), (jmin, jmax) = bbox_list[i], bbox_list[j]
-            if np.any(imin > jmax) or np.any(jmin > imax):
+            if not bboxes_overlap(imin, imax, jmin, jmax):
                 continue
             inter = len(sets[i] & sets[j])
             if inter / max(len(sets[i]), 1) > overlap_ratio:

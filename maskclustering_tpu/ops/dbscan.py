@@ -38,7 +38,7 @@ def dbscan_labels(points: np.ndarray, eps: float, min_points: int) -> np.ndarray
     return DBSCAN(eps=eps, min_samples=min_points).fit(points).labels_.astype(np.int64)
 
 
-def dbscan_fixed_jax(points, valid, eps: float, min_points: int, max_iters: int = 64):
+def dbscan_fixed_jax(points, valid, eps: float, min_points: int):
     """Static-shape DBSCAN inside jit: core-point expansion by label propagation.
 
     points: (P, 3); valid: (P,) bool (padding rows excluded).
@@ -46,6 +46,10 @@ def dbscan_fixed_jax(points, valid, eps: float, min_points: int, max_iters: int 
     the lowest-labeled neighboring core cluster (deterministic, unlike
     scan-order-dependent classic DBSCAN — only tie-breaking differs).
     O(P^2) distances — intended for per-mask point sets (P <= a few k).
+
+    Label propagation runs to fixpoint with pointer jumping (one hop + one
+    label-of-label per sweep), so chains longer than any fixed iteration
+    budget still collapse to a single component.
     """
     import jax
     import jax.numpy as jnp
@@ -57,13 +61,21 @@ def dbscan_fixed_jax(points, valid, eps: float, min_points: int, max_iters: int 
     core = (degree >= min_points) & valid
 
     core_adj = near & core[:, None] & core[None, :]
-    labels = jnp.where(core, jnp.arange(p, dtype=jnp.int32), p)
+    init = jnp.where(core, jnp.arange(p, dtype=jnp.int32), p)
 
-    def body(i, lab):
+    def cond(state):
+        return state[1]
+
+    def body(state):
+        lab, _ = state
         neigh = jnp.where(core_adj, lab[None, :], p)
-        return jnp.where(core, jnp.minimum(lab, jnp.min(neigh, axis=1)), lab)
+        best = jnp.where(core, jnp.minimum(lab, jnp.min(neigh, axis=1)), lab)
+        # pointer jumping: label-of-label (padding index p stays p)
+        ext = jnp.concatenate([best, jnp.array([p], dtype=jnp.int32)])
+        best = jnp.where(core, jnp.minimum(best, ext[best]), best)
+        return best, jnp.any(best != lab)
 
-    labels = jax.lax.fori_loop(0, max_iters, body, labels)
+    labels, _ = jax.lax.while_loop(cond, body, (init, jnp.bool_(True)))
     # border points: lowest neighboring core label
     border_lab = jnp.min(jnp.where(near & core[None, :], labels[None, :], p), axis=1)
     labels = jnp.where(core, labels, jnp.where(valid & (border_lab < p), border_lab, p))

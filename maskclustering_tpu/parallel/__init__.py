@@ -1,0 +1,17 @@
+"""Mesh sharding and multi-chip execution (ICI/DCN collectives via XLA)."""
+
+from maskclustering_tpu.parallel.mesh import constrain, make_mesh, sharding
+from maskclustering_tpu.parallel.sharded import (
+    FusedStepResult,
+    build_fused_step,
+    fused_step_example_args,
+)
+
+__all__ = [
+    "constrain",
+    "make_mesh",
+    "sharding",
+    "FusedStepResult",
+    "build_fused_step",
+    "fused_step_example_args",
+]

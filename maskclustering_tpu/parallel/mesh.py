@@ -1,0 +1,55 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's only distribution mechanism is OS processes pinned to GPUs
+via ``CUDA_VISIBLE_DEVICES`` with the filesystem as IPC (reference
+run.py:8-17,33-50). The TPU analog is a `jax.sharding.Mesh` over the slice:
+collectives ride ICI, sharding is declared with `NamedSharding` /
+`PartitionSpec`, and XLA inserts the communication.
+
+Axis convention for this workload:
+
+- ``scene``  — data parallelism over scenes (the reference's per-GPU scene
+  sharding, run.py:33-38, but inside one jit instead of one OS process).
+- ``frame``  — sequence parallelism: RGB-D frames are the "sequence" axis;
+  per-frame association is embarrassingly parallel and the mask axis
+  (masks are ordered by frame) inherits the same sharding for the
+  O(M^2) affinity matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Tuple[str, ...] = ("scene", "frame"),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    With ``shape=None`` all devices land on the last axis (pure
+    sequence/tensor parallelism); a leading ``scene`` axis of size 1 keeps
+    the in_shardings uniform whether or not scene DP is used.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = (1,) * (len(axis_names) - 1) + (n,)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    return Mesh(np.array(devices).reshape(shape), axis_names)
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """`NamedSharding(mesh, PartitionSpec(*spec))` shorthand."""
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def constrain(x, mesh: Mesh, *spec):
+    """with_sharding_constraint shorthand (no-op outside jit tracing)."""
+    return jax.lax.with_sharding_constraint(x, sharding(mesh, *spec))

@@ -1,0 +1,194 @@
+"""Mesh-sharded, fully-jitted pipeline step (the multi-chip path).
+
+The reference scales by launching one OS process per GPU over a scene list
+(reference run.py:33-50); inside a scene everything is single-device. Here
+the *entire* per-scene pipeline — projective association, mask-graph
+statistics, observer schedule, iterative clustering — is one jitted program
+over a `jax.sharding.Mesh`, with a leading scene batch axis:
+
+- scenes  -> ``scene`` mesh axis (data parallelism; vmap with
+  ``spmd_axis_name`` so batch collectives partition over the axis);
+- frames  -> ``frame`` mesh axis (sequence parallelism: per-frame
+  association is independent; XLA turns the cross-frame reductions —
+  boundary OR, first/last min/max — into psums over ICI);
+- masks   -> masks are ordered by frame, so the (M_pad, F) visibility and
+  (M_pad, M_pad) containment/affinity matrices row-shard over the same
+  ``frame`` axis; the V@V^T / C@C^T consensus matmuls become
+  all-gather + local matmul, inserted by XLA from the constraints.
+
+This fused path uses a *dense* mask slot table (slot = frame * K_max + id),
+trading padding FLOPs for zero host syncs — the right trade on a pod where
+a host roundtrip costs more than padded MXU work. The single-chip path
+(models/pipeline.py) instead compacts masks on host between stages.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from maskclustering_tpu.models.backprojection import associate_frame
+from maskclustering_tpu.models.clustering import iterative_clustering
+from maskclustering_tpu.models.graph import compute_graph_stats, observer_schedule_device
+from maskclustering_tpu.parallel.mesh import constrain, sharding
+
+
+def _maybe_constrain(x, mesh, *spec):
+    return x if mesh is None else constrain(x, mesh, *spec)
+
+
+class FusedStepResult(NamedTuple):
+    """Per-scene-batch outputs of the fused step. Leading axis = scenes."""
+
+    assignment: jnp.ndarray  # (S, M_pad) int32 representative slot per mask slot
+    node_visible: jnp.ndarray  # (S, M_pad, F) bool aggregated visible_frame per rep
+    mask_active: jnp.ndarray  # (S, M_pad) bool valid & not undersegmented
+    mask_of_point: jnp.ndarray  # (S, F, N) int32 point-in-mask matrix
+    first_id: jnp.ndarray  # (S, F, N) int32
+    last_id: jnp.ndarray  # (S, F, N) int32
+    num_objects: jnp.ndarray  # (S,) int32 live representative count
+
+
+def _dense_mask_table(num_frames: int, k_max: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Static (frame, id) table covering every (frame, mask-id) slot."""
+    mask_frame = jnp.repeat(jnp.arange(num_frames, dtype=jnp.int32), k_max)
+    mask_id = jnp.tile(jnp.arange(1, k_max + 1, dtype=jnp.int32), num_frames)
+    return mask_frame, mask_id
+
+
+def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
+    """Compile-ready fused pipeline step over `mesh`.
+
+    Returns a jitted function of the batched scene arrays
+    ``(scene_points (S,N,3), depths (S,F,H,W), segs (S,F,H,W),
+    intrinsics (S,F,3,3), cam_to_world (S,F,4,4), frame_valid (S,F))``
+    producing a `FusedStepResult`. All shapes static; S must equal the
+    ``scene`` axis size times any per-device scene batch. ``mesh=None``
+    gives the same program with no sharding (single-chip compile checks).
+    """
+
+    def per_scene(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid):
+        f = depths.shape[0]
+        m_pad = f * k_max
+
+        # ---- association: vmap over frames (sequence-parallel) ----
+        def one_frame(depth, seg, intr, c2w, fv):
+            fa = associate_frame(
+                scene_points, depth, seg, intr, c2w, fv,
+                k_max=k_max, window=cfg.association_window,
+                distance_threshold=cfg.distance_threshold,
+                depth_trunc=cfg.depth_trunc,
+                few_points_threshold=cfg.few_points_threshold,
+                coverage_threshold=cfg.coverage_threshold,
+            )
+            return fa.mask_of_point, fa.first_id, fa.last_id, fa.mask_valid
+
+        mop, first, last, mask_valid = jax.vmap(one_frame)(
+            depths, segs, intrinsics, cam_to_world, frame_valid)
+        mop = _maybe_constrain(mop, mesh, "frame", None)
+        first = _maybe_constrain(first, mesh, "frame", None)
+        last = _maybe_constrain(last, mesh, "frame", None)
+
+        # cross-frame reductions: XLA lowers these to psums over `frame`
+        boundary = jnp.any(first != last, axis=0)
+
+        # ---- dense mask table + graph statistics ----
+        mask_frame, mask_id = _dense_mask_table(f, k_max)
+        active0 = mask_valid[mask_frame, mask_id]  # (M_pad,) slot validity
+        stats = compute_graph_stats(
+            mop, boundary, mask_frame, mask_id, active0,
+            k_max=k_max, point_chunk=cfg.point_chunk,
+            mask_visible_threshold=cfg.mask_visible_threshold,
+            contained_threshold=cfg.contained_threshold,
+            undersegment_filter_threshold=cfg.undersegment_filter_threshold,
+            big_mask_point_count=cfg.big_mask_point_count,
+        )
+        visible = _maybe_constrain(stats.visible, mesh, "frame", None)
+        contained = _maybe_constrain(stats.contained, mesh, "frame", None)
+
+        # ---- schedule + clustering, all on device ----
+        schedule = observer_schedule_device(
+            stats.sorted_observers, stats.observers_positive,
+            max_len=cfg.max_cluster_iterations)
+        active = active0 & ~stats.undersegment
+        result = iterative_clustering(
+            visible, contained, active, schedule,
+            view_consensus_threshold=cfg.view_consensus_threshold)
+        assignment = _maybe_constrain(result.assignment, mesh, "frame")
+        num_objects = jnp.sum(result.node_active & active).astype(jnp.int32)
+        return FusedStepResult(
+            assignment=assignment,
+            node_visible=result.node_visible,
+            mask_active=active,
+            mask_of_point=mop,
+            first_id=first,
+            last_id=last,
+            num_objects=num_objects,
+        )
+
+    if mesh is None:
+        return jax.jit(jax.vmap(per_scene))
+    batched = jax.vmap(per_scene, spmd_axis_name="scene")
+
+    in_shardings = (
+        sharding(mesh, "scene"),                 # scene_points (S, N, 3)
+        sharding(mesh, "scene", "frame"),        # depths (S, F, H, W)
+        sharding(mesh, "scene", "frame"),        # segs
+        sharding(mesh, "scene", "frame"),        # intrinsics
+        sharding(mesh, "scene", "frame"),        # cam_to_world
+        sharding(mesh, "scene", "frame"),        # frame_valid
+    )
+    out_shardings = FusedStepResult(
+        assignment=sharding(mesh, "scene", "frame"),
+        node_visible=sharding(mesh, "scene", "frame", None),
+        mask_active=sharding(mesh, "scene", "frame"),
+        mask_of_point=sharding(mesh, "scene", "frame", None),
+        first_id=sharding(mesh, "scene", "frame", None),
+        last_id=sharding(mesh, "scene", "frame", None),
+        num_objects=sharding(mesh, "scene"),
+    )
+    return jax.jit(
+        batched,
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+        donate_argnums=(1, 2) if donate else (),
+    )
+
+
+def fused_step_example_args(num_scenes: int = 2, num_frames: int = 8,
+                            num_points: int = 4096, image_hw=(32, 48), seed: int = 0,
+                            spacing: float = 0.08):
+    """Tiny synthetic scene batch for compile checks and dryruns.
+
+    ``spacing``/``num_points`` are chosen so no scene exceeds the point
+    budget — points are padded by tiling (harmless duplicates), never
+    truncated (truncation would starve later boxes of coverage).
+    """
+    from maskclustering_tpu.utils.synthetic import make_scene
+
+    scenes = [
+        make_scene(num_boxes=3, num_frames=num_frames, image_hw=image_hw,
+                   spacing=spacing, seed=seed + i)
+        for i in range(num_scenes)
+    ]
+    n = num_points
+
+    def pad_points(p):
+        if p.shape[0] > n:
+            raise ValueError(f"scene has {p.shape[0]} points > budget {n}; "
+                             f"raise num_points or spacing")
+        reps = -(-n // p.shape[0])
+        return np.tile(p, (reps, 1))[:n]
+
+    return (
+        np.stack([pad_points(s.scene_points) for s in scenes]).astype(np.float32),
+        np.stack([s.depths for s in scenes]),
+        np.stack([s.segmentations for s in scenes]),
+        np.stack([s.intrinsics for s in scenes]),
+        np.stack([s.cam_to_world for s in scenes]),
+        np.stack([s.frame_valid for s in scenes]),
+    )

@@ -3,7 +3,7 @@ import numpy as np
 import pytest
 
 from maskclustering_tpu.models.backprojection import associate_frame, associate_scene
-from tests.synthetic import make_scene
+from maskclustering_tpu.utils.synthetic import make_scene
 
 # looser-than-real thresholds sized for the synthetic scene's point spacing
 DT = 0.03

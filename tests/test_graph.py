@@ -5,7 +5,7 @@ import pytest
 from maskclustering_tpu.models.backprojection import associate_scene
 from maskclustering_tpu.models.graph import build_mask_table, compute_graph_stats, observer_schedule
 from tests.oracles import oracle_graph_stats, oracle_observer_thresholds
-from tests.synthetic import make_scene
+from maskclustering_tpu.utils.synthetic import make_scene
 
 DT = 0.03
 K_MAX = 15
@@ -136,3 +136,23 @@ def test_graph_stats_random_claims():
         np.testing.assert_array_equal(np.asarray(stats.undersegment)[:m], o_under, err_msg=f"trial {trial}")
         np.testing.assert_array_equal(np.asarray(stats.visible)[:m], o_visible, err_msg=f"trial {trial}")
         np.testing.assert_array_equal(np.asarray(stats.contained)[:m, :m], o_contained, err_msg=f"trial {trial}")
+
+
+def test_observer_schedule_device_matches_host():
+    """Device (f32 + exact integer ranks) vs host (f64) schedule parity."""
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.models.graph import observer_schedule, observer_schedule_device
+
+    rng = np.random.default_rng(11)
+    for trial in range(6):
+        m2 = int(rng.integers(50, 4000))
+        n_zero = int(rng.integers(0, m2 // 2))
+        obs = np.sort(np.concatenate([
+            np.zeros(n_zero), rng.integers(1, 40, size=m2 - n_zero).astype(np.float64)]))
+        host = observer_schedule(obs.astype(np.float32), m2 - n_zero)
+        dev = np.asarray(observer_schedule_device(
+            jnp.asarray(obs, jnp.float32), jnp.int32(m2 - n_zero)))
+        finite = np.isfinite(host)
+        assert (np.isfinite(dev) == finite).all(), (trial, host, dev)
+        np.testing.assert_allclose(dev[finite], host[finite], rtol=1e-6)

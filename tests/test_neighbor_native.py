@@ -91,3 +91,46 @@ def test_native_outlier_removal():
     keep = native_statistical_outliers(np.concatenate([cloud, outliers]), 20, 2.0)
     assert not keep[-1] and not keep[-2]
     assert keep[:-2].mean() > 0.9
+
+
+def test_dbscan_fixed_jax_long_chain():
+    """A >64-hop chain of core points must collapse to ONE cluster.
+
+    Regression: one-hop-per-iteration propagation with a fixed budget split
+    long thin components; pointer jumping runs to fixpoint.
+    """
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.ops.dbscan import dbscan_fixed_jax, dbscan_labels
+
+    n = 300
+    pts = np.stack([np.arange(n) * 0.05, np.zeros(n), np.zeros(n)], axis=1)
+    valid = np.ones(n, dtype=bool)
+    lab = np.asarray(dbscan_fixed_jax(jnp.asarray(pts, jnp.float32), jnp.asarray(valid),
+                                      eps=0.06, min_points=2))
+    assert (lab >= 0).all()
+    assert len(np.unique(lab)) == 1
+    ref = dbscan_labels(pts, eps=0.06, min_points=2)
+    assert len(np.unique(ref[ref >= 0])) == 1
+
+
+def test_dbscan_fixed_jax_matches_host():
+    """Cluster count parity with host DBSCAN on random blobs, incl. padding."""
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.ops.dbscan import dbscan_fixed_jax, dbscan_labels
+
+    rng = np.random.default_rng(3)
+    blobs = [rng.normal(c, 0.03, size=(40, 3)) for c in
+             [(0, 0, 0), (1, 0, 0), (0, 1, 0)]]
+    pts = np.concatenate(blobs)
+    pad = 8
+    pts_pad = np.concatenate([pts, np.full((pad, 3), 50.0)])
+    valid = np.concatenate([np.ones(len(pts), bool), np.zeros(pad, bool)])
+    lab = np.asarray(dbscan_fixed_jax(jnp.asarray(pts_pad, jnp.float32),
+                                      jnp.asarray(valid), eps=0.2, min_points=4))
+    ref = dbscan_labels(pts, eps=0.2, min_points=4)
+    assert (lab[len(pts):] == -1).all()
+    n_jax = len(np.unique(lab[:len(pts)][lab[:len(pts)] >= 0]))
+    n_ref = len(np.unique(ref[ref >= 0]))
+    assert n_jax == n_ref == 3

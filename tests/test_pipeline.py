@@ -3,7 +3,7 @@ import pytest
 
 from maskclustering_tpu.config import PipelineConfig
 from maskclustering_tpu.models.pipeline import run_scene
-from tests.synthetic import make_scene, to_scene_tensors, visibility_count
+from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors, visibility_count
 
 
 def _config():
