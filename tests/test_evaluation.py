@@ -1,0 +1,190 @@
+"""AP-protocol tests against analytically known cases.
+
+The reference ships no tests (SURVEY.md §4); these pin the protocol semantics
+of reference evaluation/evaluate.py: greedy matching, min-region filtering,
+void ignore, duplicate-detection false positives, and the AP integration.
+"""
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu.evaluation import (
+    assign_instances_for_scan,
+    compute_averages,
+    evaluate_matches,
+    evaluate_scans,
+    group_instances,
+)
+
+LABELS = ["cabinet", "bed"]
+VALID_IDS = [3, 4]
+ID2LABEL = {3: "cabinet", 4: "bed"}
+N = 1000
+
+
+def _gt_two_instances():
+    """Two cabinet instances of 300 points each; the rest unannotated."""
+    gt = np.zeros(N, dtype=np.int64)
+    gt[:300] = 3001
+    gt[300:600] = 3002
+    return gt
+
+
+def _matches(gt_ids, masks, scores, classes, **kw):
+    gt2pred, pred2gt = assign_instances_for_scan(
+        masks, scores, classes, gt_ids, LABELS, VALID_IDS, **kw)
+    return {"scan": {"gt": gt2pred, "pred": pred2gt}}
+
+
+def test_perfect_predictions_give_ap_one():
+    gt = _gt_two_instances()
+    masks = np.zeros((N, 2), dtype=bool)
+    masks[:300, 0] = True
+    masks[300:600, 1] = True
+    m = _matches(gt, masks, np.ones(2), np.full(2, 3))
+    aps = evaluate_matches(m, LABELS)
+    avgs = compute_averages(aps, LABELS)
+    assert avgs["classes"]["cabinet"]["ap"] == pytest.approx(1.0)
+    assert avgs["classes"]["bed"]["ap"] != avgs["classes"]["bed"]["ap"]  # NaN: no GT, no pred
+    assert avgs["all_ap"] == pytest.approx(1.0)  # nanmean skips bed
+
+
+def test_half_overlap_passes_ap25_fails_ap50():
+    """IoU = 150/450 = 1/3: counts at 0.25 threshold, misses at 0.5."""
+    gt = _gt_two_instances()
+    masks = np.zeros((N, 2), dtype=bool)
+    masks[150:450, 0] = True  # straddles both instances, IoU 1/3 with each
+    masks[300:600, 1] = True  # exact match of 3002
+    m = _matches(gt, masks, np.array([0.9, 1.0]), np.full(2, 3))
+    aps = evaluate_matches(m, LABELS)
+    avgs = compute_averages(aps, LABELS)
+    assert avgs["classes"]["cabinet"]["ap25%"] == pytest.approx(1.0)
+    # at IoU 0.5 only instance 3002 is found; the straddler is a false positive
+    assert avgs["classes"]["cabinet"]["ap50%"] < 1.0
+    assert avgs["classes"]["cabinet"]["ap50%"] > 0.0
+
+
+def test_small_predictions_are_skipped():
+    gt = _gt_two_instances()
+    masks = np.zeros((N, 1), dtype=bool)
+    masks[:50, 0] = True  # below the 100-vertex minimum region size
+    _, pred2gt = assign_instances_for_scan(
+        masks, np.ones(1), np.full(1, 3), gt, LABELS, VALID_IDS)
+    assert pred2gt["cabinet"] == []
+
+
+def test_void_coverage_is_not_a_false_positive():
+    """A prediction mostly on unannotated points is ignored, not penalized."""
+    gt = _gt_two_instances()
+    masks = np.zeros((N, 3), dtype=bool)
+    masks[:300, 0] = True
+    masks[300:600, 1] = True
+    masks[600:900, 2] = True  # entirely void
+    m = _matches(gt, masks, np.ones(3), np.full(3, 3))
+    aps = evaluate_matches(m, LABELS)
+    avgs = compute_averages(aps, LABELS)
+    assert avgs["classes"]["cabinet"]["ap"] == pytest.approx(1.0)
+
+
+def test_duplicate_detection_becomes_false_positive():
+    """Two perfect copies of one GT: the duplicate counts as an FP.
+
+    With a *lower* confidence duplicate the protocol still yields AP = 1.0
+    (the FP sits at a cutoff below full recall); with *equal* confidence the
+    FP shares the cutoff and AP = 0.75 (precision 0.5 at recall 1.0,
+    precision 1.0 at the artificial endpoint, trapezoid-integrated).
+    """
+    gt = np.zeros(N, dtype=np.int64)
+    gt[:300] = 3001
+    masks = np.zeros((N, 2), dtype=bool)
+    masks[:300, 0] = True
+    masks[:300, 1] = True
+
+    m = _matches(gt, masks, np.array([1.0, 0.5]), np.full(2, 3))
+    avgs = compute_averages(evaluate_matches(m, LABELS), LABELS)
+    assert avgs["classes"]["cabinet"]["ap50%"] == pytest.approx(1.0)
+
+    m = _matches(gt, masks, np.array([1.0, 1.0]), np.full(2, 3))
+    avgs = compute_averages(evaluate_matches(m, LABELS), LABELS)
+    assert avgs["classes"]["cabinet"]["ap50%"] == pytest.approx(0.75)
+
+
+def test_missed_instance_halves_recall():
+    gt = _gt_two_instances()
+    masks = np.zeros((N, 1), dtype=bool)
+    masks[:300, 0] = True  # only 3001 found
+    m = _matches(gt, masks, np.ones(1), np.full(1, 3))
+    aps = evaluate_matches(m, LABELS)
+    avgs = compute_averages(aps, LABELS)
+    # precision 1 up to recall 0.5, then 0: AP = 0.5
+    assert avgs["classes"]["cabinet"]["ap50%"] == pytest.approx(0.5)
+
+
+def test_no_class_mode_collapses_labels():
+    gt = np.zeros(N, dtype=np.int64)
+    gt[:300] = 3001  # cabinet
+    # bed; instance numbers are scene-unique (GT prep assigns inst ids
+    # globally, so id % 1000 stays distinct after the no_class remap)
+    gt[300:600] = 4002
+    gt[600:] = 4003  # cover every vertex: see phantom-instance test below
+    masks = np.zeros((N, 3), dtype=bool)
+    masks[:300, 0] = True
+    masks[300:600, 1] = True
+    masks[600:, 2] = True
+    # predicted classes are garbage; no_class ignores them
+    m = _matches(gt, masks, np.ones(3), np.array([99, 77, 55]), no_class=True)
+    aps = evaluate_matches(m, LABELS)
+    avgs = compute_averages(aps, LABELS)
+    assert avgs["classes"]["cabinet"]["ap"] == pytest.approx(1.0)
+
+
+def test_no_class_phantom_instance_from_unannotated():
+    """Protocol quirk parity (reference evaluate.py:261-262): in no_class
+    mode the remap ``id % 1000 + first*1000`` turns unannotated vertices
+    (encoded as 1 by GT prep, prepare_gt.py:23) into a phantom instance that
+    is never matched, costing a hard false negative."""
+    gt = np.full(N, 1, dtype=np.int64)  # reference encoding for unannotated
+    gt[:300] = 3002
+    masks = np.zeros((N, 1), dtype=bool)
+    masks[:300, 0] = True
+    m = _matches(gt, masks, np.ones(1), np.full(1, 3), no_class=True)
+    avgs = compute_averages(evaluate_matches(m, LABELS), LABELS)
+    # real instance matched, phantom missed: precision 1, recall 1/2 -> AP 0.5
+    assert avgs["classes"]["cabinet"]["ap50%"] == pytest.approx(0.5)
+
+
+def test_group_instances_skips_void_and_zero():
+    gt = np.zeros(N, dtype=np.int64)
+    gt[:200] = 3001
+    gt[200:400] = 99001  # label 99 not in vocabulary -> void
+    grouped = group_instances(gt, VALID_IDS, LABELS, ID2LABEL)
+    assert len(grouped["cabinet"]) == 1
+    assert grouped["cabinet"][0].vert_count == 200
+    assert grouped["bed"] == []
+
+
+def test_evaluate_scans_end_to_end(tmp_path):
+    """File-level round trip: npz + txt in, result file out."""
+    gt = np.zeros(N, dtype=np.int64)
+    gt[:300] = 3001  # label 3 = "cabinet" in the scannet vocabulary
+    gt[300:] = 3002  # all vertices annotated (no no_class phantom)
+    gt_dir = tmp_path / "gt"
+    pred_dir = tmp_path / "pred"
+    gt_dir.mkdir()
+    pred_dir.mkdir()
+    np.savetxt(gt_dir / "scene0000_00.txt", gt, fmt="%d")
+    masks = np.zeros((N, 2), dtype=bool)
+    masks[:300, 0] = True
+    masks[300:, 1] = True
+    np.savez(pred_dir / "scene0000_00.npz",
+             pred_masks=masks, pred_score=np.ones(2),
+             pred_classes=np.zeros(2, dtype=np.int32))
+    out = tmp_path / "result.txt"
+    avgs = evaluate_scans(
+        [str(pred_dir / "scene0000_00.npz")],
+        [str(gt_dir / "scene0000_00.txt")],
+        "scannet", no_class=True, output_file=str(out), verbose=False)
+    assert avgs["all_ap"] == pytest.approx(1.0)
+    lines = out.read_text().splitlines()
+    assert lines[0] == "class,class id,ap,ap50,ap25"
+    assert len(lines) > 2
