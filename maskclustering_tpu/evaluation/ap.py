@@ -100,13 +100,15 @@ def assign_instances_for_scan(
     }
     pred2gt: Dict[str, List[_Pred]] = {label: [] for label in labels}
 
-    # flatten GT instances into one one-hot tensor (columns in label order)
-    flat: List[Tuple[str, int]] = []  # (label, index within label)
+    # flatten GT instances into one one-hot tensor; columns are grouped by
+    # label, so each label owns a contiguous [start, stop) column range
     columns: List[np.ndarray] = []
+    label_cols: Dict[str, Tuple[int, int]] = {}
     for label in labels:
-        for j, rec in enumerate(gt2pred[label]):
-            flat.append((label, j))
+        start = len(columns)
+        for rec in gt2pred[label]:
             columns.append(gt_ids == rec.inst.instance_id)
+        label_cols[label] = (start, len(columns))
     gt_onehot = (np.stack(columns, axis=1) if columns
                  else np.zeros((len(gt_ids), 0), dtype=bool))
     void = ~np.isin(gt_ids // 1000, np.asarray(valid_ids))
@@ -136,14 +138,13 @@ def assign_instances_for_scan(
             confidence=float(pred_scores[i]),
             void_intersection=int(void_inter[i]),
         )
-        # same-label GT overlaps only (evaluate.py:313-323)
-        for col, (lab, j) in enumerate(flat):
-            if lab != label:
-                continue
-            n = int(inter[i, col])
-            if n > 0:
-                pred.matched_gt.append((gt2pred[label][j].inst, n))
-                gt2pred[label][j].matched_pred.append((pred, n))
+        # same-label GT overlaps only (evaluate.py:313-323); the label's
+        # columns are contiguous, so only its nonzero entries are visited
+        start, stop = label_cols[label]
+        for j in np.nonzero(inter[i, start:stop])[0]:
+            n = int(inter[i, start + j])
+            pred.matched_gt.append((gt2pred[label][j].inst, n))
+            gt2pred[label][j].matched_pred.append((pred, n))
         pred2gt[label].append(pred)
     return gt2pred, pred2gt
 

@@ -1,3 +1,53 @@
-from maskclustering_tpu.semantics.vocab import get_vocab
+"""Open-vocabulary semantics (reference semantics/ layer, L4)."""
 
-__all__ = ["get_vocab"]
+from maskclustering_tpu.semantics.vocab import get_vocab
+from maskclustering_tpu.semantics.crops import (
+    CROP_SCALES,
+    mask_to_box,
+    multiscale_crops,
+    pad_to_square,
+)
+from maskclustering_tpu.semantics.encoder import (
+    HashEncoder,
+    HFCLIPEncoder,
+    ImageEncoder,
+    PrecomputedFeatures,
+    TextEncoder,
+    l2_normalize,
+)
+from maskclustering_tpu.semantics.features import (
+    extract_label_features,
+    extract_mask_features,
+    pool_scale_features,
+    representative_mask_index,
+    save_mask_features,
+)
+from maskclustering_tpu.semantics.query import (
+    assign_labels,
+    classify_objects,
+    object_features,
+    run_query,
+)
+
+__all__ = [
+    "get_vocab",
+    "CROP_SCALES",
+    "mask_to_box",
+    "multiscale_crops",
+    "pad_to_square",
+    "HashEncoder",
+    "HFCLIPEncoder",
+    "ImageEncoder",
+    "PrecomputedFeatures",
+    "TextEncoder",
+    "l2_normalize",
+    "extract_label_features",
+    "extract_mask_features",
+    "pool_scale_features",
+    "representative_mask_index",
+    "save_mask_features",
+    "assign_labels",
+    "classify_objects",
+    "object_features",
+    "run_query",
+]

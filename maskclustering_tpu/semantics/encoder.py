@@ -1,0 +1,153 @@
+"""Pluggable CLIP encoders for open-vocabulary semantics.
+
+The reference hardwires open_clip ViT-H-14 laion2b_s32b_b79k on CUDA
+(get_open-voc_features.py:101-107, extract_label_featrues.py:7-13). Here the
+encoder is an interface so the pooling/query math (pure jnp) is testable and
+the model backend is swappable:
+
+- ``HFCLIPEncoder``: HuggingFace ``transformers`` CLIP (Flax on TPU when
+  available, else torch CPU) from a *local* checkpoint path or cache.
+- ``PrecomputedFeatures``: reads feature npy artifacts produced elsewhere —
+  the common deployment shape, since 2D mask prediction and CLIP encoding are
+  frozen upstream stages (SURVEY.md §2.2).
+- ``HashEncoder``: deterministic fake for tests.
+
+All encoders return L2-normalized float32 features.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+
+class ImageEncoder(Protocol):
+    feature_dim: int
+
+    def encode_images(self, images: Sequence[np.ndarray]) -> np.ndarray:
+        """(B, D) L2-normalized features from a list of HxWx3 uint8 images."""
+        ...
+
+
+class TextEncoder(Protocol):
+    feature_dim: int
+
+    def encode_texts(self, texts: Sequence[str]) -> np.ndarray:
+        """(B, D) L2-normalized features from text prompts."""
+        ...
+
+
+def l2_normalize(x: np.ndarray, axis: int = -1, eps: float = 1e-12) -> np.ndarray:
+    return x / np.maximum(np.linalg.norm(x, axis=axis, keepdims=True), eps)
+
+
+class HashEncoder:
+    """Deterministic stand-in encoder: feature = seeded hash of the input.
+
+    Images/texts that are bytewise identical map to identical unit vectors,
+    so pooling and query logic can be exercised without model weights.
+    """
+
+    def __init__(self, feature_dim: int = 64):
+        self.feature_dim = feature_dim
+
+    def _embed(self, payload: bytes) -> np.ndarray:
+        import zlib
+
+        rng = np.random.default_rng(zlib.crc32(payload))
+        return rng.standard_normal(self.feature_dim).astype(np.float32)
+
+    def encode_images(self, images: Sequence[np.ndarray]) -> np.ndarray:
+        feats = [self._embed(np.ascontiguousarray(im).tobytes()) for im in images]
+        return l2_normalize(np.stack(feats))
+
+    def encode_texts(self, texts: Sequence[str]) -> np.ndarray:
+        feats = [self._embed(t.encode()) for t in texts]
+        return l2_normalize(np.stack(feats))
+
+
+class HFCLIPEncoder:
+    """CLIP via HuggingFace transformers from a local checkpoint.
+
+    Prefers the Flax model (runs on the TPU through jax); falls back to torch
+    CPU. Raises a clear error when the checkpoint is unavailable — this
+    environment has no network egress, so weights must already be on disk.
+    """
+
+    def __init__(self, model_name_or_path: str, image_size: int = 224):
+        import logging
+
+        self.image_size = image_size
+        self._flax = None
+        self._torch = None
+        try:
+            from transformers import CLIPProcessor, FlaxCLIPModel
+
+            self._model = FlaxCLIPModel.from_pretrained(
+                model_name_or_path, local_files_only=True)
+            self._processor = CLIPProcessor.from_pretrained(
+                model_name_or_path, local_files_only=True)
+            self._flax = True
+        except (ImportError, OSError, EnvironmentError) as e:
+            logging.getLogger("maskclustering_tpu").warning(
+                "Flax CLIP load failed (%s); falling back to torch CPU", e)
+            from transformers import CLIPModel, CLIPProcessor
+
+            self._model = CLIPModel.from_pretrained(
+                model_name_or_path, local_files_only=True)
+            self._processor = CLIPProcessor.from_pretrained(
+                model_name_or_path, local_files_only=True)
+            self._torch = True
+        self.feature_dim = int(self._model.config.projection_dim)
+
+    def encode_images(self, images: Sequence[np.ndarray]) -> np.ndarray:
+        inputs = self._processor(images=list(images), return_tensors="np"
+                                 if self._flax else "pt")
+        if self._flax:
+            feats = np.asarray(self._model.get_image_features(**inputs))
+        else:
+            import torch
+
+            with torch.no_grad():
+                feats = self._model.get_image_features(**inputs).numpy()
+        return l2_normalize(feats.astype(np.float32))
+
+    def encode_texts(self, texts: Sequence[str]) -> np.ndarray:
+        inputs = self._processor(text=list(texts), return_tensors="np"
+                                 if self._flax else "pt", padding=True)
+        if self._flax:
+            feats = np.asarray(self._model.get_text_features(**inputs))
+        else:
+            import torch
+
+            with torch.no_grad():
+                feats = self._model.get_text_features(**inputs).numpy()
+        return l2_normalize(feats.astype(np.float32))
+
+
+class PrecomputedFeatures:
+    """Feature store backed by the reference's npy artifacts.
+
+    ``open-vocabulary_features.npy`` maps ``"{frame_id}_{mask_id}"`` to a
+    feature vector (reference get_open-voc_features.py:143-149);
+    ``data/text_features/<dataset>.npy`` maps label text to a feature
+    (extract_label_featrues.py:22-26).
+    """
+
+    def __init__(self, path: str):
+        self._dict = np.load(path, allow_pickle=True).item()
+        if not self._dict:
+            raise ValueError(f"feature store {path} is empty")
+        first = next(iter(self._dict.values()))
+        self.feature_dim = int(np.asarray(first).shape[-1])
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._dict
+
+    def get(self, key: str) -> Optional[np.ndarray]:
+        v = self._dict.get(key)
+        return None if v is None else np.asarray(v, dtype=np.float32)
+
+    def keys(self):
+        return self._dict.keys()

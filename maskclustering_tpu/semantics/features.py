@@ -1,0 +1,119 @@
+"""Per-mask open-vocabulary feature extraction and pooling.
+
+Pipeline parity with reference semantics/get_open-voc_features.py:109-149:
+gather the representative masks of every object from ``object_dict.npy``, crop
+each at 3 scales, encode with CLIP, L2-normalize, and average the scales into
+one feature per (frame, mask). Artifact contract is identical:
+``<object_dict_dir>/<config>/open-vocabulary_features.npy`` maps
+``"{frame_id}_{mask_id}"`` to a (D,) float vector.
+
+TPU-first difference: scale pooling is one reshaped jnp mean over the whole
+batch rather than a per-item Python loop, and image decoding is a thread pool
+(the reference uses a torch DataLoader with 16 workers purely for I/O).
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from maskclustering_tpu.semantics.crops import CROP_SCALES, multiscale_crops
+from maskclustering_tpu.semantics.encoder import ImageEncoder
+
+
+def pool_scale_features(features: np.ndarray, num_scales: int = CROP_SCALES) -> np.ndarray:
+    """(B*S, D) per-crop features -> (B, D) per-mask features.
+
+    Features arrive L2-normalized; the mask feature is their plain mean over
+    scales (reference get_open-voc_features.py:140-143 — NOT re-normalized).
+    """
+    b = features.shape[0] // num_scales
+    f = jnp.asarray(features).reshape(b, num_scales, -1)
+    return np.asarray(jnp.mean(f, axis=1))
+
+
+def representative_mask_index(object_dict: Dict) -> List[Tuple[str, int]]:
+    """Unique (frame_id, mask_id) pairs over all objects' representative masks."""
+    seen = []
+    seen_set = set()
+    for value in object_dict.values():
+        for mask_info in value.get("repre_mask_list", []):
+            key = (mask_info[0], int(mask_info[1]))
+            if key not in seen_set:
+                seen_set.add(key)
+                seen.append(key)
+    return seen
+
+
+def extract_mask_features(
+    dataset,
+    object_dict: Dict,
+    encoder: ImageEncoder,
+    *,
+    batch_size: int = 64,
+    io_workers: int = 16,
+) -> Dict[str, np.ndarray]:
+    """Feature dict ``"{frame}_{mask}" -> (D,)`` for all representative masks.
+
+    ``dataset`` provides ``get_frame_path(frame_id) -> (rgb_path, seg_path)``
+    (duck type, reference dataset/scannet.py:76-80).
+    """
+    pairs = representative_mask_index(object_dict)
+    if not pairs:
+        return {}
+
+    def load_crops(pair):
+        frame_id, mask_id = pair
+        rgb_path, seg_path = dataset.get_frame_path(frame_id)
+        rgb = _imread_rgb(rgb_path)
+        seg = _imread_raw(seg_path)
+        return multiscale_crops(rgb, seg == mask_id)
+
+    out: Dict[str, np.ndarray] = {}
+    with ThreadPoolExecutor(max_workers=io_workers) as pool:
+        for start in range(0, len(pairs), batch_size):
+            chunk = pairs[start:start + batch_size]
+            crops_per_mask = list(pool.map(load_crops, chunk))
+            flat = [c for crops in crops_per_mask for c in crops]
+            feats = encoder.encode_images(flat)
+            pooled = pool_scale_features(feats)
+            for (frame_id, mask_id), feat in zip(chunk, pooled):
+                out[f"{frame_id}_{mask_id}"] = feat
+    return out
+
+
+def save_mask_features(features: Dict[str, np.ndarray], object_dict_dir: str,
+                       config_name: str) -> str:
+    path = os.path.join(object_dict_dir, config_name, "open-vocabulary_features.npy")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    np.save(path, features, allow_pickle=True)
+    return path
+
+
+def extract_label_features(labels: Sequence[str], encoder, save_path: str) -> str:
+    """Text features for a benchmark vocabulary (extract_label_featrues.py:15-26).
+
+    Writes a dict label -> (D,) normalized feature; cached by the orchestrator
+    if the file already exists (reference run.py:52-55).
+    """
+    feats = encoder.encode_texts(list(labels))
+    os.makedirs(os.path.dirname(save_path) or ".", exist_ok=True)
+    np.save(save_path, {label: feats[i] for i, label in enumerate(labels)},
+            allow_pickle=True)
+    return save_path
+
+
+def _imread_rgb(path: str) -> np.ndarray:
+    from maskclustering_tpu.io.image import read_rgb
+
+    return read_rgb(path)
+
+
+def _imread_raw(path: str) -> np.ndarray:
+    from maskclustering_tpu.io.image import read_mask_png
+
+    return read_mask_png(path)

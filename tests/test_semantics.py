@@ -1,0 +1,179 @@
+"""Semantics layer tests: crop geometry, pooling math, open-vocab query.
+
+Pin the OpenMask3D crop policy (reference get_open-voc_features.py:44-99) and
+the query math (open-voc_query.py:30-53) with a deterministic fake encoder —
+no CLIP weights needed (SURVEY.md §4's fake-backend strategy).
+"""
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu.semantics import (
+    HashEncoder,
+    assign_labels,
+    classify_objects,
+    extract_label_features,
+    extract_mask_features,
+    l2_normalize,
+    mask_to_box,
+    multiscale_crops,
+    object_features,
+    pad_to_square,
+    pool_scale_features,
+    representative_mask_index,
+)
+
+
+def test_mask_to_box_levels():
+    mask = np.zeros((100, 200), dtype=bool)
+    mask[40:61, 50:91] = True  # rows 40..60, cols 50..90
+    assert mask_to_box(mask, 0) == (50, 40, 90, 60)
+    # level 1: expand by int(extent * 0.1) per side, extent_x=40, extent_y=20
+    assert mask_to_box(mask, 1) == (46, 38, 94, 62)
+    # level 2 expands twice as far, clamped to the image
+    left, top, right, bottom = mask_to_box(mask, 2)
+    assert (left, top, right, bottom) == (42, 36, 98, 64)
+
+
+def test_mask_to_box_clamps_to_image():
+    mask = np.zeros((20, 20), dtype=bool)
+    mask[0:20, 0:20] = True
+    # tight box is 0..19; expansion int(19*0.1)*2 = 2 clamps to the image
+    assert mask_to_box(mask, 2) == (0, 0, 20, 20)
+
+
+def test_pad_to_square_centers_content():
+    img = np.full((10, 4, 3), 7, dtype=np.uint8)
+    sq = pad_to_square(img)
+    assert sq.shape == (10, 10, 3)
+    assert (sq[:, 3:7] == 7).all()  # content centered
+    assert (sq[:, :3] == 255).all() and (sq[:, 7:] == 255).all()
+
+
+def test_multiscale_crops_shapes_grow():
+    rgb = np.random.default_rng(0).integers(0, 255, (100, 200, 3), dtype=np.uint8)
+    mask = np.zeros((100, 200), dtype=bool)
+    mask[40:61, 50:91] = True
+    crops = multiscale_crops(rgb, mask)
+    assert len(crops) == 3
+    sizes = [c.shape[0] for c in crops]
+    assert sizes == sorted(sizes)  # larger level -> larger (square) crop
+    assert all(c.shape[0] == c.shape[1] and c.shape[2] == 3 for c in crops)
+
+
+def test_multiscale_crops_resizes_lowres_mask():
+    rgb = np.zeros((100, 200, 3), dtype=np.uint8)
+    mask = np.zeros((50, 100), dtype=bool)  # half-resolution segmentation
+    mask[20:31, 25:46] = True
+    crops = multiscale_crops(rgb, mask)
+    assert len(crops) == 3  # scaled up to RGB resolution without error
+
+
+def test_pool_scale_features_means_over_scales():
+    f = np.arange(12, dtype=np.float32).reshape(6, 2)  # 2 masks x 3 scales
+    pooled = pool_scale_features(f, num_scales=3)
+    assert pooled.shape == (2, 2)
+    np.testing.assert_allclose(pooled[0], f[0:3].mean(axis=0))
+    np.testing.assert_allclose(pooled[1], f[3:6].mean(axis=0))
+
+
+def test_classify_objects_picks_nearest_text():
+    rng = np.random.default_rng(1)
+    text = l2_normalize(rng.standard_normal((5, 16)).astype(np.float32))
+    objs = text[[3, 0, 4]] + 0.01 * rng.standard_normal((3, 16)).astype(np.float32)
+    idx = classify_objects(objs, text)
+    assert idx.tolist() == [3, 0, 4]
+
+
+def test_object_features_and_missing_masks():
+    object_dict = {
+        0: {"repre_mask_list": [("f1", 2, 0.9), ("f2", 3, 0.8)], "point_ids": [0, 1]},
+        1: {"repre_mask_list": [], "point_ids": [2]},
+    }
+    mask_features = {"f1_2": np.ones(4, np.float32), "f2_3": 3 * np.ones(4, np.float32)}
+    feats, valid = object_features(object_dict, mask_features, 4)
+    np.testing.assert_allclose(feats[0], 2 * np.ones(4))
+    assert valid.tolist() == [True, False]
+
+
+def test_assign_labels_end_to_end():
+    enc = HashEncoder(feature_dim=32)
+    labels = ["chair", "table"]
+    text = enc.encode_texts(labels)
+    label_features = {l: text[i] for i, l in enumerate(labels)}
+    # object 0's masks carry exactly the "table" text feature
+    mask_features = {"f1_1": text[1], "f2_5": text[1]}
+    object_dict = {
+        7: {"repre_mask_list": [("f1", 1, 0.9), ("f2", 5, 0.7)],
+            "point_ids": np.array([0, 3, 4])},
+    }
+    pred = assign_labels(object_dict, mask_features, label_features,
+                         {"chair": 11, "table": 22}, num_points=6)
+    assert pred["pred_classes"].tolist() == [22]
+    assert pred["pred_masks"].shape == (6, 1)
+    assert pred["pred_masks"][:, 0].tolist() == [True, False, False, True, True, False]
+    assert pred["pred_score"].tolist() == [1.0]
+
+
+def test_assign_labels_featureless_object_keeps_empty_mask():
+    """Objects without representative-mask features must keep an all-False
+    mask column (reference open-voc_query.py:33-35 skips them entirely), so
+    the evaluator drops them instead of seeing a confidence-1.0 prediction."""
+    object_dict = {
+        0: {"repre_mask_list": [], "point_ids": np.array([1, 2])},
+    }
+    pred = assign_labels(object_dict, {}, {"chair": np.ones(4, np.float32)},
+                         {"chair": 11}, num_points=4)
+    assert not pred["pred_masks"].any()
+    assert pred["pred_classes"].tolist() == [0]
+
+
+def test_representative_mask_index_dedupes():
+    object_dict = {
+        0: {"repre_mask_list": [("f1", 1, 0.9), ("f2", 2, 0.8)]},
+        1: {"repre_mask_list": [("f1", 1, 0.5)]},  # shared mask
+    }
+    assert representative_mask_index(object_dict) == [("f1", 1), ("f2", 2)]
+
+
+class _DiskDataset:
+    """Minimal duck-typed dataset over temp rgb/seg PNGs."""
+
+    def __init__(self, root):
+        self.root = root
+
+    def get_frame_path(self, frame_id):
+        return (f"{self.root}/rgb_{frame_id}.png", f"{self.root}/seg_{frame_id}.png")
+
+
+def test_extract_mask_features_from_disk(tmp_path):
+    from PIL import Image
+
+    rng = np.random.default_rng(2)
+    rgb = rng.integers(0, 255, (60, 80, 3), dtype=np.uint8)
+    seg = np.zeros((60, 80), dtype=np.uint8)
+    seg[10:30, 20:50] = 1
+    seg[35:55, 5:25] = 2
+    Image.fromarray(rgb).save(tmp_path / "rgb_000.png")
+    Image.fromarray(seg).save(tmp_path / "seg_000.png")
+
+    object_dict = {
+        0: {"repre_mask_list": [("000", 1, 0.9)], "point_ids": [0]},
+        1: {"repre_mask_list": [("000", 2, 0.9)], "point_ids": [1]},
+    }
+    feats = extract_mask_features(_DiskDataset(tmp_path), object_dict,
+                                  HashEncoder(16), batch_size=2, io_workers=2)
+    assert set(feats) == {"000_1", "000_2"}
+    assert all(v.shape == (16,) for v in feats.values())
+    # deterministic: same inputs, same features
+    feats2 = extract_mask_features(_DiskDataset(tmp_path), object_dict,
+                                   HashEncoder(16), batch_size=1, io_workers=1)
+    np.testing.assert_allclose(feats["000_1"], feats2["000_1"], atol=1e-6)
+
+
+def test_extract_label_features_artifact(tmp_path):
+    path = extract_label_features(["chair", "sofa"], HashEncoder(8),
+                                  str(tmp_path / "text" / "scannet.npy"))
+    d = np.load(path, allow_pickle=True).item()
+    assert set(d) == {"chair", "sofa"}
+    np.testing.assert_allclose(np.linalg.norm(d["chair"]), 1.0, atol=1e-5)
