@@ -19,7 +19,6 @@ from maskclustering_tpu.semantics.vocab import get_vocab
 
 class ScanNetDataset(BaseDataset):
     depth_scale = 1000.0
-    image_size = (640, 480)
     dataset_name = "scannet"
 
     def __init__(self, seq_name: str, data_root: str = "./data") -> None:
@@ -32,6 +31,24 @@ class ScanNetDataset(BaseDataset):
         self.point_cloud_path = os.path.join(self.root, f"{seq_name}_vh_clean_2.ply")
         self.data_root = data_root
         self._intrinsics_cache = None
+        self._image_size = None
+
+    @property
+    def image_size(self):
+        """(width, height) of the depth stream — the alignment target for
+        segmentations (reference hardcodes 640x480, dataset/scannet.py:15;
+        deriving it from the data keeps non-standard resolutions working)."""
+        if self._image_size is None:
+            from PIL import Image
+
+            names = sorted(f for f in os.listdir(self.depth_dir)
+                           if f.split(".")[0].isdigit()) \
+                if os.path.isdir(self.depth_dir) else []
+            if not names:
+                return (640, 480)
+            with Image.open(os.path.join(self.depth_dir, names[0])) as im:
+                self._image_size = im.size  # PIL size is (width, height)
+        return self._image_size
 
     # frame ids are integers 0..last, subsampled by stride; the id space is
     # defined by the numerically-largest color image (reference scannet.py:25-31)

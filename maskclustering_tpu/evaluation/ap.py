@@ -273,15 +273,20 @@ def evaluate_matches(
 def compute_averages(aps: np.ndarray, labels: Sequence[str],
                      overlaps: np.ndarray = DEFAULT_OVERLAPS) -> Dict:
     """AP / AP50 / AP25 summaries (reference evaluate.py:207-224)."""
+    import warnings
+
     o50 = np.isclose(overlaps, 0.5)
     o25 = np.isclose(overlaps, 0.25)
     not25 = ~o25
-    out = {
-        "all_ap": float(np.nanmean(aps[:, not25])),
-        "all_ap_50%": float(np.nanmean(aps[:, o50])),
-        "all_ap_25%": float(np.nanmean(aps[:, o25])),
-        "classes": {},
-    }
+    with warnings.catch_warnings():
+        # all-NaN when no class has GT or predictions; NaN result is correct
+        warnings.simplefilter("ignore", category=RuntimeWarning)
+        out = {
+            "all_ap": float(np.nanmean(aps[:, not25])),
+            "all_ap_50%": float(np.nanmean(aps[:, o50])),
+            "all_ap_25%": float(np.nanmean(aps[:, o25])),
+            "classes": {},
+        }
     for li, label in enumerate(labels):
         out["classes"][label] = {
             "ap": float(np.average(aps[li, not25])),

@@ -1,0 +1,402 @@
+"""Pipeline orchestration (reference run.py, L6).
+
+The reference fans out OS processes per GPU with ``os.system`` and files as
+the only IPC (run.py:8-17,33-50). Here the seven steps run in-process against
+the library API, with:
+
+- **scene work queue**: scenes round-robin-sharded ``seq_names[i::workers]``
+  (same shape as run.py:39) over a spawn Pool when ``workers > 1``; on a
+  single TPU chip the default is in-process sequential — intra-scene mesh
+  sharding is the parallelism axis there (SURVEY.md §2.3).
+- **failure detection**: a failed scene is captured per-scene (status +
+  traceback in the run report) instead of silently producing a missing npz
+  (the reference's only failure signal, SURVEY.md §5).
+- **resume**: artifact-level skip-if-done per step (the reference has this
+  commented out, main.py:13-14); disable with ``resume=False``.
+- **tracing**: optional ``jax.profiler`` trace over the clustering step plus
+  per-step wall timings persisted to ``run_report.json``.
+
+Steps: masks -> cluster -> eval_ca -> features -> label_features -> query -> eval.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+import traceback
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from maskclustering_tpu.config import PipelineConfig, load_config
+from maskclustering_tpu.datasets import get_dataset
+from maskclustering_tpu.semantics.vocab import vocab_name
+
+log = logging.getLogger("maskclustering_tpu")
+
+ALL_STEPS = ("masks", "cluster", "eval_ca", "features", "label_features",
+             "query", "eval")
+
+# dataset -> (gt dir, split file) under data_root (reference run.py:19-31,64-79)
+_DATASET_LAYOUT = {
+    "scannet": ("scannet/gt", "scannet_test.txt"),
+    "scannetpp": ("scannetpp/gt", "scannetpp.txt"),
+    "matterport3d": ("matterport3d/gt", "matterport3d.txt"),
+    "tasmap": ("tasmap/gt", "tasmap.txt"),
+    "demo": ("demo/gt", "demo.txt"),
+}
+
+
+@dataclasses.dataclass
+class SceneStatus:
+    seq_name: str
+    status: str  # "ok" | "skipped" | "failed"
+    seconds: float = 0.0
+    error: str = ""
+    num_objects: int = -1
+
+
+@dataclasses.dataclass
+class RunReport:
+    config_name: str
+    step_seconds: Dict[str, float] = dataclasses.field(default_factory=dict)
+    scenes: List[SceneStatus] = dataclasses.field(default_factory=list)
+
+    @property
+    def failed(self) -> List[SceneStatus]:
+        return [s for s in self.scenes if s.status == "failed"]
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({
+                "config_name": self.config_name,
+                "step_seconds": self.step_seconds,
+                "scenes": [dataclasses.asdict(s) for s in self.scenes],
+            }, f, indent=2)
+
+
+def get_seq_name_list(dataset: str, splits_dir: str = "splits",
+                      seq_name_list: Optional[str] = None) -> List[str]:
+    """Scene list from an explicit +-joined string or the split file."""
+    if seq_name_list:
+        return [s for s in seq_name_list.split("+") if s]
+    _, split_file = _DATASET_LAYOUT[dataset]
+    path = os.path.join(splits_dir, split_file)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no split file {path}; pass seq_name_list explicitly")
+    with open(path) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def make_encoder(spec: str):
+    """Encoder factory: ``hash[:dim]`` | ``hf:<local path>``."""
+    from maskclustering_tpu.semantics import HashEncoder, HFCLIPEncoder
+
+    if spec.startswith("hash"):
+        _, _, dim = spec.partition(":")
+        return HashEncoder(int(dim) if dim else 64)
+    if spec.startswith("hf:"):
+        return HFCLIPEncoder(spec[3:])
+    raise ValueError(f"unknown encoder spec {spec!r} (use hash[:dim] or hf:<path>)")
+
+
+# ---------------------------------------------------------------------------
+# steps
+# ---------------------------------------------------------------------------
+
+
+def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
+                mask_command: Optional[str] = None) -> List[str]:
+    """Step 1: ensure 2D mask id-maps exist for every scene.
+
+    Mask prediction is a frozen external stage (CropFormer; SURVEY.md §2.2) —
+    the contract is a PNG id-map per frame under ``<scene>/output/mask``. When
+    ``mask_command`` is given (template with ``{seq_name}``), it is invoked
+    for scenes with missing masks; otherwise they are reported.
+    """
+    missing = []
+    for seq in seq_names:
+        ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
+        seg_dir = ds.segmentation_dir
+        if not (os.path.isdir(seg_dir) and os.listdir(seg_dir)):
+            missing.append(seq)
+    if missing and mask_command:
+        for seq in missing:
+            cmd = mask_command.format(seq_name=seq)
+            log.info("running mask predictor: %s", cmd)
+            if os.system(cmd) != 0:
+                log.error("mask predictor failed for %s", seq)
+        return check_masks(cfg, missing, mask_command=None)
+    return missing
+
+
+def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
+                  prediction_root: Optional[str] = None) -> SceneStatus:
+    """Step 2 for one scene: tensors -> run_scene -> npz/object_dict export."""
+    from maskclustering_tpu.models.pipeline import run_scene
+
+    prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
+    t0 = time.perf_counter()
+    try:
+        ds = get_dataset(cfg.dataset, seq_name, data_root=cfg.data_root)
+        npz_path = os.path.join(prediction_root, cfg.config_name + "_class_agnostic",
+                                f"{seq_name}.npz")
+        if resume and os.path.exists(npz_path):
+            return SceneStatus(seq_name, "skipped")
+        tensors = ds.load_scene_tensors(cfg.step)
+        result = run_scene(tensors, cfg, seq_name=seq_name, export=True,
+                           object_dict_dir=ds.object_dict_dir,
+                           prediction_root=prediction_root)
+        return SceneStatus(seq_name, "ok", time.perf_counter() - t0,
+                           num_objects=len(result.objects.point_ids_list))
+    except Exception:
+        log.exception("scene %s failed", seq_name)
+        return SceneStatus(seq_name, "failed", time.perf_counter() - t0,
+                           error=traceback.format_exc(limit=20))
+
+
+def _cluster_worker(payload):
+    cfg, seq_names, resume = payload  # PipelineConfig pickles whole
+    if cfg.backend == "cpu":
+        # spawn-children inherit the TPU plugin preload; the env var is too
+        # late by now, so switch platforms through jax.config instead
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return [cluster_scene(cfg, s, resume=resume) for s in seq_names]
+
+
+def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
+                   workers: int = 1, resume: bool = True) -> List[SceneStatus]:
+    """Step 2: the scene work queue.
+
+    ``workers == 1`` runs in-process (the TPU path: one chip, intra-scene
+    sharding). ``workers > 1`` spawns processes with round-robin scene shards
+    — the CPU / multi-host shape, mirroring run.py:33-45 without os.system.
+    """
+    if workers <= 1:
+        return [cluster_scene(cfg, s, resume=resume) for s in seq_names]
+    import multiprocessing as mp
+
+    shards = [list(seq_names[i::workers]) for i in range(workers)]
+    payloads = [(cfg, shard, resume) for shard in shards if shard]
+    ctx = mp.get_context("spawn")  # fork is unsafe once jax owns the TPU
+    with ctx.Pool(len(payloads)) as pool:
+        out = pool.map(_cluster_worker, payloads)
+    statuses = [s for chunk in out for s in chunk]
+    order = {name: i for i, name in enumerate(seq_names)}
+    return sorted(statuses, key=lambda s: order[s.seq_name])
+
+
+def evaluate_step(cfg: PipelineConfig, *, no_class: bool,
+                  prediction_root: Optional[str] = None) -> Optional[dict]:
+    """Steps 3/7: AP evaluation over the prediction directory."""
+    from maskclustering_tpu.evaluation.ap import evaluate_scans
+
+    prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
+    suffix = "_class_agnostic" if no_class else ""
+    pred_dir = os.path.join(prediction_root, cfg.config_name + suffix)
+    gt_rel, _ = _DATASET_LAYOUT[cfg.dataset]
+    gt_dir = os.path.join(cfg.data_root, gt_rel)
+    if not os.path.isdir(pred_dir):
+        log.warning("no predictions at %s; skipping evaluation", pred_dir)
+        return None
+    names = sorted(f for f in os.listdir(pred_dir) if f.endswith(".npz"))
+    pred_files = [os.path.join(pred_dir, n) for n in names]
+    gt_files = [os.path.join(gt_dir, n.replace(".npz", ".txt")) for n in names]
+    missing_gt = [g for g in gt_files if not os.path.isfile(g)]
+    if missing_gt:
+        log.warning("missing GT for %d scenes; skipping evaluation", len(missing_gt))
+        return None
+    out = os.path.join(cfg.data_root, "evaluation", cfg.dataset,
+                       f"{cfg.config_name}{suffix}.txt")
+    return evaluate_scans(pred_files, gt_files, vocab_name(cfg.dataset),
+                          no_class=no_class, output_file=out)
+
+
+def features_step(cfg: PipelineConfig, seq_names: Sequence[str], encoder, *,
+                  resume: bool = True) -> None:
+    """Step 4: per-mask CLIP features for every scene's representative masks."""
+    from maskclustering_tpu.semantics import extract_mask_features, save_mask_features
+
+    for seq in seq_names:
+        ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
+        out_path = os.path.join(ds.object_dict_dir, cfg.config_name,
+                                "open-vocabulary_features.npy")
+        if resume and os.path.exists(out_path):
+            continue
+        od_path = os.path.join(ds.object_dict_dir, cfg.config_name, "object_dict.npy")
+        if not os.path.exists(od_path):
+            log.warning("no object_dict for %s; run the cluster step first", seq)
+            continue
+        object_dict = np.load(od_path, allow_pickle=True).item()
+        feats = extract_mask_features(ds, object_dict, encoder)
+        save_mask_features(feats, ds.object_dict_dir, cfg.config_name)
+
+
+def label_features_step(cfg: PipelineConfig, encoder, *, resume: bool = True) -> str:
+    """Step 5: vocabulary text features, cached on disk (run.py:52-57)."""
+    from maskclustering_tpu.semantics import extract_label_features, get_vocab
+
+    path = os.path.join(cfg.data_root, "text_features",
+                        f"{vocab_name(cfg.dataset)}.npy")
+    if resume and os.path.exists(path):
+        return path
+    labels, _ = get_vocab(cfg.dataset)
+    return extract_label_features(labels, encoder, path)
+
+
+def query_step(cfg: PipelineConfig, seq_names: Sequence[str], *,
+               resume: bool = True, prediction_root: Optional[str] = None) -> None:
+    """Step 6: open-vocab label assignment -> class-aware npz per scene."""
+    from maskclustering_tpu.semantics import run_query
+
+    prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
+    for seq in seq_names:
+        out_path = os.path.join(prediction_root, cfg.config_name, f"{seq}.npz")
+        if resume and os.path.exists(out_path):
+            continue
+        ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
+        needed = [os.path.join(ds.object_dict_dir, cfg.config_name, n)
+                  for n in ("object_dict.npy", "open-vocabulary_features.npy")]
+        missing = [p for p in needed if not os.path.exists(p)]
+        if missing:
+            # a failed upstream scene must not abort the whole queue
+            log.warning("skipping query for %s: missing %s", seq, missing)
+            continue
+        run_query(ds, cfg.config_name, seq, prediction_root=prediction_root)
+
+
+# ---------------------------------------------------------------------------
+# pipeline driver
+# ---------------------------------------------------------------------------
+
+
+def run_pipeline(
+    cfg: PipelineConfig,
+    seq_names: Sequence[str],
+    *,
+    steps: Sequence[str] = ALL_STEPS,
+    workers: int = 1,
+    resume: bool = True,
+    encoder_spec: str = "hash",
+    mask_command: Optional[str] = None,
+    profile_dir: Optional[str] = None,
+    report_path: Optional[str] = None,
+) -> RunReport:
+    unknown = set(steps) - set(ALL_STEPS)
+    if unknown:
+        raise ValueError(f"unknown steps {sorted(unknown)}; valid: {ALL_STEPS}")
+    report = RunReport(config_name=cfg.config_name)
+    encoder = None
+    trace_ctx = None
+    if profile_dir:
+        import jax.profiler
+
+        trace_ctx = jax.profiler.trace(profile_dir)
+
+    def timed(name, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        report.step_seconds[name] = time.perf_counter() - t0
+        log.info("step %s: %.1fs", name, report.step_seconds[name])
+        return out
+
+    if "masks" in steps:
+        missing = timed("masks", lambda: check_masks(cfg, seq_names, mask_command))
+        if missing:
+            log.warning("scenes with no 2D masks (excluded): %s", missing)
+            seq_names = [s for s in seq_names if s not in set(missing)]
+
+    if "cluster" in steps:
+        if trace_ctx is not None:
+            trace_ctx.__enter__()
+        try:
+            report.scenes = timed("cluster", lambda: cluster_scenes(
+                cfg, seq_names, workers=workers, resume=resume))
+        finally:
+            if trace_ctx is not None:
+                trace_ctx.__exit__(None, None, None)
+        ok = sum(1 for s in report.scenes if s.status != "failed")
+        log.info("clustered %d/%d scenes", ok, len(report.scenes))
+
+    if "eval_ca" in steps:
+        timed("eval_ca", lambda: evaluate_step(cfg, no_class=True))
+
+    if {"features", "label_features"} & set(steps):
+        encoder = make_encoder(encoder_spec)
+    if "features" in steps:
+        timed("features", lambda: features_step(cfg, seq_names, encoder,
+                                                resume=resume))
+    if "label_features" in steps:
+        timed("label_features", lambda: label_features_step(cfg, encoder,
+                                                            resume=resume))
+    if "query" in steps:
+        timed("query", lambda: query_step(cfg, seq_names, resume=resume))
+    if "eval" in steps:
+        timed("eval", lambda: evaluate_step(cfg, no_class=False))
+
+    if report_path:
+        report.save(report_path)
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="maskclustering_tpu.run",
+        description="TPU-native mask-clustering pipeline orchestrator")
+    parser.add_argument("--config", required=True, help="config name under configs/")
+    parser.add_argument("--seq_name_list", default=None,
+                        help="+-joined scene names (default: split file)")
+    parser.add_argument("--splits_dir", default="splits")
+    parser.add_argument("--steps", default=",".join(ALL_STEPS),
+                        help=f"comma-separated subset of {ALL_STEPS}")
+    parser.add_argument("--workers", type=int, default=1,
+                        help="scene-queue worker processes (1 = in-process)")
+    parser.add_argument("--no-resume", action="store_true",
+                        help="recompute even when artifacts exist")
+    parser.add_argument("--encoder", default="hash",
+                        help="CLIP encoder spec: hash[:dim] | hf:<local path>")
+    parser.add_argument("--mask_command", default=None,
+                        help="external mask-predictor template with {seq_name}")
+    parser.add_argument("--profile_dir", default=None,
+                        help="write a jax.profiler trace of the cluster step here")
+    parser.add_argument("--report", default=None, help="run report JSON path")
+    parser.add_argument("--data_root", default=None,
+                        help="override the config's data root")
+    parser.add_argument("--debug", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.DEBUG if args.debug else logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    overrides = {"data_root": args.data_root} if args.data_root else {}
+    cfg = load_config(args.config, **overrides)
+    seq_names = get_seq_name_list(cfg.dataset, args.splits_dir, args.seq_name_list)
+    log.info("there are %d scenes", len(seq_names))
+
+    t0 = time.time()
+    report = run_pipeline(
+        cfg, seq_names,
+        steps=tuple(s for s in args.steps.split(",") if s),
+        workers=args.workers,
+        resume=not args.no_resume,
+        encoder_spec=args.encoder,
+        mask_command=args.mask_command,
+        profile_dir=args.profile_dir,
+        report_path=args.report,
+    )
+    total = time.time() - t0
+    log.info("total time %.1f min (%.1f s/scene)", total / 60,
+             total / max(len(seq_names), 1))
+    return 1 if report.failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,10 +18,15 @@ _VOCAB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "vocab_dat
 _ALIASES = {"matterport": "matterport3d", "demo": "scannet"}
 
 
+def vocab_name(dataset: str) -> str:
+    """Canonical vocabulary name for a dataset (demo shares scannet's)."""
+    return _ALIASES.get(dataset, dataset)
+
+
 @functools.lru_cache(maxsize=None)
 def get_vocab(dataset: str) -> Tuple[List[str], List[int]]:
     """Return (labels, ids) for a dataset's benchmark vocabulary."""
-    dataset = _ALIASES.get(dataset, dataset)
+    dataset = vocab_name(dataset)
     path = os.path.join(_VOCAB_DIR, f"{dataset}.json")
     if not os.path.exists(path):
         raise KeyError(f"no vocabulary for dataset {dataset!r}")

@@ -223,3 +223,46 @@ def to_scene_tensors(scene: SyntheticScene):
         frame_valid=scene.frame_valid,
         frame_ids=scene.frame_ids,
     )
+
+
+def write_scannet_layout(scene: SyntheticScene, data_root: str, seq_name: str,
+                         gt_label_id: int = 3) -> str:
+    """Materialize a synthetic scene on disk in the ScanNet processed layout.
+
+    Produces everything the ScanNetDataset loader and the orchestrator need:
+    color/ depth/ pose/ intrinsic/ output/mask/ + the vh_clean_2 ply, plus a
+    benchmark GT txt (label*1000 + inst + 1; unannotated floor = 1) under
+    ``data/scannet/gt``. Used by end-to-end tests in place of real scans.
+    """
+    import os
+
+    from PIL import Image
+
+    from maskclustering_tpu.io.ply import write_ply_points
+
+    root = os.path.join(data_root, "scannet", "processed", seq_name)
+    for sub in ("color", "depth", "pose", "intrinsic", os.path.join("output", "mask")):
+        os.makedirs(os.path.join(root, sub), exist_ok=True)
+    intr4 = np.eye(4)
+    intr4[:3, :3] = scene.intrinsics[0]
+    np.savetxt(os.path.join(root, "intrinsic", "intrinsic_depth.txt"), intr4)
+    for f, fid in enumerate(scene.frame_ids):
+        depth_mm = np.clip(scene.depths[f] * 1000.0, 0, 65535).astype(np.uint16)
+        Image.fromarray(depth_mm).save(os.path.join(root, "depth", f"{fid}.png"))
+        seg = scene.segmentations[f]
+        seg_img = (seg.astype(np.uint16) if seg.max() > 255
+                   else seg.astype(np.uint8))
+        Image.fromarray(seg_img).save(
+            os.path.join(root, "output", "mask", f"{fid}.png"))
+        rgb = np.stack([(seg * 40 % 256).astype(np.uint8)] * 3, axis=-1)
+        Image.fromarray(rgb).save(os.path.join(root, "color", f"{fid}.jpg"))
+        np.savetxt(os.path.join(root, "pose", f"{fid}.txt"),
+                   scene.cam_to_world[f].astype(np.float64))
+    write_ply_points(os.path.join(root, f"{seq_name}_vh_clean_2.ply"),
+                     scene.scene_points)
+    gt_dir = os.path.join(data_root, "scannet", "gt")
+    os.makedirs(gt_dir, exist_ok=True)
+    gt = np.where(scene.gt_instance > 0,
+                  gt_label_id * 1000 + scene.gt_instance + 1, 1)
+    np.savetxt(os.path.join(gt_dir, f"{seq_name}.txt"), gt, fmt="%d")
+    return root
