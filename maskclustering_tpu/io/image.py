@@ -61,6 +61,24 @@ def write_mask_png(path: str, ids: np.ndarray) -> None:
     Image.fromarray(ids).save(path)
 
 
+def write_depth_png(path: str, depth_mm: np.ndarray) -> None:
+    """Write a 16-bit depth PNG (values in millimetres, uint16).
+
+    The reference writes exported depth frames as 16-bit PNGs via pypng
+    (preprocess/scannet/SensorData.py export_depth_images); PIL 'I;16'
+    produces the same on-disk format.
+    """
+    depth_mm = np.asarray(depth_mm)
+    if depth_mm.dtype != np.uint16:
+        depth_mm = np.clip(np.round(depth_mm), 0, 65535).astype(np.uint16)
+    if _HAS_CV2:
+        cv2.imwrite(path, depth_mm)
+    else:
+        # uint16 maps to I;16 via PIL's typemap; the explicit mode= kwarg
+        # is deprecated (removed in Pillow 13)
+        Image.fromarray(depth_mm).save(path)
+
+
 def resize_nearest(img: np.ndarray, size_wh: tuple[int, int]) -> np.ndarray:
     """Nearest-neighbor resize to (width, height), id-preserving.
 

@@ -105,6 +105,99 @@ def read_ply_points(path: str, return_colors: bool = False):
     return verts
 
 
+def read_ply_mesh(path: str):
+    """Read a PLY mesh: vertices, triangle faces, and per-face scalar props.
+
+    Returns ``(verts (N,3) float64, faces (F,3) int64, face_props dict)``.
+    face_props maps scalar property names on the face element (e.g.
+    ``category_id`` in Matterport house_segmentations meshes, reference
+    preprocess/matterport3d/process.py:32-35) to (F,) arrays. Handles
+    binary little/big endian and ascii; assumes uniform triangle faces on
+    the fast path with a ragged fallback.
+    """
+    with open(path, "rb") as f:
+        fmt, elements = _parse_header(f)
+        endian = "<" if fmt in ("binary_little_endian", "ascii") else ">"
+        verts = None
+        faces = None
+        face_props: dict[str, np.ndarray] = {}
+        for name, count, props in elements:
+            if fmt == "ascii":
+                rows = [f.readline().split() for _ in range(count)]
+                if name == "vertex":
+                    names = [p[0] for p in props]
+                    arr = np.array(rows, dtype=np.float64)
+                    ix = [names.index(c) for c in ("x", "y", "z")]
+                    verts = arr[:, ix]
+                elif name == "face" and count:
+                    out_faces, scalars = [], {p[0]: [] for p in props if p[1] is not None}
+                    for row in rows:
+                        pos = 0
+                        for pname, dt, _list_dt in props:
+                            if dt is None:
+                                n = int(row[pos])
+                                out_faces.append([int(v) for v in row[pos + 1:pos + 1 + n]])
+                                pos += 1 + n
+                            else:
+                                scalars[pname].append(float(row[pos]))
+                                pos += 1
+                    # truncate polygons to their first triangle, matching the
+                    # binary paths' (F,3) contract
+                    faces = np.asarray([t[:3] for t in out_faces], dtype=np.int64)
+                    face_props = {k: np.asarray(v) for k, v in scalars.items()}
+                continue
+            has_list = any(p[1] is None for p in props)
+            if not has_list:
+                dtype = np.dtype([(p[0], endian + p[1]) for p in props])
+                data = np.frombuffer(f.read(count * dtype.itemsize), dtype=dtype)
+                if name == "vertex":
+                    verts = np.stack([data["x"], data["y"], data["z"]], axis=1).astype(np.float64)
+                continue
+            if count == 0:
+                continue
+            # face-like element: try the uniform-triangle fast path first
+            start = f.tell()
+            (lname, _, (ct, it)) = next(p for p in props if p[1] is None)
+            n0 = int(np.frombuffer(f.read(np.dtype(ct).itemsize), dtype=endian + ct)[0])
+            f.seek(start)
+            fields = []
+            for pname, dt, list_dt in props:
+                if dt is None:
+                    fields.append(("_n", endian + list_dt[0]))
+                    fields.append(("_idx", endian + list_dt[1], (n0,)))
+                else:
+                    fields.append((pname, endian + dt))
+            dtype = np.dtype(fields)
+            raw = f.read(count * dtype.itemsize)
+            # a ragged element can leave fewer bytes than the uniform guess
+            # (e.g. a leading quad followed by triangles at EOF)
+            uniform = len(raw) == count * dtype.itemsize
+            data = np.frombuffer(raw, dtype=dtype) if uniform else None
+            if not uniform or not np.all(data["_n"] == n0):  # ragged: slow walk
+                f.seek(start)
+                out_faces, scalars = [], {p[0]: [] for p in props if p[1] is not None}
+                for _ in range(count):
+                    for pname, dt, list_dt in props:
+                        if dt is None:
+                            ct_, it_ = list_dt
+                            n = int(np.frombuffer(f.read(np.dtype(ct_).itemsize), dtype=endian + ct_)[0])
+                            out_faces.append(np.frombuffer(f.read(n * np.dtype(it_).itemsize), dtype=endian + it_).astype(np.int64))
+                        else:
+                            scalars[pname].append(np.frombuffer(f.read(np.dtype(dt).itemsize), dtype=endian + dt)[0])
+                if name == "face":
+                    faces = np.asarray([t[:3] for t in out_faces], dtype=np.int64)
+                    face_props = {k: np.asarray(v) for k, v in scalars.items()}
+                continue
+            if name == "face":
+                faces = data["_idx"][:, :3].astype(np.int64)
+                face_props = {p[0]: np.ascontiguousarray(data[p[0]]) for p in props if p[1] is not None}
+    if verts is None:
+        raise ValueError(f"no vertex element found in {path}")
+    if faces is None:
+        faces = np.zeros((0, 3), dtype=np.int64)
+    return verts, faces, face_props
+
+
 def write_ply_points(path: str, points: np.ndarray, colors: np.ndarray | None = None) -> None:
     """Write an (N,3) point cloud as binary-little-endian PLY."""
     points = np.asarray(points, dtype=np.float32)

@@ -1,0 +1,24 @@
+"""L0 preprocessing: raw dataset downloads -> processed scene dirs + GT txt.
+
+Host-side I/O layer (SURVEY.md SS2.2: "host-side Python; unchanged role").
+Mirrors the reference's preprocess/{scannet,scannetpp,matterport3d} and
+tasmap/tasmap2mct_format.py contracts: per-scene dirs with color/ depth/
+pose/ intrinsic/ subdirs, `<scene>_vh_clean_2.ply` clouds, and GT txt files
+encoding `label_id*1000 + instance + 1` per vertex.
+"""
+
+from maskclustering_tpu.preprocess.scannet import (  # noqa: F401
+    SensHeader,
+    iter_sens_frames,
+    export_sens_scene,
+    prepare_scannet_gt,
+    scannet_scene_gt,
+    write_sens,
+)
+from maskclustering_tpu.preprocess.matterport import convert_matterport_gt  # noqa: F401
+from maskclustering_tpu.preprocess.scannetpp import write_toolkit_configs  # noqa: F401
+from maskclustering_tpu.preprocess.tasmap import (  # noqa: F401
+    omni_intrinsics,
+    pose_to_extrinsic,
+    convert_tasmap_scene,
+)

@@ -194,8 +194,13 @@ def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
 
 
 def evaluate_step(cfg: PipelineConfig, *, no_class: bool,
+                  seq_names: Optional[Sequence[str]] = None,
                   prediction_root: Optional[str] = None) -> Optional[dict]:
-    """Steps 3/7: AP evaluation over the prediction directory."""
+    """Steps 3/7: AP evaluation over the run's scenes.
+
+    Restricted to seq_names when given so stale predictions from earlier
+    runs (or scenes dropped from the split) can't block or skew the AP.
+    """
     from maskclustering_tpu.evaluation.ap import evaluate_scans
 
     prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
@@ -207,6 +212,12 @@ def evaluate_step(cfg: PipelineConfig, *, no_class: bool,
         log.warning("no predictions at %s; skipping evaluation", pred_dir)
         return None
     names = sorted(f for f in os.listdir(pred_dir) if f.endswith(".npz"))
+    if seq_names is not None:
+        wanted = set(seq_names)
+        names = [n for n in names if n[:-len(".npz")] in wanted]
+    if not names:
+        log.warning("no predictions for this run's scenes in %s", pred_dir)
+        return None
     pred_files = [os.path.join(pred_dir, n) for n in names]
     gt_files = [os.path.join(gt_dir, n.replace(".npz", ".txt")) for n in names]
     missing_gt = [g for g in gt_files if not os.path.isfile(g)]
@@ -326,7 +337,8 @@ def run_pipeline(
         log.info("clustered %d/%d scenes", ok, len(report.scenes))
 
     if "eval_ca" in steps:
-        timed("eval_ca", lambda: evaluate_step(cfg, no_class=True))
+        timed("eval_ca", lambda: evaluate_step(cfg, no_class=True,
+                                               seq_names=seq_names))
 
     if {"features", "label_features"} & set(steps):
         encoder = make_encoder(encoder_spec)
@@ -339,7 +351,8 @@ def run_pipeline(
     if "query" in steps:
         timed("query", lambda: query_step(cfg, seq_names, resume=resume))
     if "eval" in steps:
-        timed("eval", lambda: evaluate_step(cfg, no_class=False))
+        timed("eval", lambda: evaluate_step(cfg, no_class=False,
+                                            seq_names=seq_names))
 
     if report_path:
         report.save(report_path)

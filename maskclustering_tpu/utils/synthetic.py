@@ -238,6 +238,7 @@ def write_scannet_layout(scene: SyntheticScene, data_root: str, seq_name: str,
 
     from PIL import Image
 
+    from maskclustering_tpu.io.image import write_depth_png, write_mask_png
     from maskclustering_tpu.io.ply import write_ply_points
 
     root = os.path.join(data_root, "scannet", "processed", seq_name)
@@ -247,13 +248,10 @@ def write_scannet_layout(scene: SyntheticScene, data_root: str, seq_name: str,
     intr4[:3, :3] = scene.intrinsics[0]
     np.savetxt(os.path.join(root, "intrinsic", "intrinsic_depth.txt"), intr4)
     for f, fid in enumerate(scene.frame_ids):
-        depth_mm = np.clip(scene.depths[f] * 1000.0, 0, 65535).astype(np.uint16)
-        Image.fromarray(depth_mm).save(os.path.join(root, "depth", f"{fid}.png"))
+        write_depth_png(os.path.join(root, "depth", f"{fid}.png"),
+                        scene.depths[f] * 1000.0)
         seg = scene.segmentations[f]
-        seg_img = (seg.astype(np.uint16) if seg.max() > 255
-                   else seg.astype(np.uint8))
-        Image.fromarray(seg_img).save(
-            os.path.join(root, "output", "mask", f"{fid}.png"))
+        write_mask_png(os.path.join(root, "output", "mask", f"{fid}.png"), seg)
         rgb = np.stack([(seg * 40 % 256).astype(np.uint8)] * 3, axis=-1)
         Image.fromarray(rgb).save(os.path.join(root, "color", f"{fid}.jpg"))
         np.savetxt(os.path.join(root, "pose", f"{fid}.txt"),

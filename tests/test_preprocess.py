@@ -1,0 +1,353 @@
+"""L0 preprocessing tests: .sens round-trip, GT prep, converters.
+
+Synthetic fixtures throughout — no real dataset downloads. Oracle
+behaviors are cited from the reference preprocess/ scripts.
+"""
+
+import io
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu.io.image import read_depth_png, read_mask_png
+from maskclustering_tpu.io.ply import read_ply_mesh, read_ply_points
+from maskclustering_tpu.preprocess import (
+    SensHeader,
+    convert_matterport_gt,
+    convert_tasmap_scene,
+    export_sens_scene,
+    iter_sens_frames,
+    omni_intrinsics,
+    pose_to_extrinsic,
+    prepare_scannet_gt,
+    write_sens,
+    write_toolkit_configs,
+)
+from maskclustering_tpu.preprocess.scannet import SensFrame, load_label_map
+
+
+def _jpeg_bytes(rgb: np.ndarray) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(rgb).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def _make_sens(path, n_frames=6, dw=8, dh=6, cw=16, ch=12):
+    rng = np.random.default_rng(0)
+    intr_d = np.eye(4, dtype=np.float32)
+    intr_d[0, 0], intr_d[1, 1] = 5.0, 5.0
+    intr_d[0, 2], intr_d[1, 2] = dw / 2, dh / 2
+    header = SensHeader(
+        sensor_name="StructureSensor", intrinsic_color=np.eye(4, dtype=np.float32),
+        extrinsic_color=np.eye(4, dtype=np.float32), intrinsic_depth=intr_d,
+        extrinsic_depth=np.eye(4, dtype=np.float32),
+        color_compression="jpeg", depth_compression="zlib_ushort",
+        color_width=cw, color_height=ch, depth_width=dw, depth_height=dh,
+        depth_shift=1000.0, num_frames=n_frames)
+    frames, depths, poses = [], [], []
+    for i in range(n_frames):
+        depth = rng.integers(500, 3000, size=(dh, dw)).astype(np.uint16)
+        pose = np.eye(4, dtype=np.float32)
+        pose[:3, 3] = [i * 0.1, 0.0, 0.0]
+        rgb = rng.integers(0, 255, size=(ch, cw, 3)).astype(np.uint8)
+        frames.append(SensFrame(
+            index=i, camera_to_world=pose, timestamp_color=i, timestamp_depth=i,
+            color_bytes=_jpeg_bytes(rgb),
+            depth_bytes=zlib.compress(depth.tobytes())))
+        depths.append(depth)
+        poses.append(pose)
+    write_sens(path, header, frames)
+    return header, depths, poses
+
+
+class TestSens:
+    def test_roundtrip_stream(self, tmp_path):
+        path = str(tmp_path / "scene.sens")
+        header, depths, poses = _make_sens(path)
+        seen = 0
+        for hdr, frame in iter_sens_frames(path):
+            assert hdr.sensor_name == "StructureSensor"
+            assert hdr.depth_shift == 1000.0
+            np.testing.assert_array_equal(frame.depth(hdr), depths[frame.index])
+            np.testing.assert_allclose(frame.camera_to_world, poses[frame.index])
+            assert frame.color(hdr).shape == (12, 16, 3)
+            seen += 1
+        assert seen == 6
+
+    def test_export_layout_and_stride(self, tmp_path):
+        sens = str(tmp_path / "scene.sens")
+        out = str(tmp_path / "processed")
+        _, depths, poses = _make_sens(sens, n_frames=7)
+        # frame_skip=3 keeps frames 0,3,6 (reference reader.py exports
+        # frame_skip=10 over the full capture)
+        n = export_sens_scene(sens, out, frame_skip=3)
+        assert n == 3
+        assert sorted(os.listdir(os.path.join(out, "depth"))) == [
+            "0.png", "3.png", "6.png"]
+        d3 = read_depth_png(os.path.join(out, "depth", "3.png"), depth_scale=1000.0)
+        np.testing.assert_allclose(d3 * 1000.0, depths[3], atol=0.5)
+        p6 = np.loadtxt(os.path.join(out, "pose", "6.txt"))
+        np.testing.assert_allclose(p6, poses[6], atol=1e-5)
+        intr = np.loadtxt(os.path.join(out, "intrinsic", "intrinsic_depth.txt"))
+        assert intr[0, 0] == pytest.approx(5.0)
+        assert os.path.exists(os.path.join(out, "color", "0.jpg"))
+
+
+class TestScanNetGT:
+    def _write_scene(self, root, scene_id, seg_indices, groups):
+        scene = root / scene_id
+        scene.mkdir(parents=True)
+        with open(scene / f"{scene_id}_vh_clean_2.0.010000.segs.json", "w") as f:
+            json.dump({"segIndices": seg_indices}, f)
+        with open(scene / f"{scene_id}.aggregation.json", "w") as f:
+            json.dump({"segGroups": groups}, f)
+
+    def test_gt_encoding(self, tmp_path):
+        # 6 vertices in segments [0,0,1,1,2,3]; group 0 = chair (id 5, valid),
+        # group 1 = raw category unknown to the tsv -> label 0
+        tsv = tmp_path / "labels.tsv"
+        tsv.write_text("id\traw_category\tcategory\n5\tchair\tchair\n999\tweird\tweird\n")
+        self._write_scene(
+            tmp_path / "scans", "scene0000_00",
+            [0, 0, 1, 1, 2, 3],
+            [{"id": 0, "label": "chair", "segments": [0, 1]},
+             {"id": 1, "label": "nosuch", "segments": [2]}])
+        prepare_scannet_gt(str(tmp_path / "scans"), str(tmp_path / "gt"),
+                           str(tsv), ["scene0000_00"], num_workers=1)
+        gt = np.loadtxt(tmp_path / "gt" / "scene0000_00.txt", dtype=np.int64)
+        # grouped chair verts: 5*1000 + (0+1) + 1 (prepare_gt.py:23-24,70)
+        np.testing.assert_array_equal(gt[:4], [5002] * 4)
+        # group with unknown label -> label 0, instance 2: 0*1000+2+1
+        assert gt[4] == 3
+        # ungrouped vertex: label 0 instance 0 -> 1
+        assert gt[5] == 1
+
+    def test_invalid_label_zeroed(self, tmp_path):
+        # id 999 exists in the tsv but is not a benchmark id -> label 0
+        tsv = tmp_path / "labels.tsv"
+        tsv.write_text("id\traw_category\n999\tweird\n")
+        self._write_scene(tmp_path / "scans", "scene0001_00", [0, 0],
+                          [{"id": 0, "label": "weird", "segments": [0]}])
+        prepare_scannet_gt(str(tmp_path / "scans"), str(tmp_path / "gt"),
+                           str(tsv), ["scene0001_00"], num_workers=1)
+        gt = np.loadtxt(tmp_path / "gt" / "scene0001_00.txt", dtype=np.int64)
+        np.testing.assert_array_equal(gt, [2, 2])  # 0*1000 + 1 + 1
+
+    def test_label_map_parsing(self, tmp_path):
+        tsv = tmp_path / "labels.tsv"
+        tsv.write_text("id\traw_category\n3\ttable\nx\tbroken\n")
+        m = load_label_map(str(tsv))
+        assert m == {"table": 3}
+
+
+def _write_matterport_scene(root, seq, verts, faces, category_ids,
+                            face_segments, instance_groups):
+    """Binary-little-endian mesh ply + fsegs/semseg jsons."""
+    d = root / seq / seq / "house_segmentations"
+    d.mkdir(parents=True)
+    n_v, n_f = len(verts), len(faces)
+    header = (
+        "ply\nformat binary_little_endian 1.0\n"
+        f"element vertex {n_v}\n"
+        "property float x\nproperty float y\nproperty float z\n"
+        f"element face {n_f}\n"
+        "property list uchar int vertex_indices\n"
+        "property int category_id\n"
+        "end_header\n")
+    with open(d / f"{seq}.ply", "wb") as f:
+        f.write(header.encode("ascii"))
+        f.write(np.asarray(verts, dtype="<f4").tobytes())
+        for face, cid in zip(faces, category_ids):
+            f.write(struct.pack("<B3ii", 3, *[int(v) for v in face], int(cid)))
+    with open(d / f"{seq}.fsegs.json", "w") as f:
+        json.dump({"segIndices": face_segments}, f)
+    with open(d / f"{seq}.semseg.json", "w") as f:
+        json.dump({"segGroups": [{"segments": g} for g in instance_groups]}, f)
+
+
+class TestMatterportGT:
+    def test_convert(self, tmp_path):
+        # 6 verts, 2 triangles; face 0 raw cat 1 -> nyu 7 (valid),
+        # face 1 raw cat 2 -> nyu 42 (not valid -> 0)
+        tsv = tmp_path / "category_mapping.tsv"
+        tsv.write_text("index\traw_category\tnyuId\n1\tchair\t7\n2\tblob\t42\n")
+        verts = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0],
+                          [2, 0, 0], [3, 0, 0], [2, 1, 0]], dtype=np.float32)
+        _write_matterport_scene(
+            tmp_path, "houseA", verts,
+            faces=[[0, 1, 2], [3, 4, 5]], category_ids=[1, 2],
+            face_segments=[0, 1], instance_groups=[[0], [1]])
+        gt = convert_matterport_gt(str(tmp_path), "houseA", str(tmp_path / "gt"),
+                                   str(tsv), valid_ids=[7])
+        # verts of face 0: nyu 7, instance 0 -> 7*1000 + 0 + 1
+        np.testing.assert_array_equal(gt[:3], [7001] * 3)
+        # verts of face 1: nyu 42 invalid -> 0, instance 1 -> 2
+        np.testing.assert_array_equal(gt[3:], [2] * 3)
+        on_disk = np.loadtxt(tmp_path / "gt" / "houseA.txt", dtype=np.int64)
+        np.testing.assert_array_equal(on_disk, gt)
+
+    def test_mesh_reader_face_props(self, tmp_path):
+        verts = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0]], dtype=np.float32)
+        _write_matterport_scene(tmp_path, "h", verts, faces=[[0, 1, 2]],
+                                category_ids=[9], face_segments=[0],
+                                instance_groups=[[0]])
+        path = str(tmp_path / "h" / "h" / "house_segmentations" / "h.ply")
+        v, f, props = read_ply_mesh(path)
+        np.testing.assert_allclose(v, verts, atol=1e-6)
+        np.testing.assert_array_equal(f, [[0, 1, 2]])
+        np.testing.assert_array_equal(props["category_id"], [9])
+
+
+class TestScanNetPPConfigs:
+    def test_emission(self, tmp_path):
+        paths = write_toolkit_configs(str(tmp_path), data_root="/data/spp",
+                                      sample_factor=0.25)
+        assert set(paths) == {
+            "download_scannetpp.yml", "prepare_iphone_data.yml", "render.yml",
+            "prepare_training_data.yml", "prepare_semantic_gt.yml"}
+        train = open(paths["prepare_training_data.yml"]).read()
+        assert "sample_factor: 0.25" in train
+        assert "sample_points_on_mesh" in train
+        gt = open(paths["prepare_semantic_gt.yml"]).read()
+        assert "inst_gt_format: true" in gt
+        render = open(paths["render.yml"]).read()
+        assert "near: 0.05" in render and "far: 20.0" in render
+
+
+class TestTasmap:
+    def test_intrinsics_model(self):
+        fx, fy, cx, cy = omni_intrinsics()
+        # fx = W*f/aperture (tasmap2mct_format.py:44-47); square sensor -> fx==fy
+        assert fx == pytest.approx(1024 * 17.0 / 20.954999923706055)
+        assert fx == pytest.approx(fy)
+        assert cx == cy == 512.0
+
+    def test_pose_identity_quat(self):
+        # identity orientation: camera axes are (+x, -y, -z) -> R rows
+        w2c, c2w = pose_to_extrinsic(np.array([1.0, 2.0, 3.0]),
+                                     np.array([0.0, 0.0, 0.0, 1.0]))
+        np.testing.assert_allclose(w2c[:3, :3],
+                                   np.diag([1.0, -1.0, -1.0]), atol=1e-12)
+        np.testing.assert_allclose(w2c @ np.array([1.0, 2.0, 3.0, 1.0]),
+                                   [0, 0, 0, 1], atol=1e-12)
+        np.testing.assert_allclose(c2w @ w2c, np.eye(4), atol=1e-12)
+
+    def test_convert_scene(self, tmp_path):
+        from PIL import Image
+
+        rng = np.random.default_rng(1)
+        extra = tmp_path / "extra_info"
+        for i in range(3):
+            fdir = extra / f"{i:05d}"
+            fdir.mkdir(parents=True)
+            rgb = rng.integers(0, 255, size=(8, 8, 3)).astype(np.uint8)
+            Image.fromarray(rgb).save(fdir / "original_image.png")
+            depth = np.full((8, 8), 2.0, dtype=np.float32)  # 2 m plane
+            np.save(fdir / "depth.npy", depth)
+            np.save(fdir / "pose_ori.npy",
+                    np.array([np.zeros(3), np.array([0, 0, 0, 1.0])],
+                             dtype=object))
+        out = tmp_path / "processed"
+        ply = convert_tasmap_scene(str(extra), str(out), "scene0000_00",
+                                   voxel_size=0.05, buffer_size=2)
+        for sub in ("color", "depth", "pose", "intrinsic", "depth_npy"):
+            assert os.path.isdir(out / sub)
+        d = read_depth_png(str(out / "depth" / "00000.png"))
+        np.testing.assert_allclose(d, 2.0, atol=1e-3)
+        pose = np.loadtxt(out / "pose" / "00001.txt")
+        np.testing.assert_allclose(pose[:3, :3], np.diag([1.0, -1.0, -1.0]),
+                                   atol=1e-6)
+        pts = read_ply_points(ply)
+        assert len(pts) > 0
+        # identity pose at origin, cam frame flipped: all points at world z=-2
+        np.testing.assert_allclose(pts[:, 2], -2.0, atol=0.05)
+
+
+class TestPlyRobustness:
+    def test_binary_ragged_leading_quad_at_eof(self, tmp_path):
+        # first face is a quad, rest triangles, face element last in file:
+        # the uniform fast path over-reads and must fall back to the walk
+        header = (
+            "ply\nformat binary_little_endian 1.0\n"
+            "element vertex 5\n"
+            "property float x\nproperty float y\nproperty float z\n"
+            "element face 2\n"
+            "property list uchar int vertex_indices\n"
+            "end_header\n")
+        path = tmp_path / "ragged.ply"
+        with open(path, "wb") as f:
+            f.write(header.encode("ascii"))
+            f.write(np.zeros((5, 3), dtype="<f4").tobytes())
+            f.write(struct.pack("<B4i", 4, 0, 1, 2, 3))
+            f.write(struct.pack("<B3i", 3, 2, 3, 4))
+        verts, faces, _ = read_ply_mesh(str(path))
+        assert len(verts) == 5
+        np.testing.assert_array_equal(faces, [[0, 1, 2], [2, 3, 4]])
+
+    def test_ascii_quads_truncate_to_triangles(self, tmp_path):
+        path = tmp_path / "quads.ply"
+        path.write_text(
+            "ply\nformat ascii 1.0\n"
+            "element vertex 4\n"
+            "property float x\nproperty float y\nproperty float z\n"
+            "element face 2\n"
+            "property list uchar int vertex_indices\n"
+            "end_header\n"
+            "0 0 0\n1 0 0\n1 1 0\n0 1 0\n"
+            "4 0 1 2 3\n"
+            "3 0 2 3\n")
+        _, faces, _ = read_ply_mesh(str(path))
+        assert faces.shape == (2, 3)
+        np.testing.assert_array_equal(faces[0], [0, 1, 2])
+
+    def test_binary_uniform_quads_truncate(self, tmp_path):
+        header = (
+            "ply\nformat binary_little_endian 1.0\n"
+            "element vertex 4\n"
+            "property float x\nproperty float y\nproperty float z\n"
+            "element face 2\n"
+            "property list uchar int vertex_indices\n"
+            "end_header\n")
+        path = tmp_path / "uq.ply"
+        with open(path, "wb") as f:
+            f.write(header.encode("ascii"))
+            f.write(np.zeros((4, 3), dtype="<f4").tobytes())
+            f.write(struct.pack("<B4i", 4, 0, 1, 2, 3))
+            f.write(struct.pack("<B4i", 4, 3, 2, 1, 0))
+        _, faces, _ = read_ply_mesh(str(path))
+        assert faces.shape == (2, 3)
+        np.testing.assert_array_equal(faces, [[0, 1, 2], [3, 2, 1]])
+
+
+class TestReviewRegressions:
+    def test_matterport_out_of_range_raw_id_is_unknown(self, tmp_path):
+        tsv = tmp_path / "category_mapping.tsv"
+        tsv.write_text("index\traw_category\tnyuId\n1\tchair\t7\n2\tblob\t42\n")
+        verts = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0]], dtype=np.float32)
+        _write_matterport_scene(tmp_path, "h2", verts, faces=[[0, 1, 2]],
+                                category_ids=[5000], face_segments=[0],
+                                instance_groups=[[0]])
+        gt = convert_matterport_gt(str(tmp_path), "h2", str(tmp_path / "gt"),
+                                   str(tsv), valid_ids=[7, 42])
+        np.testing.assert_array_equal(gt, [1, 1, 1])  # unknown, not clipped
+
+    def test_export_zero_frame_sens_writes_intrinsics(self, tmp_path):
+        from maskclustering_tpu.preprocess import SensHeader, write_sens
+        intr = np.eye(4, dtype=np.float32)
+        intr[0, 0] = 7.0
+        hdr = SensHeader("empty", np.eye(4, dtype=np.float32),
+                         np.eye(4, dtype=np.float32), intr,
+                         np.eye(4, dtype=np.float32), "jpeg", "zlib_ushort",
+                         4, 4, 4, 4, 1000.0, 0)
+        sens = str(tmp_path / "empty.sens")
+        write_sens(sens, hdr, [])
+        n = export_sens_scene(sens, str(tmp_path / "out"))
+        assert n == 0
+        got = np.loadtxt(tmp_path / "out" / "intrinsic" / "intrinsic_depth.txt")
+        assert got[0, 0] == pytest.approx(7.0)
