@@ -37,8 +37,13 @@ from maskclustering_tpu.semantics.vocab import vocab_name
 
 log = logging.getLogger("maskclustering_tpu")
 
-ALL_STEPS = ("masks", "cluster", "eval_ca", "features", "label_features",
-             "query", "eval")
+# the full-benchmark pipeline (reference run.py:85-105)
+DEFAULT_STEPS = ("masks", "cluster", "eval_ca", "features", "label_features",
+                 "query", "eval")
+# the tasmap/demo variant: no eval or CLIP, plus visualization
+# (reference tasmap_inference.py:116-138)
+TASMAP_STEPS = ("masks", "cluster", "vis", "top_images")
+ALL_STEPS = DEFAULT_STEPS + ("vis", "top_images")
 
 # dataset -> (gt dir, split file) under data_root (reference run.py:19-31,64-79)
 _DATASET_LAYOUT = {
@@ -111,13 +116,16 @@ def make_encoder(spec: str):
 
 
 def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
-                mask_command: Optional[str] = None) -> List[str]:
+                mask_command: Optional[str] = None,
+                mask_predictor=None) -> List[str]:
     """Step 1: ensure 2D mask id-maps exist for every scene.
 
-    Mask prediction is a frozen external stage (CropFormer; SURVEY.md §2.2) —
-    the contract is a PNG id-map per frame under ``<scene>/output/mask``. When
-    ``mask_command`` is given (template with ``{seq_name}``), it is invoked
-    for scenes with missing masks; otherwise they are reported.
+    Mask prediction is a pluggable external stage (CropFormer in the
+    reference; SURVEY.md §2.2) — the contract is a PNG id-map per frame
+    under ``<scene>/output/mask``. Scenes with missing masks are filled by
+    ``mask_predictor`` (a mask_prediction.MaskPredictor run in-process)
+    or ``mask_command`` (template with ``{seq_name}``, one subprocess per
+    scene, the reference's shape); otherwise they are reported.
     """
     missing = []
     for seq in seq_names:
@@ -125,6 +133,14 @@ def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
         seg_dir = ds.segmentation_dir
         if not (os.path.isdir(seg_dir) and os.listdir(seg_dir)):
             missing.append(seq)
+    if missing and mask_predictor is not None:
+        from maskclustering_tpu.mask_prediction import predict_scene_masks
+
+        for seq in missing:
+            ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
+            log.info("predicting masks for %s", seq)
+            predict_scene_masks(ds, mask_predictor, stride=cfg.step)
+        return check_masks(cfg, missing, mask_command=None)
     if missing and mask_command:
         for seq in missing:
             cmd = mask_command.format(seq_name=seq)
@@ -288,15 +304,58 @@ def query_step(cfg: PipelineConfig, seq_names: Sequence[str], *,
 # ---------------------------------------------------------------------------
 
 
+def vis_step(cfg: PipelineConfig, seq_names: Sequence[str],
+             prediction_root: Optional[str] = None) -> List[str]:
+    """Tasmap-variant step: instance-colored scene artifacts per scene
+    (reference tasmap_inference.py vis steps -> visualize/vis_scene*)."""
+    from maskclustering_tpu.visualize import vis_scene
+
+    prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
+    written = []
+    for seq in seq_names:
+        npz_path = os.path.join(prediction_root, cfg.config_name + "_class_agnostic",
+                                f"{seq}.npz")
+        if not os.path.exists(npz_path):
+            log.warning("no prediction for %s; run the cluster step first", seq)
+            continue
+        ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
+        pred = np.load(npz_path)
+        out_dir = os.path.join(cfg.data_root, "vis", seq)
+        out = vis_scene(ds.get_scene_points(), pred["pred_masks"], out_dir)
+        written.append(out["instances"])
+    return written
+
+
+def top_images_step(cfg: PipelineConfig, seq_names: Sequence[str],
+                    max_objects: Optional[int] = None) -> List[str]:
+    """Tasmap-variant step: per-object bbox grids over representative
+    frames (reference get_top_images.save_debug_image)."""
+    from maskclustering_tpu.visualize import save_debug_grids
+
+    written = []
+    for seq in seq_names:
+        ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
+        od_path = os.path.join(ds.object_dict_dir, cfg.config_name, "object_dict.npy")
+        if not os.path.exists(od_path):
+            log.warning("no object_dict for %s; run the cluster step first", seq)
+            continue
+        object_dict = np.load(od_path, allow_pickle=True).item()
+        out_dir = os.path.join(cfg.data_root, "vis", seq, "top_images")
+        written.extend(save_debug_grids(ds, object_dict, ds.get_scene_points(),
+                                        out_dir, max_objects=max_objects))
+    return written
+
+
 def run_pipeline(
     cfg: PipelineConfig,
     seq_names: Sequence[str],
     *,
-    steps: Sequence[str] = ALL_STEPS,
+    steps: Sequence[str] = DEFAULT_STEPS,
     workers: int = 1,
     resume: bool = True,
     encoder_spec: str = "hash",
     mask_command: Optional[str] = None,
+    mask_predictor=None,
     profile_dir: Optional[str] = None,
     report_path: Optional[str] = None,
 ) -> RunReport:
@@ -319,7 +378,8 @@ def run_pipeline(
         return out
 
     if "masks" in steps:
-        missing = timed("masks", lambda: check_masks(cfg, seq_names, mask_command))
+        missing = timed("masks", lambda: check_masks(
+            cfg, seq_names, mask_command, mask_predictor=mask_predictor))
         if missing:
             log.warning("scenes with no 2D masks (excluded): %s", missing)
             seq_names = [s for s in seq_names if s not in set(missing)]
@@ -353,6 +413,10 @@ def run_pipeline(
     if "eval" in steps:
         timed("eval", lambda: evaluate_step(cfg, no_class=False,
                                             seq_names=seq_names))
+    if "vis" in steps:
+        timed("vis", lambda: vis_step(cfg, seq_names))
+    if "top_images" in steps:
+        timed("top_images", lambda: top_images_step(cfg, seq_names))
 
     if report_path:
         report.save(report_path)
@@ -369,7 +433,7 @@ def main(argv=None) -> int:
     parser.add_argument("--seq_name_list", default=None,
                         help="+-joined scene names (default: split file)")
     parser.add_argument("--splits_dir", default="splits")
-    parser.add_argument("--steps", default=",".join(ALL_STEPS),
+    parser.add_argument("--steps", default=",".join(DEFAULT_STEPS),
                         help=f"comma-separated subset of {ALL_STEPS}")
     parser.add_argument("--workers", type=int, default=1,
                         help="scene-queue worker processes (1 = in-process)")

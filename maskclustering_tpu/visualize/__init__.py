@@ -1,0 +1,24 @@
+"""Visualization & debug-image subsystem (reference visualize/*, get_top_images.py).
+
+Host-side artifact writers plus one genuinely hot op — z-buffered point
+splatting for object re-projection — which runs as a jitted JAX
+scatter-min instead of the reference's per-point Python loop
+(get_top_images.py:137-169).
+"""
+
+from maskclustering_tpu.visualize.scene import (  # noqa: F401
+    instance_palette,
+    vis_scene,
+)
+from maskclustering_tpu.visualize.mask2d import (  # noqa: F401
+    colorize_id_map,
+    create_colormap,
+    vis_mask_frame,
+    frames_to_gif,
+)
+from maskclustering_tpu.visualize.top_images import (  # noqa: F401
+    project_zbuffer,
+    bbox_by_projection,
+    draw_bbox,
+    save_debug_grids,
+)

@@ -1,0 +1,90 @@
+"""2D mask id-map visualization (reference visualize/vis_mask.py) + frame
+sequences to GIF (reference tasmap/vis_masks_to_mp4.py, ffmpeg-free).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from maskclustering_tpu.io.image import resize_nearest
+
+
+def create_colormap(num: int = 65536, seed: int = 1) -> np.ndarray:
+    """(num,3) uint8 colormap; index 0 is black (vis_mask.py create_colormap)."""
+    rng = np.random.default_rng(seed)
+    cmap = rng.integers(0, 256, size=(num, 3)).astype(np.uint8)
+    cmap[0] = 0
+    return cmap
+
+
+def colorize_id_map(seg: np.ndarray, colormap: Optional[np.ndarray] = None) -> np.ndarray:
+    """Vectorised palette lookup: id-map (H,W) -> (H,W,3) uint8."""
+    seg = np.asarray(seg)
+    if colormap is None:
+        colormap = create_colormap(int(seg.max()) + 1)
+    return colormap[np.minimum(seg.astype(np.int64), len(colormap) - 1)]
+
+
+def _draw_label(img: np.ndarray, text: str, center) -> None:
+    """Stamp the mask id at its centroid (vis_mask.py:33-35); cv2 when
+    present, PIL fallback."""
+    try:
+        import cv2
+
+        cv2.putText(img, text, center, cv2.FONT_HERSHEY_SIMPLEX, 1, (0, 0, 0), 2)
+    except Exception:
+        from PIL import Image, ImageDraw
+
+        pil = Image.fromarray(img)
+        ImageDraw.Draw(pil).text(center, text, fill=(0, 0, 0))
+        img[:] = np.asarray(pil)
+
+
+def vis_mask_frame(dataset, frame_id, vis_dir: str,
+                   colormap: Optional[np.ndarray] = None) -> str:
+    """Colorized id-map side by side with the RGB frame, half scale.
+
+    Matches reference vis_mask.py:17-39: per-mask color + id text at the
+    mask centroid, concatenated horizontally with the raw RGB and
+    downscaled 2x. Returns the written path.
+    """
+    seg = dataset.get_segmentation(frame_id, align_with_depth=False)
+    color_seg = colorize_id_map(seg, colormap).copy()
+    for mask_id in np.unique(seg):
+        if mask_id == 0:
+            continue
+        ys, xs = np.nonzero(seg == mask_id)
+        _draw_label(color_seg, str(int(mask_id)),
+                    (int(xs.mean()), int(ys.mean())))
+    rgb = dataset.get_rgb(frame_id)
+    if rgb.shape[:2] != color_seg.shape[:2]:
+        color_seg = resize_nearest(color_seg, (rgb.shape[1], rgb.shape[0]))
+    combined = np.concatenate([rgb, color_seg], axis=1)
+    combined = combined[::2, ::2]  # half scale (vis_mask.py:38)
+    os.makedirs(vis_dir, exist_ok=True)
+    path = os.path.join(vis_dir, f"{frame_id}.png")
+    from PIL import Image
+
+    Image.fromarray(combined).save(path)
+    return path
+
+
+def frames_to_gif(image_paths: Sequence[str], out_path: str,
+                  fps: int = 10) -> str:
+    """Stitch frame PNGs into an animated GIF.
+
+    The reference pipes mask overlays through imageio to mp4/gif
+    (tasmap/vis_masks_to_mp4.py); GIF via PIL needs no codec stack.
+    """
+    from PIL import Image
+
+    frames: List[Image.Image] = [Image.open(p).convert("P") for p in image_paths]
+    if not frames:
+        raise ValueError("no frames to animate")
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    frames[0].save(out_path, save_all=True, append_images=frames[1:],
+                   duration=max(1, int(1000 / fps)), loop=0)
+    return out_path

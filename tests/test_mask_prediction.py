@@ -1,0 +1,225 @@
+"""L2 mask-prediction tests: id-map contract + pluggable predictors.
+
+The oracle is reference mask_predict.py:94-114: keep masks with
+confidence >= 0.5, iterate in ascending score order assigning ids 1..K,
+skip sub-400-pixel masks without consuming an id, later (higher
+confidence) masks overwrite earlier ones.
+"""
+
+import os
+
+import numpy as np
+
+from maskclustering_tpu.io.image import read_mask_png
+from maskclustering_tpu.mask_prediction import (
+    GridSegmenter,
+    _connected_components,
+    predict_scene_masks,
+    rasterize_id_map,
+)
+
+
+def _reference_rasterize(masks, scores, conf=0.5, min_px=400):
+    """Literal re-statement of the reference loop as the test oracle."""
+    keep = scores >= conf
+    masks, scores = masks[keep], scores[keep]
+    h, w = masks.shape[1:]
+    out = np.zeros((h, w), dtype=np.int64)
+    mask_id = 1
+    for index in np.argsort(scores, kind="stable"):
+        if masks[index].sum() < min_px:
+            continue
+        out[masks[index]] = mask_id
+        mask_id += 1
+    return out
+
+
+class TestRasterize:
+    def test_matches_reference_loop_random(self):
+        rng = np.random.default_rng(0)
+        for trial in range(5):
+            h, w = 40, 50
+            k = 8
+            masks = np.zeros((k, h, w), dtype=bool)
+            for i in range(k):
+                y, x = rng.integers(0, h - 25), rng.integers(0, w - 30)
+                masks[i, y:y + rng.integers(8, 25), x:x + rng.integers(10, 30)] = True
+            scores = rng.uniform(0.2, 1.0, size=k).astype(np.float32)
+            got = rasterize_id_map(masks, scores, min_pixels=100)
+            want = _reference_rasterize(masks, scores, min_px=100)
+            np.testing.assert_array_equal(got, want)
+
+    def test_overwrite_order(self):
+        # two overlapping masks: the higher-confidence one wins the overlap
+        masks = np.zeros((2, 30, 30), dtype=bool)
+        masks[0, :20, :20] = True  # low conf
+        masks[1, 10:, 10:] = True  # high conf
+        scores = np.array([0.6, 0.9])
+        out = rasterize_id_map(masks, scores, min_pixels=10)
+        assert out[5, 5] == 1  # only low-conf mask
+        assert out[15, 15] == 2  # overlap -> high conf id
+        assert out[25, 25] == 2
+
+    def test_small_masks_skip_without_consuming_id(self):
+        masks = np.zeros((3, 40, 40), dtype=bool)
+        masks[0, :20, :20] = True  # 400 px, kept (id from order)
+        masks[1, 0, :5] = True  # 5 px, skipped
+        masks[2, 20:, 20:] = True  # 400 px, kept
+        scores = np.array([0.7, 0.8, 0.9])
+        out = rasterize_id_map(masks, scores)
+        # skipped mask consumes no id: ids are 1 (mask0) and 2 (mask2)
+        assert set(np.unique(out)) == {0, 1, 2}
+        assert out[0, 0] == 1 and out[30, 30] == 2
+
+    def test_confidence_filter_and_empty(self):
+        masks = np.ones((1, 30, 30), dtype=bool)
+        out = rasterize_id_map(masks, np.array([0.3]))
+        assert out.dtype == np.uint8 and out.max() == 0
+        out2 = rasterize_id_map(np.zeros((0, 8, 8), dtype=bool), np.zeros(0))
+        assert out2.shape == (8, 8) and out2.max() == 0
+
+    def test_uint16_when_many_masks(self):
+        k, h, w = 300, 40, 600
+        masks = np.zeros((k, h, w), dtype=bool)
+        for i in range(k):
+            masks[i, :, 2 * i:2 * i + 2] = True  # 80 px each
+        scores = np.linspace(0.5, 1.0, k)
+        out = rasterize_id_map(masks, scores, min_pixels=50)
+        assert out.dtype == np.uint16
+        assert out.max() == k
+
+
+class TestConnectedComponents:
+    def test_two_regions(self):
+        key = np.array([[1, 1, 2], [1, 2, 2]])
+        labels = _connected_components(key)
+        assert labels[0, 0] == labels[0, 1] == labels[1, 0]
+        assert labels[0, 2] == labels[1, 1] == labels[1, 2]
+        assert labels[0, 0] != labels[0, 2]
+
+    def test_diagonal_not_connected(self):
+        key = np.array([[1, 2], [2, 1]])
+        labels = _connected_components(key)
+        assert labels[0, 0] != labels[1, 1]  # 4-connectivity only
+
+
+class _FakeDataset:
+    """Duck-typed dataset exposing just what predict_scene_masks uses."""
+
+    def __init__(self, root, frames, rgbs):
+        self.segmentation_dir = os.path.join(root, "output", "mask")
+        self._frames = frames
+        self._rgbs = rgbs
+
+    def get_frame_list(self, stride):
+        return self._frames[::stride]
+
+    def get_rgb(self, frame_id):
+        return self._rgbs[self._frames.index(frame_id)]
+
+
+class TestPredictSceneMasks:
+    def _rgb_two_blocks(self):
+        rgb = np.zeros((40, 60, 3), dtype=np.uint8)
+        rgb[:, :30] = [200, 30, 30]
+        rgb[:, 30:] = [30, 200, 30]
+        return rgb
+
+    def test_grid_segmenter_end_to_end(self, tmp_path):
+        rgb = self._rgb_two_blocks()
+        ds = _FakeDataset(str(tmp_path), [0, 1, 2], [rgb, rgb, rgb])
+        written = predict_scene_masks(ds, GridSegmenter(), stride=2)
+        assert len(written) == 2  # frames 0 and 2
+        seg = read_mask_png(os.path.join(ds.segmentation_dir, "0.png"))
+        assert seg.shape == (40, 60)
+        # the two color blocks become two distinct non-zero ids
+        left, right = seg[20, 10], seg[20, 50]
+        assert left != 0 and right != 0 and left != right
+
+    def test_resume_skips_existing(self, tmp_path):
+        rgb = self._rgb_two_blocks()
+        ds = _FakeDataset(str(tmp_path), [0], [rgb])
+        first = predict_scene_masks(ds, GridSegmenter())
+        second = predict_scene_masks(ds, GridSegmenter())
+        assert len(first) == 1 and len(second) == 0
+
+    def test_pipeline_masks_step_uses_predictor(self, tmp_path):
+        import jax
+
+        from maskclustering_tpu.config import load_config
+        from maskclustering_tpu.run import check_masks
+        from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
+        import shutil
+
+        scene = make_scene(num_boxes=2, num_frames=4, image_hw=(48, 64), seed=3)
+        root = str(tmp_path / "data")
+        write_scannet_layout(scene, root, "scene0000_00")
+        # remove the oracle masks so the step must regenerate them
+        seg_dir = os.path.join(root, "scannet", "processed", "scene0000_00",
+                               "output", "mask")
+        shutil.rmtree(seg_dir)
+        cfg = load_config("scannet").replace(data_root=root, step=1)
+        missing = check_masks(cfg, ["scene0000_00"],
+                              mask_predictor=GridSegmenter())
+        assert missing == []
+        assert len(os.listdir(seg_dir)) == 4
+
+
+class TestReviewRegressions:
+    def test_cc_snake_region(self):
+        # serpentine region exercises multi-sweep convergence
+        key = np.zeros((8, 8), dtype=np.int64)
+        key[0, :] = 1
+        key[1:, -1] = 1
+        key[-1, :] = 1
+        labels = _connected_components(key)
+        snake = labels[key == 1]
+        assert len(np.unique(snake)) == 1
+        assert labels[0, 0] != labels[4, 0]
+
+    def test_cc_fast_on_large_frame(self):
+        import time
+
+        rng = np.random.default_rng(0)
+        key = rng.integers(0, 4, size=(480, 640))
+        t0 = time.perf_counter()
+        labels = _connected_components(key)
+        assert time.perf_counter() - t0 < 10.0
+        assert labels.shape == key.shape
+
+    def test_quant_hash_no_collision(self):
+        rgb = np.zeros((30, 40, 3), dtype=np.uint8)
+        rgb[:, :20] = [0, 1, 0]
+        rgb[:, 20:] = [0, 0, 200]
+        masks, _ = GridSegmenter(quant=1, min_region=50)(rgb)
+        assert len(masks) == 2  # distinct colors stay distinct
+
+    def test_writes_dataset_contract_paths(self, tmp_path):
+        class ContractDS:
+            segmentation_dir = str(tmp_path / "seg")
+
+            def get_frame_list(self, stride):
+                return [5]
+
+            def get_frame_path(self, fid):
+                return (str(tmp_path / "rgb" / f"frame_{fid:06d}.jpg"),
+                        str(tmp_path / "seg" / f"frame_{fid:06d}.png"))
+
+            def get_rgb(self, fid):
+                rgb = np.zeros((40, 60, 3), dtype=np.uint8)
+                rgb[:, :30] = [200, 30, 30]
+                rgb[:, 30:] = [30, 200, 30]
+                return rgb
+
+        written = predict_scene_masks(ContractDS(), GridSegmenter())
+        assert written == [str(tmp_path / "seg" / "frame_000005.png")]
+        assert os.path.exists(written[0])
+
+    def test_draw_bbox_at_origin_keeps_all_edges(self):
+        from maskclustering_tpu.visualize import draw_bbox
+
+        rgb = np.zeros((50, 50, 3), dtype=np.uint8)
+        out = draw_bbox(rgb, (0, 0, 10, 10), thickness=4)
+        assert tuple(out[10, 5]) == (255, 0, 0)  # bottom edge drawn
+        assert tuple(out[5, 10]) == (255, 0, 0)  # right edge drawn
+        assert tuple(out[49, 5]) == (0, 0, 0)  # no wraparound

@@ -13,7 +13,7 @@ import pytest
 
 from maskclustering_tpu.config import load_config
 from maskclustering_tpu.run import (
-    ALL_STEPS,
+    DEFAULT_STEPS,
     check_masks,
     cluster_scene,
     get_seq_name_list,
@@ -40,12 +40,12 @@ def _cfg(data_root):
 def test_full_pipeline(scene_root):
     cfg = _cfg(scene_root)
     report = run_pipeline(
-        cfg, ["scene0001_00"], steps=ALL_STEPS, resume=True,
+        cfg, ["scene0001_00"], steps=DEFAULT_STEPS, resume=True,
         encoder_spec="hash:16",
         report_path=os.path.join(scene_root, "report.json"))
     assert [s.status for s in report.scenes] == ["ok"]
     assert report.scenes[0].num_objects == 3
-    assert set(report.step_seconds) == set(ALL_STEPS)
+    assert set(report.step_seconds) == set(DEFAULT_STEPS)
 
     pred_dir = os.path.join(scene_root, "prediction")
     ca = np.load(os.path.join(pred_dir, "testrun_class_agnostic", "scene0001_00.npz"))
@@ -108,3 +108,44 @@ def test_make_encoder_specs():
 def test_unknown_step_rejected(scene_root):
     with pytest.raises(ValueError):
         run_pipeline(_cfg(scene_root), [], steps=("clutser",))
+
+
+class TestTasmapVariantSteps:
+    def test_vis_and_top_images_steps(self, tmp_path):
+        """TASMAP_STEPS variant: cluster -> vis -> top_images end to end."""
+        import os
+
+        from maskclustering_tpu.config import load_config
+        from maskclustering_tpu.run import TASMAP_STEPS, run_pipeline
+        from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
+
+        scene = make_scene(num_boxes=2, num_frames=8, image_hw=(48, 64), seed=11)
+        root = str(tmp_path / "data")
+        write_scannet_layout(scene, root, "scene0003_00")
+        cfg = load_config("scannet").replace(
+            data_root=root, config_name="tvar", step=1,
+            distance_threshold=0.03, mask_pad_multiple=64)
+        report = run_pipeline(cfg, ["scene0003_00"], steps=TASMAP_STEPS)
+        assert set(report.step_seconds) == set(TASMAP_STEPS)
+        vis_dir = os.path.join(root, "vis", "scene0003_00")
+        assert os.path.exists(os.path.join(vis_dir, "instances.ply"))
+        grids = os.listdir(os.path.join(vis_dir, "top_images", "grid"))
+        assert len(grids) >= 1
+
+    def test_clean_output(self, tmp_path):
+        import os
+
+        from maskclustering_tpu.config import load_config
+        from maskclustering_tpu.utils.clean_output import clean_scene_outputs
+        from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
+
+        scene = make_scene(num_boxes=1, num_frames=4, image_hw=(32, 40), seed=5)
+        root = str(tmp_path / "data")
+        write_scannet_layout(scene, root, "scene0004_00")
+        cfg = load_config("scannet").replace(data_root=root)
+        out_dir = os.path.join(root, "scannet", "processed", "scene0004_00", "output")
+        assert os.path.isdir(out_dir)
+        listed = clean_scene_outputs(cfg, ["scene0004_00"], dry_run=True)
+        assert listed == [out_dir] and os.path.isdir(out_dir)
+        removed = clean_scene_outputs(cfg, ["scene0004_00"], dry_run=False)
+        assert removed == [out_dir] and not os.path.exists(out_dir)

@@ -1,0 +1,156 @@
+"""Visualization subsystem tests: scene PLYs, mask colorization, z-buffer
+projection (vs brute-force oracle), bbox drawing, debug grids."""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from maskclustering_tpu.io.ply import read_ply_points
+from maskclustering_tpu.visualize import (
+    bbox_by_projection,
+    colorize_id_map,
+    create_colormap,
+    draw_bbox,
+    frames_to_gif,
+    instance_palette,
+    project_zbuffer,
+    save_debug_grids,
+    vis_mask_frame,
+    vis_scene,
+)
+from maskclustering_tpu.visualize.top_images import stitch_grid
+
+
+class TestVisScene:
+    def test_writes_instance_and_rgb_plys(self, tmp_path):
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(200, 3))
+        masks = np.zeros((200, 2), dtype=bool)
+        masks[:50, 0] = True
+        masks[50:120, 1] = True
+        out = vis_scene(pts, masks, str(tmp_path), scene_colors=rng.uniform(size=(200, 3)))
+        inst, colors = read_ply_points(out["instances"], return_colors=True)
+        assert len(inst) == 120  # only labeled points
+        assert len(np.unique(colors, axis=0)) == 2
+        rgb_pts = read_ply_points(out["rgb"])
+        assert len(rgb_pts) == 200
+
+    def test_palette_deterministic(self):
+        np.testing.assert_array_equal(instance_palette(7), instance_palette(7))
+
+
+class TestMask2D:
+    def test_colorize(self):
+        seg = np.array([[0, 1], [2, 1]], dtype=np.uint8)
+        cmap = create_colormap(16)
+        out = colorize_id_map(seg, cmap)
+        np.testing.assert_array_equal(out[0, 0], [0, 0, 0])
+        np.testing.assert_array_equal(out[0, 1], cmap[1])
+        np.testing.assert_array_equal(out[1, 0], cmap[2])
+
+    def test_vis_mask_frame_and_gif(self, tmp_path):
+        class FakeDS:
+            def get_segmentation(self, fid, align_with_depth=True):
+                seg = np.zeros((40, 60), dtype=np.uint8)
+                seg[5:25, 5:30] = 1
+                return seg
+
+            def get_rgb(self, fid):
+                return np.full((40, 60, 3), 128, dtype=np.uint8)
+
+        ds = FakeDS()
+        paths = [vis_mask_frame(ds, fid, str(tmp_path / "vis")) for fid in (0, 1)]
+        from PIL import Image
+
+        im = np.asarray(Image.open(paths[0]))
+        assert im.shape == (20, 60, 3)  # concat x2 width, half scale
+        gif = frames_to_gif(paths, str(tmp_path / "anim.gif"), fps=5)
+        assert os.path.exists(gif)
+
+
+class TestProjectZbuffer:
+    def _cam(self):
+        intr = np.array([[50.0, 0, 32], [0, 50.0, 24], [0, 0, 1]])
+        return intr, np.eye(4)
+
+    def test_matches_bruteforce_oracle(self):
+        rng = np.random.default_rng(3)
+        pts = np.stack([rng.uniform(-0.5, 0.5, 300), rng.uniform(-0.4, 0.4, 300),
+                        rng.uniform(1.0, 3.0, 300)], axis=1)
+        cols = rng.uniform(size=(300, 3))
+        intr, c2w = self._cam()
+        h, w = 48, 64
+        img, zbuf, visible = project_zbuffer(
+            jnp.asarray(pts, jnp.float32), jnp.asarray(cols, jnp.float32),
+            jnp.asarray(intr, jnp.float32), jnp.asarray(c2w, jnp.float32), h, w)
+        # brute-force oracle (the reference's serial loop semantics)
+        zb = np.full((h, w), np.inf)
+        for p in pts:
+            u = int(round(50 * p[0] / p[2] + 32))
+            v = int(round(50 * p[1] / p[2] + 24))
+            if 0 <= u < w and 0 <= v < h and p[2] < zb[v, u]:
+                zb[v, u] = p[2]
+        np.testing.assert_allclose(np.asarray(zbuf), zb, rtol=1e-5)
+        # every visible point attains its pixel's min depth
+        vis_np = np.asarray(visible)
+        assert vis_np.any()
+        img_np = np.asarray(img)
+        assert img_np[np.isfinite(zb)].sum() > 0
+
+    def test_behind_camera_invisible(self):
+        intr, c2w = self._cam()
+        pts = np.array([[0, 0, -1.0], [0, 0, 2.0]])
+        img, zbuf, visible = project_zbuffer(
+            jnp.asarray(pts, jnp.float32), jnp.ones((2, 3), jnp.float32),
+            jnp.asarray(intr, jnp.float32), jnp.asarray(c2w, jnp.float32), 48, 64)
+        assert not bool(visible[0]) and bool(visible[1])
+
+    def test_bbox_by_projection(self):
+        intr, c2w = self._cam()
+        pts = np.array([[0.0, 0.0, 2.0], [0.2, 0.1, 2.0]])
+        bbox = bbox_by_projection(pts, intr, c2w, (48, 64))
+        x0, y0, x1, y1 = bbox
+        assert (x0, y0) == (32, 24)  # center pixel
+        # 50*0.2/2+32 = 37; 50*0.1/2+24 = 26.5 -> 26 (round-half-even, same
+        # as the reference's Python round())
+        assert x1 == 37 and y1 == 26
+        assert bbox_by_projection(np.array([[0, 0, -5.0]]), intr, c2w, (48, 64)) is None
+
+
+class TestGrids:
+    def test_draw_bbox(self):
+        rgb = np.zeros((30, 30, 3), dtype=np.uint8)
+        out = draw_bbox(rgb, (5, 5, 20, 20), thickness=2)
+        assert tuple(out[5, 10]) == (255, 0, 0)
+        assert tuple(out[10, 10]) == (0, 0, 0)
+        np.testing.assert_array_equal(draw_bbox(rgb, None), rgb)
+
+    def test_stitch_grid_shapes(self):
+        imgs = [np.full((10, 10, 3), i * 30, dtype=np.uint8) for i in range(5)]
+        grid = stitch_grid(imgs, cell=64)
+        assert grid.shape == (128, 192, 3)  # 2 rows x 3 cols
+        single = stitch_grid(imgs[:1], cell=64)
+        assert single.shape == (64, 64, 3)
+
+    def test_save_debug_grids(self, tmp_path):
+        class FakeDS:
+            def get_rgb(self, fid):
+                return np.full((48, 64, 3), 90, dtype=np.uint8)
+
+            def get_intrinsics(self, fid):
+                return np.array([[50.0, 0, 32], [0, 50.0, 24], [0, 0, 1]])
+
+            def get_extrinsic(self, fid):
+                return np.eye(4)
+
+        scene_points = np.array([[0, 0, 2.0], [0.1, 0.1, 2.0], [5, 5, -1.0]])
+        object_dict = {0: {
+            "point_ids": np.array([0, 1]),
+            "mask_list": [(0, 1, 0.9)],
+            "repre_mask_list": [(0, 1, 0.9), (1, 2, 0.8)],
+        }}
+        grids = save_debug_grids(FakeDS(), object_dict, scene_points, str(tmp_path))
+        assert len(grids) == 1 and os.path.exists(grids[0])
+        bboxes = os.listdir(tmp_path / "bbox")
+        assert len(bboxes) == 2
