@@ -212,6 +212,7 @@ class TorchCropFormerPredictor:
         try:
             from detectron2.config import get_cfg  # type: ignore
             from detectron2.projects.deeplab import add_deeplab_config  # type: ignore
+            from demo_cropformer.predictor import VisualizationDemo  # type: ignore
         except ImportError as e:  # pragma: no cover - gated dependency
             raise ImportError(
                 "TorchCropFormerPredictor needs detectron2 + CropFormer "
@@ -222,8 +223,6 @@ class TorchCropFormerPredictor:
         cfg.merge_from_file(config_file)
         cfg.merge_from_list(list(opts) + ["MODEL.WEIGHTS", checkpoint_path])
         cfg.freeze()
-        from demo_cropformer.predictor import VisualizationDemo  # type: ignore
-
         self._demo = VisualizationDemo(cfg)
 
     def __call__(self, rgb: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

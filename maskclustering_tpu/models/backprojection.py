@@ -205,7 +205,7 @@ def associate_frame(
     )
 
 
-def associate_scene(
+def _associate_scene_impl(
     scene_points: jnp.ndarray,  # (N, 3) float32
     depths: jnp.ndarray,  # (F, H, W)
     segs: jnp.ndarray,  # (F, H, W) int32
@@ -220,7 +220,7 @@ def associate_scene(
     few_points_threshold: int = 25,
     coverage_threshold: float = 0.3,
 ) -> SceneAssociation:
-    """Run projective association over all frames with lax.map.
+    """Projective association over all frames with lax.map (trace-time body).
 
     lax.map (not vmap) keeps per-frame intermediates (N x window gathers) at
     one frame's footprint; frames are still processed back-to-back inside a
@@ -251,6 +251,38 @@ def associate_scene(
         boundary=boundary,
         mask_valid=mask_valid,
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
+                         few_points_threshold, coverage_threshold):
+    """One cached top-level jit per static config.
+
+    Calling lax.map eagerly re-traces AND re-compiles the whole frame scan
+    on every invocation (~48 s at ScanNet scale, measured) because the
+    eager dispatch cache misses on the fresh closure; routing through one
+    persistent jit makes the first scene pay compilation and every later
+    scene (and repeat run) reuse it — steady-state association is
+    milliseconds, not a minute.
+    """
+    return jax.jit(functools.partial(
+        _associate_scene_impl, k_max=k_max, window=window,
+        distance_threshold=distance_threshold, depth_trunc=depth_trunc,
+        few_points_threshold=few_points_threshold,
+        coverage_threshold=coverage_threshold))
+
+
+def associate_scene(
+    scene_points, depths, segs, intrinsics, cam_to_world, frame_valid, *,
+    k_max: int = 127, window: int = 1, distance_threshold: float = 0.01,
+    depth_trunc: float = 20.0, few_points_threshold: int = 25,
+    coverage_threshold: float = 0.3,
+) -> SceneAssociation:
+    """Run projective association over all frames (jit-cached)."""
+    fn = _associate_scene_jit(k_max, window, float(distance_threshold),
+                              float(depth_trunc), few_points_threshold,
+                              float(coverage_threshold))
+    return fn(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid)
 
 
 def associate_scene_tensors(tensors, cfg, k_max: int = 127) -> SceneAssociation:

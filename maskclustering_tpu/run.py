@@ -140,7 +140,9 @@ def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
             ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
             log.info("predicting masks for %s", seq)
             predict_scene_masks(ds, mask_predictor, stride=cfg.step)
-        return check_masks(cfg, missing, mask_command=None)
+        # keep mask_command as the fallback for scenes the predictor
+        # could not fill (e.g. empty frame lists)
+        return check_masks(cfg, missing, mask_command=mask_command)
     if missing and mask_command:
         for seq in missing:
             cmd = mask_command.format(seq_name=seq)
@@ -304,8 +306,20 @@ def query_step(cfg: PipelineConfig, seq_names: Sequence[str], *,
 # ---------------------------------------------------------------------------
 
 
+def _scene_points_cached(cfg: PipelineConfig, seq: str,
+                         cache: Optional[Dict[str, np.ndarray]]):
+    """Load a scene's cloud once per run when vis steps share a cache."""
+    if cache is not None and seq in cache:
+        return cache[seq]
+    pts = get_dataset(cfg.dataset, seq, data_root=cfg.data_root).get_scene_points()
+    if cache is not None:
+        cache[seq] = pts
+    return pts
+
+
 def vis_step(cfg: PipelineConfig, seq_names: Sequence[str],
-             prediction_root: Optional[str] = None) -> List[str]:
+             prediction_root: Optional[str] = None, *, resume: bool = True,
+             scene_points_cache: Optional[Dict[str, np.ndarray]] = None) -> List[str]:
     """Tasmap-variant step: instance-colored scene artifacts per scene
     (reference tasmap_inference.py vis steps -> visualize/vis_scene*)."""
     from maskclustering_tpu.visualize import vis_scene
@@ -318,16 +332,21 @@ def vis_step(cfg: PipelineConfig, seq_names: Sequence[str],
         if not os.path.exists(npz_path):
             log.warning("no prediction for %s; run the cluster step first", seq)
             continue
-        ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
-        pred = np.load(npz_path)
         out_dir = os.path.join(cfg.data_root, "vis", seq)
-        out = vis_scene(ds.get_scene_points(), pred["pred_masks"], out_dir)
+        inst_path = os.path.join(out_dir, "instances.ply")
+        if resume and os.path.exists(inst_path):
+            continue
+        pred = np.load(npz_path)
+        out = vis_scene(_scene_points_cached(cfg, seq, scene_points_cache),
+                        pred["pred_masks"], out_dir)
         written.append(out["instances"])
     return written
 
 
 def top_images_step(cfg: PipelineConfig, seq_names: Sequence[str],
-                    max_objects: Optional[int] = None) -> List[str]:
+                    max_objects: Optional[int] = None, *, resume: bool = True,
+                    scene_points_cache: Optional[Dict[str, np.ndarray]] = None
+                    ) -> List[str]:
     """Tasmap-variant step: per-object bbox grids over representative
     frames (reference get_top_images.save_debug_image)."""
     from maskclustering_tpu.visualize import save_debug_grids
@@ -339,10 +358,14 @@ def top_images_step(cfg: PipelineConfig, seq_names: Sequence[str],
         if not os.path.exists(od_path):
             log.warning("no object_dict for %s; run the cluster step first", seq)
             continue
-        object_dict = np.load(od_path, allow_pickle=True).item()
         out_dir = os.path.join(cfg.data_root, "vis", seq, "top_images")
-        written.extend(save_debug_grids(ds, object_dict, ds.get_scene_points(),
-                                        out_dir, max_objects=max_objects))
+        if resume and os.path.isdir(os.path.join(out_dir, "grid")) \
+                and os.listdir(os.path.join(out_dir, "grid")):
+            continue
+        object_dict = np.load(od_path, allow_pickle=True).item()
+        written.extend(save_debug_grids(
+            ds, object_dict, _scene_points_cached(cfg, seq, scene_points_cache),
+            out_dir, max_objects=max_objects))
     return written
 
 
@@ -413,10 +436,14 @@ def run_pipeline(
     if "eval" in steps:
         timed("eval", lambda: evaluate_step(cfg, no_class=False,
                                             seq_names=seq_names))
-    if "vis" in steps:
-        timed("vis", lambda: vis_step(cfg, seq_names))
-    if "top_images" in steps:
-        timed("top_images", lambda: top_images_step(cfg, seq_names))
+    if {"vis", "top_images"} & set(steps):
+        pts_cache: Dict[str, np.ndarray] = {}
+        if "vis" in steps:
+            timed("vis", lambda: vis_step(cfg, seq_names, resume=resume,
+                                          scene_points_cache=pts_cache))
+        if "top_images" in steps:
+            timed("top_images", lambda: top_images_step(
+                cfg, seq_names, resume=resume, scene_points_cache=pts_cache))
 
     if report_path:
         report.save(report_path)
