@@ -1,0 +1,237 @@
+"""Exact-parity mask backprojection: the reference's ball-query pipeline.
+
+The default association path (models/backprojection.py) inverts the search
+direction for TPU efficiency. This module instead reproduces the
+reference's per-mask pipeline step by step (utils/mask_backprojection.py:
+70-151) for parity validation and A/B studies, selected with
+``PipelineConfig.use_exact_ball_query``:
+
+per frame: depth -> view cloud; per mask: pixel backprojections ->
+voxel downsample (r = distance_threshold) -> DBSCAN denoise keeping
+components >= 20% + statistical outlier removal (geometry.py:9-24) ->
+strict bbox crop of the scene cloud (mask_backprojection.py:48-67) ->
+batched ball query K=20 r=distance_threshold over padded masks
+(mask_backprojection.py:123-128) -> coverage >= 0.3 test (143-145); then
+the frame's masks are written into the point-in-mask matrix in ascending
+mask-id order with shared points zeroed as boundary
+(construction.py:46-62).
+
+The ball query runs on-device (the Pallas TPU kernel when available, the
+jnp fallback otherwise); the per-mask preprocessing is host numpy like the
+reference's Open3D calls — this is the fidelity path, not the fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from maskclustering_tpu.models.backprojection import SceneAssociation
+from maskclustering_tpu.ops.dbscan import dbscan_labels
+from maskclustering_tpu.ops.geometry import voxel_downsample_np
+
+
+def statistical_outlier_mask(points: np.ndarray, nb_neighbors: int = 20,
+                             std_ratio: float = 2.0) -> np.ndarray:
+    """Keep-mask of Open3D remove_statistical_outlier semantics.
+
+    Per point: mean distance to its nb_neighbors nearest neighbors; keep
+    points whose mean distance <= global_mean + std_ratio * global_std.
+    Brute force O(P^2) — inputs are per-mask clouds of at most a few
+    thousand points after voxel downsampling.
+    """
+    p = len(points)
+    if p <= 1:
+        return np.ones(p, dtype=bool)
+    nb = min(nb_neighbors, p - 1)
+    d2 = np.sum((points[:, None, :] - points[None, :, :]) ** 2, axis=-1)
+    np.fill_diagonal(d2, np.inf)
+    nearest = np.sort(d2, axis=1)[:, :nb]
+    mean_dist = np.sqrt(np.maximum(nearest, 0.0)).mean(axis=1)
+    mu, sigma = mean_dist.mean(), mean_dist.std()
+    return mean_dist <= mu + std_ratio * sigma
+
+
+def denoise_mask_points(points: np.ndarray, eps: float = 0.04,
+                        min_points: int = 4) -> np.ndarray:
+    """Reference utils/geometry.py denoise: DBSCAN components >= 20% of the
+    cloud survive, then statistical outlier removal. Returns kept indices."""
+    if len(points) == 0:
+        return np.zeros(0, dtype=np.int64)
+    labels = dbscan_labels(points, eps=eps, min_points=min_points) + 1
+    counts = np.bincount(labels)
+    keep = counts[labels] >= 0.2 * len(labels)
+    remain = np.nonzero(keep)[0]
+    if len(remain) == 0:
+        return remain
+    inlier = statistical_outlier_mask(points[remain])
+    return remain[inlier]
+
+
+def _frame_view_points(depth: np.ndarray, intrinsics: np.ndarray,
+                       cam_to_world: np.ndarray, depth_trunc: float):
+    """Valid-depth pixel backprojections in world frame + flat valid mask."""
+    h, w = depth.shape
+    fx, fy = intrinsics[0, 0], intrinsics[1, 1]
+    cx, cy = intrinsics[0, 2], intrinsics[1, 2]
+    v, u = np.mgrid[0:h, 0:w]
+    valid = (depth > 0) & (depth <= depth_trunc)
+    z = depth[valid].astype(np.float64)
+    pts = np.stack([(u[valid] - cx) / fx * z, (v[valid] - cy) / fy * z, z], axis=1)
+    pts = pts @ cam_to_world[:3, :3].T + cam_to_world[:3, 3]
+    return pts, valid.reshape(-1)
+
+
+def _ball_query_batched(mask_points_list, cropped_list, k, radius):
+    """Pad ragged per-mask arrays and run one device ball query per frame."""
+    from maskclustering_tpu.ops.neighbor import ball_query
+
+    # bucket ALL pad sizes (incl. batch) to powers of two so the device
+    # kernels compile O(log^3) distinct shapes across a whole scene, not
+    # one per frame's mask count
+    b = 1 << max(3, int(np.ceil(np.log2(max(len(mask_points_list), 1)))))
+    p_max = max(len(m) for m in mask_points_list)
+    s_max = max(max(len(c) for c in cropped_list), 1)
+    p_pad = 1 << max(6, int(np.ceil(np.log2(max(p_max, 1)))))
+    s_pad = 1 << max(8, int(np.ceil(np.log2(s_max))))
+    q = np.zeros((b, p_pad, 3), dtype=np.float32)
+    c = np.zeros((b, s_pad, 3), dtype=np.float32)
+    ql = np.zeros(b, dtype=np.int32)
+    cl = np.zeros(b, dtype=np.int32)
+    for i, (mp, cp) in enumerate(zip(mask_points_list, cropped_list)):
+        q[i, :len(mp)] = mp
+        c[i, :len(cp)] = cp
+        ql[i], cl[i] = len(mp), len(cp)
+    try:  # Pallas TPU kernel when the backend supports it
+        import jax
+
+        if jax.default_backend() == "tpu":
+            from maskclustering_tpu.ops.pallas.ball_query import ball_query_pallas
+
+            return np.asarray(ball_query_pallas(
+                jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
+                k=k, radius=radius))
+    except Exception:  # pragma: no cover - fall through to the jnp path
+        pass
+    return np.asarray(ball_query(
+        jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
+        k=k, radius=radius))
+
+
+def frame_backprojection_exact(
+    scene_points: np.ndarray,  # (N, 3)
+    depth: np.ndarray,  # (H, W) metres
+    seg: np.ndarray,  # (H, W) int
+    intrinsics: np.ndarray,
+    cam_to_world: np.ndarray,
+    *,
+    distance_threshold: float = 0.01,
+    depth_trunc: float = 20.0,
+    few_points_threshold: int = 25,
+    coverage_threshold: float = 0.3,
+    k_neighbors: int = 20,
+) -> Dict[int, np.ndarray]:
+    """One frame's mask -> scene-point-id sets, reference semantics.
+
+    Returns {mask_id: sorted unique scene point ids} for masks that pass
+    the few-points and coverage filters (mask_backprojection.py:70-151).
+    """
+    if not np.all(np.isfinite(cam_to_world)):
+        return {}
+    view_points, depth_ok = _frame_view_points(depth, intrinsics, cam_to_world,
+                                               depth_trunc)
+    seg_flat = seg.reshape(-1)
+    candidates: List[Tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
+    for mask_id in np.unique(seg_flat):
+        if mask_id == 0:
+            continue
+        mask_points = view_points[seg_flat[depth_ok] == mask_id]
+        if len(mask_points) < few_points_threshold:
+            continue
+        mask_points = voxel_downsample_np(mask_points, distance_threshold)
+        kept = denoise_mask_points(mask_points)
+        mask_points = mask_points[kept]
+        if len(mask_points) < few_points_threshold:
+            continue
+        lo, hi = mask_points.min(axis=0), mask_points.max(axis=0)
+        sel = np.nonzero(np.all((scene_points > lo) & (scene_points < hi), axis=1))[0]
+        candidates.append((int(mask_id), mask_points, scene_points[sel], sel))
+    if not candidates:
+        return {}
+
+    neighbors = _ball_query_batched([c[1] for c in candidates],
+                                    [c[2] for c in candidates],
+                                    k_neighbors, distance_threshold)
+    mask_info: Dict[int, np.ndarray] = {}
+    for i, (mask_id, mp, _, sel) in enumerate(candidates):
+        nb = neighbors[i, :len(mp)]
+        valid_nb = nb >= 0
+        coverage = np.any(valid_nb, axis=1).mean() if len(mp) else 0.0
+        if coverage < coverage_threshold:
+            continue
+        local = np.unique(nb[valid_nb])
+        mask_info[mask_id] = np.sort(sel[local])
+    return mask_info
+
+
+def associate_scene_exact(tensors, cfg, k_max: int = 127) -> SceneAssociation:
+    """Exact-parity SceneAssociation over all frames (host loop).
+
+    Produces the same tensor bundle the dense path emits so the graph,
+    clustering, and postprocess stages run unchanged: ascending-id
+    overwrite order, shared-point zeroing into boundary, and first/last
+    claim ids per point (construction.py:46-62).
+    """
+    scene_points = np.asarray(tensors.scene_points, dtype=np.float64)
+    f = len(tensors.frame_ids)
+    n = len(scene_points)
+    mop = np.zeros((f, n), dtype=np.int32)
+    first = np.zeros((f, n), dtype=np.int32)
+    last = np.zeros((f, n), dtype=np.int32)
+    point_visible = np.zeros((f, n), dtype=bool)
+    mask_valid = np.zeros((f, k_max + 1), dtype=bool)
+    boundary = np.zeros(n, dtype=bool)
+
+    for fi in range(f):
+        if not tensors.frame_valid[fi]:
+            continue
+        mask_info = frame_backprojection_exact(
+            scene_points,
+            np.asarray(tensors.depths[fi]),
+            np.asarray(tensors.segmentations[fi]),
+            np.asarray(tensors.intrinsics[fi]),
+            np.asarray(tensors.cam_to_world[fi]),
+            distance_threshold=cfg.distance_threshold,
+            depth_trunc=cfg.depth_trunc,
+            few_points_threshold=cfg.few_points_threshold,
+            coverage_threshold=cfg.coverage_threshold,
+        )
+        if not mask_info:
+            continue
+        frame_boundary = np.zeros(n, dtype=bool)
+        appeared = np.zeros(n, dtype=bool)
+        for mask_id in sorted(mask_info):
+            if mask_id > k_max:
+                continue
+            pts = mask_info[mask_id]
+            frame_boundary[pts] |= appeared[pts]
+            mop[fi, pts] = mask_id
+            first[fi, pts] = np.where(first[fi, pts] > 0,
+                                      np.minimum(first[fi, pts], mask_id), mask_id)
+            last[fi, pts] = np.maximum(last[fi, pts], mask_id)
+            appeared[pts] = True
+            point_visible[fi, pts] = True
+            mask_valid[fi, mask_id] = True
+        mop[fi, frame_boundary] = 0
+        boundary |= frame_boundary
+
+    return SceneAssociation(
+        mask_of_point=jnp.asarray(mop),
+        first_id=jnp.asarray(first),
+        last_id=jnp.asarray(last),
+        point_visible=jnp.asarray(point_visible),
+        boundary=jnp.asarray(boundary),
+        mask_valid=jnp.asarray(mask_valid),
+    )

@@ -44,14 +44,15 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: int = 127,
               object_dict_dir: Optional[str] = None,
               prediction_root: str = "data/prediction") -> SceneResult:
     """Cluster one scene. Returns objects + artifacts (optionally written)."""
-    if cfg.use_exact_ball_query:
-        raise NotImplementedError(
-            "exact ball-query association is not wired into run_scene yet; "
-            "ops/neighbor.py provides the kernel")
     timings: Dict[str, float] = {}
     t0 = time.perf_counter()
 
-    assoc = associate_scene_tensors(tensors, cfg, k_max=k_max)
+    if cfg.use_exact_ball_query:
+        from maskclustering_tpu.models.exact_backprojection import associate_scene_exact
+
+        assoc = associate_scene_exact(tensors, cfg, k_max=k_max)
+    else:
+        assoc = associate_scene_tensors(tensors, cfg, k_max=k_max)
     mask_valid_host = np.asarray(assoc.mask_valid)
     timings["associate"] = time.perf_counter() - t0
 

@@ -1,0 +1,180 @@
+"""Exact-parity association tests: Pallas/jnp ball query agreement, the
+reference's denoise + outlier-removal semantics, and end-to-end parity of
+the exact path against the dense projective path."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from maskclustering_tpu.config import PipelineConfig
+from maskclustering_tpu.models.exact_backprojection import (
+    associate_scene_exact,
+    denoise_mask_points,
+    frame_backprojection_exact,
+    statistical_outlier_mask,
+)
+from maskclustering_tpu.ops.neighbor import ball_query_brute
+from maskclustering_tpu.ops.pallas.ball_query import ball_query_pallas
+
+
+class TestPallasBallQuery:
+    """Interpret mode on the CPU test backend; the real Mosaic lowering is
+    exercised by the TPU drive (same kernel body)."""
+
+    def test_matches_oracle_ragged(self):
+        rng = np.random.default_rng(1)
+        b, p, s, k = 4, 70, 260, 6
+        q = rng.uniform(-1, 1, (b, p, 3)).astype(np.float32)
+        c = rng.uniform(-1, 1, (b, s, 3)).astype(np.float32)
+        ql = np.array([70, 33, 0, 64], np.int32)
+        cl = np.array([260, 100, 50, 1], np.int32)
+        out = np.asarray(ball_query_pallas(
+            jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
+            k=k, radius=0.4, interpret=True))
+        ref = ball_query_brute(q, c, ql, cl, k, 0.4)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_first_k_scan_order_not_nearest(self):
+        # candidate 0 is farther than candidate 1 but still within radius:
+        # pytorch3d keeps FIRST K by index, so slot 0 must be candidate 0
+        q = np.zeros((1, 1, 3), np.float32)
+        c = np.array([[[0.3, 0, 0], [0.1, 0, 0], [0.2, 0, 0]]], np.float32)
+        out = np.asarray(ball_query_pallas(
+            jnp.asarray(q), jnp.asarray(c), jnp.asarray([1], dtype=jnp.int32),
+            jnp.asarray([3], dtype=jnp.int32), k=2, radius=0.5, interpret=True))
+        np.testing.assert_array_equal(out[0, 0], [0, 1])
+
+    def test_batch_chunking(self):
+        # b > batch_chunk exercises the lax.map grouping
+        rng = np.random.default_rng(2)
+        b, p, s = 10, 16, 40
+        q = rng.uniform(-1, 1, (b, p, 3)).astype(np.float32)
+        c = rng.uniform(-1, 1, (b, s, 3)).astype(np.float32)
+        ql = np.full(b, p, np.int32)
+        cl = np.full(b, s, np.int32)
+        out = np.asarray(ball_query_pallas(
+            jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
+            k=4, radius=0.5, batch_chunk=4, interpret=True))
+        ref = ball_query_brute(q, c, ql, cl, 4, 0.5)
+        np.testing.assert_array_equal(out, ref)
+
+
+class TestDenoise:
+    def test_statistical_outlier(self):
+        rng = np.random.default_rng(0)
+        cluster = rng.normal(scale=0.01, size=(100, 3))
+        outlier = np.array([[5.0, 5.0, 5.0]])
+        pts = np.concatenate([cluster, outlier])
+        keep = statistical_outlier_mask(pts, nb_neighbors=20, std_ratio=2.0)
+        assert not keep[-1]
+        assert keep[:-1].mean() > 0.9
+
+    def test_small_component_dropped(self):
+        # 100-point main blob + 10-point far blob (10% < 20% cutoff,
+        # reference utils/geometry.py:14-16)
+        rng = np.random.default_rng(1)
+        main = rng.normal(scale=0.01, size=(100, 3))
+        minor = rng.normal(scale=0.01, size=(10, 3)) + 10.0
+        kept = denoise_mask_points(np.concatenate([main, minor]))
+        assert np.all(kept < 100)
+        assert len(kept) > 80
+
+
+def _plane_scene(n_side=40, z=2.0):
+    """A flat square of scene points seen head-on by an identity camera.
+
+    Depth carries +-2mm deterministic jitter: a perfectly flat plane makes
+    the reference's STRICT bbox crop (scene > min & < max,
+    mask_backprojection.py:59-67) degenerate in z — faithful behavior, so
+    the fixture avoids it the way real sensor noise does.
+    """
+    xs = np.linspace(-0.5, 0.5, n_side)
+    gx, gy = np.meshgrid(xs, xs)
+    pts = np.stack([gx.ravel(), gy.ravel(), np.full(n_side * n_side, z)], axis=1)
+    h = w = 64
+    intr = np.array([[60.0, 0, 32], [0, 60.0, 32], [0, 0, 1]])
+    jitter = 0.002 * np.sin(np.arange(h * w)).reshape(h, w).astype(np.float32)
+    depth = np.full((h, w), z, dtype=np.float32) + jitter
+    # the plane spans pixels ~17..47 (x = (u-32)/60*z in [-0.5, 0.5]);
+    # both masks must sit inside it or the coverage filter rejects them
+    seg = np.zeros((h, w), dtype=np.int32)
+    seg[18:31, 18:31] = 1
+    seg[34:46, 34:46] = 2
+    return pts, depth, seg, intr
+
+
+class TestFrameExact:
+    def test_two_masks_claim_disjoint_regions(self):
+        pts, depth, seg, intr = _plane_scene()
+        info = frame_backprojection_exact(
+            pts, depth, seg, intr, np.eye(4),
+            distance_threshold=0.05, few_points_threshold=10)
+        assert set(info) == {1, 2}
+        assert len(np.intersect1d(info[1], info[2])) == 0
+        # mask 1 covers the upper-left of the plane -> points with x,y < 0
+        sel = pts[info[1]]
+        assert sel[:, 0].max() < 0.1 and sel[:, 1].max() < 0.1
+
+    def test_invalid_extrinsics_skip(self):
+        pts, depth, seg, intr = _plane_scene()
+        bad = np.full((4, 4), np.inf)
+        assert frame_backprojection_exact(pts, depth, seg, intr, bad) == {}
+
+    def test_absent_object_rejected_by_coverage(self):
+        # mask 2's pixels see depth at z=1 where NO scene points exist
+        pts, depth, seg, intr = _plane_scene()
+        depth = depth.copy()
+        depth[34:46, 34:46] = 1.0
+        info = frame_backprojection_exact(
+            pts, depth, seg, intr, np.eye(4),
+            distance_threshold=0.05, few_points_threshold=10)
+        assert 1 in info and 2 not in info
+
+
+class TestExactPipelineParity:
+    def test_matches_dense_path_end_to_end(self):
+        from maskclustering_tpu.models.pipeline import run_scene
+        from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+        scene = make_scene(num_boxes=3, num_frames=10, image_hw=(60, 80), seed=4)
+        tensors = to_scene_tensors(scene)
+        base = PipelineConfig(config_name="parity", dataset="demo",
+                              distance_threshold=0.03, few_points_threshold=10,
+                              mask_pad_multiple=64)
+        dense = run_scene(tensors, base, k_max=31, export=False)
+        exact = run_scene(tensors, base.replace(use_exact_ball_query=True),
+                          k_max=31, export=False)
+        assert len(dense.objects.point_ids_list) == 3
+        # The exact path may fragment a sparse box (DBSCAN split eps 0.1 on
+        # the sparser ball-query claims), so parity is judged on purity and
+        # coverage, not object count: every exact object belongs to one GT
+        # instance, and every GT instance is recovered.
+        gt = scene.gt_instance
+        assert 3 <= len(exact.objects.point_ids_list) <= 5
+        covered = set()
+        for pids in exact.objects.point_ids_list:
+            vals, counts = np.unique(gt[pids], return_counts=True)
+            top = vals[np.argmax(counts)]
+            assert top != 0, "an exact-path object is mostly background"
+            assert counts.max() / counts.sum() > 0.9, "impure exact object"
+            covered.add(int(top))
+        assert covered == {1, 2, 3}
+
+    def test_association_tensor_shapes(self):
+        from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+        scene = make_scene(num_boxes=2, num_frames=4, image_hw=(48, 64), seed=6)
+        tensors = to_scene_tensors(scene)
+        cfg = PipelineConfig(config_name="p", dataset="demo",
+                             distance_threshold=0.03, few_points_threshold=10)
+        assoc = associate_scene_exact(tensors, cfg, k_max=31)
+        f = len(tensors.frame_ids)
+        n = len(tensors.scene_points)
+        assert assoc.mask_of_point.shape == (f, n)
+        assert assoc.mask_valid.shape == (f, 32)
+        # boundary points are zeroed in the id matrix
+        mop = np.asarray(assoc.mask_of_point)
+        first = np.asarray(assoc.first_id)
+        last = np.asarray(assoc.last_id)
+        shared = (first != last) & (last > 0)
+        assert not np.any(mop[shared])
