@@ -7,15 +7,84 @@ equivalent, ~2k masks). The reference's published cost for this exact stage
 is 6.5 GPU-h for 311 ScanNet-val scenes on an RTX 3090 ~= 75 s/scene
 (reference README.md:205); vs_baseline = 75 / measured_s_per_scene.
 
-Prints exactly ONE JSON line on stdout.
+Prints exactly ONE JSON line on stdout — even on failure or partial runs
+(value = median of whatever repeats completed, or null with an "error" key).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import threading
 import time
+
+BASELINE_S_PER_SCENE = 75.0  # reference: 6.5 GPU-h / 311 ScanNet-val scenes
+
+
+def _metric_name(args) -> str:
+    return (f"mask-clustering s/scene (synthetic scene: {args.frames}fr x "
+            f"{args.points // 1024}k pts x {args.boxes} objects)")
+
+
+def _emit(args, times, error=None):
+    import numpy as np
+
+    if times:
+        s_per_scene = float(np.median(times))
+        line = {
+            "metric": _metric_name(args),
+            "value": round(s_per_scene, 3),
+            "unit": "s/scene",
+            "vs_baseline": round(BASELINE_S_PER_SCENE / s_per_scene, 2),
+        }
+    else:
+        line = {"metric": _metric_name(args), "value": None, "unit": "s/scene",
+                "vs_baseline": None}
+    if error is not None:
+        line["error"] = str(error)[:300]
+        if times:
+            line["partial"] = True
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+def _init_backend(args):
+    """Initialize the JAX backend, failing fast and loudly.
+
+    A wedged TPU client hangs inside backend init with no exception (seen
+    when another process holds the chip), so a watchdog turns a silent
+    multi-minute stall into a one-line diagnosis + the mandatory JSON line.
+    """
+    def _watchdog():
+        print(f"[bench] FATAL: backend init did not finish within "
+              f"{args.init_timeout}s (chip busy or TPU runtime wedged)",
+              file=sys.stderr, flush=True)
+        _emit(args, [], error=f"backend init timed out after {args.init_timeout}s")
+        os._exit(3)
+
+    timer = threading.Timer(args.init_timeout, _watchdog)
+    timer.daemon = True
+    timer.start()
+    try:
+        import jax
+
+        if args.platform:
+            # jax.config (not the env var): the TPU plugin is preloaded in
+            # this image, so JAX_PLATFORMS from the environment is too late
+            jax.config.update("jax_platforms", args.platform)
+        devices = jax.devices()
+    except Exception as e:  # noqa: BLE001 — one-line diagnosis beats a 30-frame traceback
+        timer.cancel()
+        print(f"[bench] FATAL: jax backend init failed: {type(e).__name__}: "
+              f"{str(e).splitlines()[0] if str(e) else e}", file=sys.stderr, flush=True)
+        _emit(args, [], error=f"backend init failed: {e}")
+        sys.exit(2)
+    timer.cancel()
+    print(f"[bench] backend up: {len(devices)}x {devices[0].device_kind}",
+          file=sys.stderr, flush=True)
+    return devices
 
 
 def main():
@@ -27,9 +96,13 @@ def main():
     p.add_argument("--image-w", type=int, default=320)
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--k-max", type=int, default=63)
+    p.add_argument("--init-timeout", type=float, default=120.0)
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu) before backend init")
     args = p.parse_args()
 
-    import jax
+    _init_backend(args)
+
     import numpy as np
 
     from maskclustering_tpu.config import PipelineConfig
@@ -38,7 +111,7 @@ def main():
 
     print(f"[bench] generating synthetic scene: F={args.frames} "
           f"N={args.points} boxes={args.boxes} {args.image_h}x{args.image_w}",
-          file=sys.stderr)
+          file=sys.stderr, flush=True)
     t0 = time.time()
     scene = make_scene(num_boxes=args.boxes, num_frames=args.frames,
                        image_hw=(args.image_h, args.image_w), spacing=0.02, seed=0)
@@ -51,37 +124,35 @@ def main():
     else:
         pts = pts[np.random.default_rng(0).choice(pts.shape[0], n, replace=False)]
     tensors.scene_points = np.ascontiguousarray(pts, dtype=np.float32)
-    print(f"[bench] scene ready in {time.time()-t0:.1f}s "
-          f"({len(jax.devices())}x {jax.devices()[0].device_kind})", file=sys.stderr)
+    print(f"[bench] scene ready in {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
 
     cfg = PipelineConfig(config_name="bench", dataset="demo",
                          distance_threshold=0.03, few_points_threshold=25,
                          point_chunk=8192)
 
-    # warm-up (compile)
-    t0 = time.time()
-    run_scene(tensors, cfg, k_max=args.k_max)
-    print(f"[bench] warm-up (incl. compile): {time.time()-t0:.1f}s", file=sys.stderr)
-
     times = []
-    for i in range(args.repeats):
+    try:
+        # warm-up (compile)
         t0 = time.time()
-        result = run_scene(tensors, cfg, k_max=args.k_max)
-        times.append(time.time() - t0)
-        print(f"[bench] run {i}: {times[-1]:.2f}s "
-              f"({len(result.objects.point_ids_list)} objects, "
-              f"timings {['%s=%.2f' % kv for kv in result.timings.items()]})",
-              file=sys.stderr)
+        run_scene(tensors, cfg, k_max=args.k_max)
+        print(f"[bench] warm-up (incl. compile): {time.time()-t0:.1f}s",
+              file=sys.stderr, flush=True)
 
-    s_per_scene = float(np.median(times))
-    baseline = 75.0  # reference: 6.5 GPU-h / 311 ScanNet-val scenes (README.md:205)
-    print(json.dumps({
-        "metric": f"mask-clustering s/scene (synthetic scene: {args.frames}fr x "
-                  f"{args.points // 1024}k pts x {args.boxes} objects)",
-        "value": round(s_per_scene, 3),
-        "unit": "s/scene",
-        "vs_baseline": round(baseline / s_per_scene, 2),
-    }))
+        for i in range(args.repeats):
+            t0 = time.time()
+            result = run_scene(tensors, cfg, k_max=args.k_max)
+            times.append(time.time() - t0)
+            print(f"[bench] run {i}: {times[-1]:.2f}s "
+                  f"({len(result.objects.point_ids_list)} objects, "
+                  f"timings {['%s=%.2f' % kv for kv in result.timings.items()]})",
+                  file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        print(f"[bench] ERROR after {len(times)} completed runs: {e}",
+              file=sys.stderr, flush=True)
+        _emit(args, times, error=e)
+        sys.exit(1)
+
+    _emit(args, times)
 
 
 if __name__ == "__main__":

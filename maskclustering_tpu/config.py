@@ -44,7 +44,8 @@ class PipelineConfig:
     distance_threshold: float = 0.01  # metres; ball radius / depth-agreement tol
     few_points_threshold: int = 25
     depth_trunc: float = 20.0
-    bbox_expand: float = 0.1
+    # (the reference's BBOX_EXPAND constant is defined but never used,
+    # mask_backprojection.py:14 — intentionally not carried over)
 
     # --- post-processing (reference utils/post_process.py) ---
     dbscan_split_eps: float = 0.1
@@ -61,13 +62,14 @@ class PipelineConfig:
     association_window: int = 1  # half-width of the pixel window in projective association
     point_chunk: int = 8192  # point-chunk size for the affinity matmul
     mask_pad_multiple: int = 256  # pad N_masks to a multiple of this (bucketed recompiles)
-    frame_pad_multiple: int = 32  # pad N_frames likewise
+    frame_pad_multiple: int = 32  # pad N_frames likewise (mesh batch path)
     max_cluster_iterations: int = 20  # schedule length (95..0 step -5 = 20 entries)
-    # parity mode: pytorch3d-style ball-query association (ops/neighbor.py).
-    # Not yet wired into run_scene (raises NotImplementedError if set).
+    # parity mode: run the reference's ball-query association
+    # (models/exact_backprojection.py) instead of projective association
     use_exact_ball_query: bool = False
-    mesh_shape: Tuple[int, ...] = ()  # e.g. (8,) — empty = single device
-    mesh_axis_names: Tuple[str, ...] = ("frames",)
+    # (scene, frame) device-mesh factorization for the fused multi-chip path
+    # (parallel/batch.py); empty = single-device host pipeline
+    mesh_shape: Tuple[int, ...] = ()
 
     # --- paths ---
     data_root: str = "./data"
@@ -85,6 +87,9 @@ class PipelineConfig:
             raise ValueError("distance_threshold must be positive")
         if self.backend not in ("tpu", "cpu", "gpu"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.mesh_shape and len(self.mesh_shape) != 2:
+            raise ValueError(
+                f"mesh_shape must be (scene, frame), got {self.mesh_shape}")
 
     def replace(self, **kw) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)
@@ -92,7 +97,6 @@ class PipelineConfig:
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
         d["mesh_shape"] = list(d["mesh_shape"])
-        d["mesh_axis_names"] = list(d["mesh_axis_names"])
         return json.dumps(d, indent=2)
 
 
@@ -114,7 +118,6 @@ def load_config(name: str, config_dir: Optional[str] = None, **overrides) -> Pip
         raise ValueError(f"unknown config keys in {path}: {sorted(unknown)}")
     raw["config_name"] = name
     raw.update(overrides)
-    for tup_key in ("mesh_shape", "mesh_axis_names"):
-        if tup_key in raw and isinstance(raw[tup_key], list):
-            raw[tup_key] = tuple(raw[tup_key])
+    if isinstance(raw.get("mesh_shape"), list):
+        raw["mesh_shape"] = tuple(raw["mesh_shape"])
     return PipelineConfig(**raw)

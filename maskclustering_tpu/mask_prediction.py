@@ -193,6 +193,24 @@ def _connected_components(key: np.ndarray) -> np.ndarray:
     return out.reshape(h, w)
 
 
+def predictor_from_spec(spec: str) -> "MaskPredictor":
+    """Mask-predictor factory for config-driven construction.
+
+    ``"grid"`` -> GridSegmenter (dependency-free fallback);
+    ``"<detectron2 yaml>::<checkpoint.pth>"`` -> TorchCropFormerPredictor
+    (the reference's cropformer_path carries the checkpoint,
+    configs/scannet.json:8; the yaml names the architecture).
+    """
+    if spec == "grid":
+        return GridSegmenter()
+    if "::" in spec:
+        config_file, _, checkpoint = spec.partition("::")
+        return TorchCropFormerPredictor(config_file, checkpoint)
+    raise ValueError(
+        f"unknown mask-predictor spec {spec!r}: use 'grid' or "
+        f"'<config.yaml>::<checkpoint.pth>'")
+
+
 # ---------------------------------------------------------------------------
 # Optional torch/detectron2 CropFormer adapter (import-gated)
 

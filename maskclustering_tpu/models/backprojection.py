@@ -71,25 +71,32 @@ class SceneAssociation(NamedTuple):
     mask_valid: jnp.ndarray  # (F, K_max+1) bool
 
 
-def _hash_voxel(keys: jnp.ndarray, bits: int = 23) -> jnp.ndarray:
+def _hash_bits(num_ids: int) -> int:
+    """Voxel-hash width so the packed (id, hash) key stays within int32."""
+    return 30 - max(int(num_ids - 1).bit_length(), 1)
+
+
+def _hash_voxel(keys: jnp.ndarray, bits: int) -> jnp.ndarray:
     """Mix integer voxel coords into a positive int32 hash (bits < 31)."""
     h = keys[..., 0] * 73856093 ^ keys[..., 1] * 19349663 ^ keys[..., 2] * 83492791
     return jnp.abs(h) & ((1 << bits) - 1)
 
 
 def _count_distinct_per_mask(ids: jnp.ndarray, vox_hash: jnp.ndarray, valid: jnp.ndarray,
-                             num_ids: int) -> jnp.ndarray:
+                             num_ids: int, bits: int) -> jnp.ndarray:
     """Count distinct (id, voxel-hash) pairs per id via one sort (no scatter).
 
     Invalid entries collapse into slot 0 (background), which callers ignore.
-    Hash collisions (23-bit buckets) undercount by ~0.1% — immaterial for a
-    0.3 coverage threshold.
+    Hash collisions (2^bits buckets; 23 bits at the default k_max=127)
+    undercount by ~0.1% — immaterial for a 0.3 coverage threshold. ``bits``
+    shrinks as k_max grows to keep the packed key within int32 (the TPU-native
+    integer width); at k_max=1023 the 20-bit buckets still undercount < 1%.
     """
     ids = jnp.where(valid, ids, 0)
-    key = ids * (1 << 23) + jnp.where(valid, vox_hash, 0)
+    key = ids * (1 << bits) + jnp.where(valid, vox_hash, 0)
     skey = jnp.sort(key)
     new = jnp.concatenate([jnp.array([True]), skey[1:] != skey[:-1]])
-    sid = skey >> 23
+    sid = skey >> bits
     return jax.ops.segment_sum(new.astype(jnp.int32), sid, num_segments=num_ids)
 
 
@@ -119,7 +126,11 @@ def associate_frame(
     fx, fy = intrinsics[0, 0], intrinsics[1, 1]
     cx, cy = intrinsics[0, 2], intrinsics[1, 2]
 
-    seg = jnp.clip(seg, 0, k_max)
+    # Ids outside [1, k_max] are dropped to background, never merged: clipping
+    # would alias every id > k_max into one mask and cross-contaminate it
+    # (the reference handles arbitrary uint16 ids, mask_backprojection.py:89-94;
+    # callers derive k_max from the scene's true max id, pipeline.run_scene).
+    seg = jnp.where((seg < 0) | (seg > k_max), 0, seg)
     depth_ok = (depth > 0) & (depth <= depth_trunc)
 
     # ---- project scene points into the frame ----
@@ -163,7 +174,9 @@ def associate_frame(
     # occupied voxels of the mask's backprojected pixels (coverage denominator)
     world_pix, _ = unproject_depth(depth, intrinsics, cam_to_world, depth_trunc)
     vox = jnp.floor(world_pix.reshape(-1, 3) / distance_threshold).astype(jnp.int32)
-    n_voxels = _count_distinct_per_mask(pix_ids, _hash_voxel(vox), dok_flat & (seg_flat > 0), k_max + 1)
+    bits = _hash_bits(k_max + 1)
+    n_voxels = _count_distinct_per_mask(pix_ids, _hash_voxel(vox, bits),
+                                        dok_flat & (seg_flat > 0), k_max + 1, bits)
 
     # scene points claimed per mask (numerator): each (point, mask) pair
     # counts once — dedupe candidate ids within each point's window row.

@@ -23,10 +23,14 @@ reference's Open3D calls — this is the fidelity path, not the fast path.
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger("maskclustering_tpu")
+_PALLAS_WARNED = False
 
 from maskclustering_tpu.models.backprojection import SceneAssociation
 from maskclustering_tpu.ops.dbscan import dbscan_labels
@@ -37,18 +41,19 @@ def statistical_outlier_mask(points: np.ndarray, nb_neighbors: int = 20,
                              std_ratio: float = 2.0) -> np.ndarray:
     """Keep-mask of Open3D remove_statistical_outlier semantics.
 
-    Per point: mean distance to its nb_neighbors nearest neighbors; keep
-    points whose mean distance <= global_mean + std_ratio * global_std.
-    Brute force O(P^2) — inputs are per-mask clouds of at most a few
-    thousand points after voxel downsampling.
+    Per point: mean distance to its nb_neighbors nearest neighbors, where —
+    matching Open3D's KNN, whose search set includes the query point itself
+    at distance 0 — the point's own zero distance occupies one of the
+    nb_neighbors slots. Keep points whose mean distance <= global_mean +
+    std_ratio * global_std. Brute force O(P^2) — inputs are per-mask clouds
+    of at most a few thousand points after voxel downsampling.
     """
     p = len(points)
     if p <= 1:
         return np.ones(p, dtype=bool)
-    nb = min(nb_neighbors, p - 1)
+    nb = min(nb_neighbors, p)
     d2 = np.sum((points[:, None, :] - points[None, :, :]) ** 2, axis=-1)
-    np.fill_diagonal(d2, np.inf)
-    nearest = np.sort(d2, axis=1)[:, :nb]
+    nearest = np.sort(d2, axis=1)[:, :nb]  # row minimum is the self-distance 0
     mean_dist = np.sqrt(np.maximum(nearest, 0.0)).mean(axis=1)
     mu, sigma = mean_dist.mean(), mean_dist.std()
     return mean_dist <= mu + std_ratio * sigma
@@ -114,7 +119,11 @@ def _ball_query_batched(mask_points_list, cropped_list, k, radius):
                 jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
                 k=k, radius=radius))
     except Exception:  # pragma: no cover - fall through to the jnp path
-        pass
+        global _PALLAS_WARNED
+        if not _PALLAS_WARNED:  # a real Mosaic lowering failure must be
+            _PALLAS_WARNED = True  # visible, not a silent perf regression
+            log.warning("Pallas ball_query failed; using the jnp fallback",
+                        exc_info=True)
     return np.asarray(ball_query(
         jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
         k=k, radius=radius))
@@ -132,6 +141,8 @@ def frame_backprojection_exact(
     few_points_threshold: int = 25,
     coverage_threshold: float = 0.3,
     k_neighbors: int = 20,
+    denoise_eps: float = 0.04,
+    denoise_min_points: int = 4,
 ) -> Dict[int, np.ndarray]:
     """One frame's mask -> scene-point-id sets, reference semantics.
 
@@ -151,7 +162,8 @@ def frame_backprojection_exact(
         if len(mask_points) < few_points_threshold:
             continue
         mask_points = voxel_downsample_np(mask_points, distance_threshold)
-        kept = denoise_mask_points(mask_points)
+        kept = denoise_mask_points(mask_points, eps=denoise_eps,
+                                   min_points=denoise_min_points)
         mask_points = mask_points[kept]
         if len(mask_points) < few_points_threshold:
             continue
@@ -207,6 +219,8 @@ def associate_scene_exact(tensors, cfg, k_max: int = 127) -> SceneAssociation:
             depth_trunc=cfg.depth_trunc,
             few_points_threshold=cfg.few_points_threshold,
             coverage_threshold=cfg.coverage_threshold,
+            denoise_eps=cfg.denoise_eps,
+            denoise_min_points=cfg.denoise_min_points,
         )
         if not mask_info:
             continue

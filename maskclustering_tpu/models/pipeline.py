@@ -39,13 +39,30 @@ class SceneResult(NamedTuple):
     timings: Dict[str, float]
 
 
-def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: int = 127,
+def bucket_k_max(max_id: int, minimum: int = 63) -> int:
+    """Smallest (2^b - 1) >= max(max_id, minimum): few jit buckets, no aliasing."""
+    k = minimum
+    while k < max_id:
+        k = k * 2 + 1
+    return k
+
+
+def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int] = None,
               seq_name: Optional[str] = None, export: bool = False,
               object_dict_dir: Optional[str] = None,
               prediction_root: str = "data/prediction") -> SceneResult:
-    """Cluster one scene. Returns objects + artifacts (optionally written)."""
+    """Cluster one scene. Returns objects + artifacts (optionally written).
+
+    ``k_max`` (max mask id per frame) defaults to a power-of-two bucket of the
+    scene's true max segmentation id, so crowded frames (CropFormer id-maps
+    are uint16) are never truncated while jit recompiles stay rare.
+    """
     timings: Dict[str, float] = {}
     t0 = time.perf_counter()
+
+    if k_max is None:
+        max_id = int(np.max(tensors.segmentations)) if np.size(tensors.segmentations) else 0
+        k_max = bucket_k_max(max_id)
 
     if cfg.use_exact_ball_query:
         from maskclustering_tpu.models.exact_backprojection import associate_scene_exact
@@ -85,11 +102,12 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: int = 127,
     timings["cluster"] = time.perf_counter() - t0
 
     t0 = time.perf_counter()
+    first_h = np.asarray(assoc.first_id)
     objects = postprocess_scene(
         np.asarray(tensors.scene_points),
-        np.asarray(assoc.first_id),
+        first_h,
         np.asarray(assoc.last_id),
-        np.asarray(assoc.point_visible),
+        first_h > 0,  # == assoc.point_visible, minus one (F, N) transfer
         table.frame,
         table.mask_id,
         np.asarray(active),
@@ -102,8 +120,10 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: int = 127,
         dbscan_min_points=cfg.dbscan_split_min_points,
         overlap_merge_ratio=cfg.overlap_merge_ratio,
         min_masks_per_object=cfg.min_masks_per_object,
+        timings=(post_timings := {}),
     )
     timings["postprocess"] = time.perf_counter() - t0
+    timings.update({f"post.{k}": v for k, v in post_timings.items()})
 
     if export:
         if seq_name is None or object_dict_dir is None:

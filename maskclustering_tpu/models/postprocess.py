@@ -17,12 +17,27 @@ the native C++ extension when built, else sklearn.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, NamedTuple, Sequence, Tuple
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 from maskclustering_tpu.ops.dbscan import dbscan_labels
 from maskclustering_tpu.ops.geometry import bboxes_overlap
+
+
+class _PhaseTimer:
+    """Optional phase wall-times accumulated into a caller-owned dict."""
+
+    def __init__(self, timings: Optional[Dict[str, float]]):
+        self.timings = timings
+        self.last = time.perf_counter()
+
+    def mark(self, name: str) -> None:
+        if self.timings is not None:
+            now = time.perf_counter()
+            self.timings[name] = self.timings.get(name, 0.0) + now - self.last
+            self.last = now
 
 
 class SceneObjects(NamedTuple):
@@ -76,7 +91,9 @@ def postprocess_scene(
     dbscan_min_points: int = 4,
     overlap_merge_ratio: float = 0.8,
     min_masks_per_object: int = 2,
+    timings: Optional[Dict[str, float]] = None,
 ) -> SceneObjects:
+    t = _PhaseTimer(timings)
     f, n = first.shape
     m_pad = mask_frame.shape[0]
 
@@ -85,98 +102,147 @@ def postprocess_scene(
     gmap[mask_frame[act_idx], mask_id[act_idx]] = act_idx
 
     m_coo, p_coo, f_coo = _claims_coo(first, last, gmap)
-    rep_coo = assignment[m_coo]
+    rep_coo = assignment[m_coo].astype(np.int64)
+    t.mark("claims")
 
     # per-mask point sets (sorted by mask)
     order = np.argsort(m_coo, kind="stable")
     m_sorted, p_by_mask = m_coo[order], p_coo[order]
-    mask_starts = np.searchsorted(m_sorted, np.arange(m_pad + 1))
-
-    def mask_points(m):
-        return p_by_mask[mask_starts[m]: mask_starts[m + 1]]
 
     # node sizes: count of active member masks per representative
     sizes = np.bincount(assignment[mask_active], minlength=m_pad)
     reps = np.nonzero(sizes >= min_masks_per_object)[0]
 
-    # node point sets: unique (rep, point) via packed 1-D int64 keys —
-    # an order of magnitude faster than np.unique(axis=0)'s row sort
-    rp_key = np.unique(rep_coo.astype(np.int64) * n + p_coo)
-    rp = np.stack([rp_key // n, rp_key % n], axis=1)
-    rp_starts = np.searchsorted(rp[:, 0], np.arange(m_pad + 1))
+    # ONE sort builds both node structures: unique claimed (rep, point, frame)
+    # triples, and — because the triple keys are sorted by (rep, point) first —
+    # the unique (rep, point) node rows fall out with a flag diff, no 2nd sort.
+    rpf_key = np.sort((rep_coo * n + p_coo) * f + f_coo)
+    new_tri = np.empty(len(rpf_key), dtype=bool)
+    if len(rpf_key):
+        new_tri[0] = True
+        new_tri[1:] = rpf_key[1:] != rpf_key[:-1]
+    rpf_key = rpf_key[new_tri]
+    rpf_pf = rpf_key // f
+    rpf_f = (rpf_key % f).astype(np.int32)
 
-    # node claimed (rep, point, frame) triples, deduped the same way
-    rpf_key = np.unique((rep_coo.astype(np.int64) * n + p_coo) * f + f_coo)
-    rpf_pf, rpf_f = rpf_key // f, rpf_key % f
-    rpf = np.stack([rpf_pf // n, rpf_pf % n, rpf_f], axis=1)
-    rpf_starts = np.searchsorted(rpf[:, 0], np.arange(m_pad + 1))
+    new_rp = np.empty(len(rpf_pf), dtype=bool)
+    if len(rpf_pf):
+        new_rp[0] = True
+        new_rp[1:] = rpf_pf[1:] != rpf_pf[:-1]
+    rp_key = rpf_pf[new_rp]
+    rp_rep = (rp_key // n).astype(np.int32)
+    rp_pt = (rp_key % n).astype(np.int32)
+    row_of_tri = np.cumsum(new_rp) - 1  # triple -> its (rep, point) row
+    rp_starts = np.searchsorted(rp_rep, np.arange(m_pad + 1))
+    t.mark("node_structs")
 
-    members_by_rep: Dict[int, np.ndarray] = {}
-    for m in act_idx:
-        members_by_rep.setdefault(int(assignment[m]), []).append(int(m))
+    # ---- detection ratio, vectorized over ALL (rep, point) rows at once ----
+    # numerator: #frames where the point is claimed by a node mask
+    tri_rep = (rpf_pf // n).astype(np.int32)
+    tri_ok = node_visible[tri_rep, rpf_f]
+    num = np.bincount(row_of_tri[tri_ok], minlength=len(rp_key)).astype(np.float64)
+    # denominator: #node frames where the point is visible at all
+    # (chunked (rows, F) gather keeps peak memory bounded)
+    den = np.empty(len(rp_key), dtype=np.float64)
+    pv_t = point_visible.T  # (N, F)
+    chunk = 1 << 20
+    for s in range(0, len(rp_key), chunk):
+        e = min(s + chunk, len(rp_key))
+        den[s:e] = (node_visible[rp_rep[s:e]] & pv_t[rp_pt[s:e]]).sum(axis=1)
+    ratio_ok_rows = num / (den + 1e-6) > point_filter_threshold
+    t.mark("ratio")
+
+    # ---- DBSCAN split each node; group labels live in one global array ----
+    # glabel[row] = group_offset[rep] + (dbscan label + 1); 0-label = noise is
+    # kept as its own candidate object (reference post_process.py:109-123)
+    glabel = np.full(len(rp_key), -1, dtype=np.int64)
+    rep_offset = np.zeros(m_pad, dtype=np.int64)  # group_offset per rep
+    rep_groups = np.zeros(m_pad, dtype=np.int64)  # group count per live rep
+    rep_slices: List[Tuple[int, int, int, np.ndarray]] = []  # (rep, s, e, groups)
+    group_offset = 0
+    for rep in reps:
+        s, e = rp_starts[rep], rp_starts[rep + 1]
+        if e == s or not node_visible[rep].any():
+            continue
+        labels = dbscan_labels(scene_points[rp_pt[s:e]], eps=dbscan_eps,
+                               min_points=dbscan_min_points)
+        groups = labels + 1
+        glabel[s:e] = group_offset + groups
+        rep_offset[rep] = group_offset
+        rep_groups[rep] = int(groups.max()) + 1
+        rep_slices.append((int(rep), int(s), int(e), groups))
+        group_offset += int(groups.max()) + 1
+    total_groups = max(group_offset, 1)
+    group_size = np.bincount(glabel[glabel >= 0], minlength=total_groups)
+    t.mark("dbscan")
+
+    # ---- assign each member mask to its best-overlapping group ----
+    # Every claimed point of a mask is a node point of its rep, so the
+    # mask∩group intersection is a count of the mask's claims per group of
+    # its OWN rep — so a (mask, local-group) slot table is dense and small
+    # (Σ members × groups-of-their-rep) and one O(C) bincount replaces the
+    # per-(mask × group) intersect1d loop (and any O(C log C) sort).
+    g_of_mask = rep_groups[assignment]  # (m_pad,) slots per mask
+    slot_base = np.zeros(m_pad + 1, dtype=np.int64)
+    np.cumsum(g_of_mask, out=slot_base[1:])
+    claim_row = np.searchsorted(rp_key, rep_coo[order] * n + p_by_mask)
+    claim_gl = glabel[claim_row]
+    ok = claim_gl >= 0
+    m_ok = m_sorted[ok]
+    key = slot_base[m_ok] + (claim_gl[ok] - rep_offset[assignment[m_ok]])
+    counts = np.bincount(key, minlength=slot_base[-1]).astype(np.int64)
+    # per-mask argmax over its slot segment: pack (count, lowest-index wins)
+    # into one int64 so np.maximum.reduceat resolves ties like the
+    # reference's ascending scan with a strict > (post_process.py:~150)
+    ln = max(len(counts), 1)
+    packed = counts * ln + (ln - 1 - np.arange(len(counts), dtype=np.int64))
+    # segment boundaries must cover every non-empty slot run (masks with zero
+    # slots occupy zero width, so consecutive starts still tile `counts`);
+    # inactive masks have no claims, land at cnt == 0, and are skipped below
+    seg_masks = np.nonzero(g_of_mask > 0)[0]
+    seg_starts = slot_base[seg_masks]
+    obj_masks: Dict[int, List[Tuple]] = {}
+    if len(seg_starts):
+        seg_best = np.maximum.reduceat(packed, seg_starts)
+        best_cnt = seg_best // ln
+        best_slot = ln - 1 - (seg_best % ln)
+        best_gl = best_slot - slot_base[seg_masks] + rep_offset[assignment[seg_masks]]
+        for m, gl, cnt in zip(seg_masks, best_gl, best_cnt):
+            if cnt <= 0:  # mask with no surviving claims (all mid-id overlaps)
+                continue
+            obj_masks.setdefault(int(gl), []).append(
+                (frame_ids[mask_frame[m]], int(mask_id[m]), float(cnt / group_size[gl]))
+            )
+    t.mark("mask_assign")
 
     total_point_ids: List[np.ndarray] = []
     total_bboxes: List[Tuple[np.ndarray, np.ndarray]] = []
     total_masks: List[List[Tuple]] = []
 
-    pv = point_visible  # (F, N)
-    for rep in reps:
-        node_pts = rp[rp_starts[rep]: rp_starts[rep + 1], 1]
-        if len(node_pts) == 0:
-            continue
-        node_frames = np.nonzero(node_visible[rep])[0]
-        if len(node_frames) == 0:
-            continue
-
-        # ---- detection ratio over the node's frames ----
-        # denominator: #node frames where the point is visible at all
-        # (np.ix_ selects the node's own points before materializing)
-        den = pv[np.ix_(node_frames, node_pts)].sum(axis=0).astype(np.float64)
-        # numerator: #node frames where the point is claimed by a node mask
-        tri = rpf[rpf_starts[rep]: rpf_starts[rep + 1]]
-        tri = tri[np.isin(tri[:, 2], node_frames)]
-        pos = np.searchsorted(node_pts, tri[:, 1])
-        num = np.bincount(pos, minlength=len(node_pts)).astype(np.float64)
-        ratio_ok = num / (den + 1e-6) > point_filter_threshold
-
-        # ---- DBSCAN split into spatially connected objects ----
-        labels = dbscan_labels(scene_points[node_pts], eps=dbscan_eps,
-                               min_points=dbscan_min_points)
-        groups = labels + 1  # group 0 = noise, kept as its own candidate object
-        # (the reference keeps the noise group too, post_process.py:109-123)
-
-        # ---- assign each member mask to its best-overlapping object ----
-        group_ids = np.unique(groups)
-        group_sets = {g: node_pts[groups == g] for g in group_ids}
-        obj_masks: Dict[int, List[Tuple]] = {g: [] for g in group_ids}
-        for m in members_by_rep.get(int(rep), []):
-            mp = mask_points(m)
-            best_g, best_inter = -1, 0
-            best_cov = 0.0
-            for g in group_ids:
-                inter = np.intersect1d(mp, group_sets[g], assume_unique=False).size
-                if inter > best_inter:
-                    best_g, best_inter = g, inter
-                    best_cov = inter / len(group_sets[g])
-            if best_inter > 0:
-                obj_masks[best_g].append(
-                    (frame_ids[mask_frame[m]], int(mask_id[m]), float(best_cov))
-                )
-
-        for g in group_ids:
+    for rep, s, e, groups in rep_slices:
+        node_pts = rp_pt[s:e]
+        ratio_ok = ratio_ok_rows[s:e]
+        base = glabel[s]  # group_offset of this rep (groups[0] may be noise 0)
+        base -= groups[0]
+        for g in range(int(groups.max()) + 1):
             sel = groups == g
+            if not sel.any():
+                continue
+            masks_g = obj_masks.get(int(base + g), [])
             obj_pts_all = node_pts[sel]
             obj_pts = obj_pts_all[ratio_ok[sel]]
-            if len(obj_pts) == 0 or len(obj_masks[g]) < min_masks_per_object:
+            if len(obj_pts) == 0 or len(masks_g) < min_masks_per_object:
                 continue
             pts3d = scene_points[obj_pts_all]
             total_point_ids.append(obj_pts)
             total_bboxes.append((pts3d.min(axis=0), pts3d.max(axis=0)))
-            total_masks.append(obj_masks[g])
+            total_masks.append(masks_g)
 
+    t.mark("emit")
     point_ids_list, mask_list = _merge_overlapping(
         total_point_ids, total_bboxes, total_masks, overlap_merge_ratio
     )
+    t.mark("merge")
     return SceneObjects(point_ids_list=point_ids_list, mask_list=mask_list, num_points=n)
 
 

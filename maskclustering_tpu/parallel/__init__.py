@@ -1,5 +1,6 @@
 """Mesh sharding and multi-chip execution (ICI/DCN collectives via XLA)."""
 
+from maskclustering_tpu.parallel.batch import cluster_scene_batch, fused_scene_objects
 from maskclustering_tpu.parallel.mesh import constrain, make_mesh, sharding
 from maskclustering_tpu.parallel.sharded import (
     FusedStepResult,
@@ -8,7 +9,9 @@ from maskclustering_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "cluster_scene_batch",
     "constrain",
+    "fused_scene_objects",
     "make_mesh",
     "sharding",
     "FusedStepResult",

@@ -1,0 +1,175 @@
+"""Mesh-batched scene clustering to artifacts — the multi-chip e2e path.
+
+The reference scales out by completing each scene's pipeline inside one GPU
+process, scenes round-robined over GPUs with the filesystem as IPC
+(reference run.py:33-50). The TPU analog implemented here:
+
+- scenes batch over the ``scene`` mesh axis, frames shard over ``frame``;
+- the whole device pipeline is ONE jitted program per shape bucket
+  (parallel/sharded.py `build_fused_step`: association -> graph ->
+  schedule -> clustering, zero host syncs);
+- ragged scenes are padded to shared static shapes: frames to a multiple of
+  lcm(frame axis, cfg.frame_pad_multiple) with ``frame_valid=False``, points
+  to a bucket with a far-away sentinel that no frustum ever claims;
+- post-process + npz/object_dict export then run per scene on host —
+  identical artifacts to the single-chip path (models/pipeline.run_scene),
+  which the e2e tests assert byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from maskclustering_tpu.config import PipelineConfig
+from maskclustering_tpu.datasets.base import SceneTensors
+from maskclustering_tpu.models.pipeline import bucket_k_max
+from maskclustering_tpu.models.postprocess import SceneObjects, postprocess_scene
+from maskclustering_tpu.parallel.mesh import make_mesh
+from maskclustering_tpu.parallel.sharded import build_fused_step
+
+# Sentinel coordinate for point padding: far outside any indoor scan, so a
+# padded point is never inside a frustum within depth_trunc and never claimed.
+_PAD_COORD = 1.0e4
+
+
+def _round_up(value: int, multiple: int) -> int:
+    return max(multiple, -(-value // multiple) * multiple)
+
+
+def batch_shapes(tensors_list: Sequence[SceneTensors], cfg: PipelineConfig,
+                 mesh) -> Tuple[int, int]:
+    """(F_pad, N_pad) shared static shapes for a scene batch on ``mesh``."""
+    f_axis = int(mesh.shape["frame"])
+    f_mult = math.lcm(f_axis, max(cfg.frame_pad_multiple, 1))
+    f_pad = _round_up(max(t.num_frames for t in tensors_list), f_mult)
+    n_pad = _round_up(max(t.num_points for t in tensors_list),
+                      max(cfg.point_chunk, 1))
+    return f_pad, n_pad
+
+
+def pad_scene_batch(tensors_list: Sequence[SceneTensors], f_pad: int, n_pad: int,
+                    num_scenes: int):
+    """Stack scenes into the fused step's batched arrays.
+
+    Short batches repeat the last scene (outputs for the repeats are
+    discarded by the caller); padded frames are invalid, padded points sit
+    at the sentinel. Returns the 6-tuple of (S, ...) arrays.
+    """
+    h, w = tensors_list[0].depths.shape[1:3]
+    s = num_scenes
+    pts = np.full((s, n_pad, 3), _PAD_COORD, dtype=np.float32)
+    depths = np.zeros((s, f_pad, h, w), dtype=np.float32)
+    segs = np.zeros((s, f_pad, h, w), dtype=np.int32)
+    intr = np.tile(np.eye(3, dtype=np.float32), (s, f_pad, 1, 1))
+    c2w = np.tile(np.eye(4, dtype=np.float32), (s, f_pad, 1, 1))
+    fv = np.zeros((s, f_pad), dtype=bool)
+    for i in range(s):
+        t = tensors_list[min(i, len(tensors_list) - 1)]
+        f, n = t.num_frames, t.num_points
+        pts[i, :n] = t.scene_points
+        depths[i, :f] = t.depths
+        segs[i, :f] = t.segmentations
+        intr[i, :f] = t.intrinsics
+        c2w[i, :f] = t.cam_to_world
+        fv[i, :f] = t.frame_valid
+    return pts, depths, segs, intr, c2w, fv
+
+
+def fused_scene_objects(
+    out, index: int, tensors: SceneTensors, cfg: PipelineConfig, k_max: int,
+    timings: Optional[Dict[str, float]] = None,
+) -> SceneObjects:
+    """Host post-process of one scene of a FusedStepResult batch.
+
+    Uses the fused path's dense (frame, id) slot table; object ordering and
+    artifact bytes match the single-chip path because both enumerate masks
+    ascending by (frame, id) and representatives are min-index labels.
+    """
+    first = np.asarray(out.first_id[index])
+    f_pad = first.shape[0]
+    mask_frame = np.repeat(np.arange(f_pad, dtype=np.int32), k_max)
+    mask_id = np.tile(np.arange(1, k_max + 1, dtype=np.int32), f_pad)
+    frame_ids = list(tensors.frame_ids)
+    frame_ids += [None] * (f_pad - len(frame_ids))
+
+    objects = postprocess_scene(
+        np.asarray(out_scene_points(tensors, first.shape[1])),
+        first,
+        np.asarray(out.last_id[index]),
+        first > 0,
+        mask_frame,
+        mask_id,
+        np.asarray(out.mask_active[index]),
+        np.asarray(out.assignment[index]),
+        np.asarray(out.node_visible[index]),
+        frame_ids,
+        k_max=k_max,
+        point_filter_threshold=cfg.point_filter_threshold,
+        dbscan_eps=cfg.dbscan_split_eps,
+        dbscan_min_points=cfg.dbscan_split_min_points,
+        overlap_merge_ratio=cfg.overlap_merge_ratio,
+        min_masks_per_object=cfg.min_masks_per_object,
+        timings=timings,
+    )
+    n_real = tensors.num_points
+    for pids in objects.point_ids_list:
+        assert pids.size == 0 or int(pids.max()) < n_real, \
+            "sentinel pad point claimed — padding invariant violated"
+    return SceneObjects(point_ids_list=objects.point_ids_list,
+                        mask_list=objects.mask_list, num_points=n_real)
+
+
+def out_scene_points(tensors: SceneTensors, n_pad: int) -> np.ndarray:
+    """Scene cloud re-padded to the batch bucket (sentinel coords)."""
+    pts = np.asarray(tensors.scene_points, dtype=np.float32)
+    if pts.shape[0] == n_pad:
+        return pts
+    out = np.full((n_pad, 3), _PAD_COORD, dtype=np.float32)
+    out[: pts.shape[0]] = pts
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_step(mesh, cfg: PipelineConfig, k_max: int):
+    """One jitted fused step per (mesh, cfg, k_max) — reuse across batches."""
+    return build_fused_step(mesh, cfg, k_max=k_max)
+
+
+def cluster_scene_batch(
+    cfg: PipelineConfig,
+    mesh,
+    tensors_list: Sequence[SceneTensors],
+    *,
+    k_max: Optional[int] = None,
+) -> List[SceneObjects]:
+    """Run a batch of scenes through the fused mesh step to SceneObjects.
+
+    The batch is padded up to a multiple of the ``scene`` axis; every scene
+    in it shares one (F_pad, N_pad, k_max) shape bucket, so distinct buckets
+    compile once each (lru-cached jit).
+    """
+    if not tensors_list:
+        return []
+    s_axis = int(mesh.shape["scene"])
+    num_scenes = _round_up(len(tensors_list), s_axis)
+    f_pad, n_pad = batch_shapes(tensors_list, cfg, mesh)
+    if k_max is None:
+        max_id = max(int(np.max(t.segmentations)) if np.size(t.segmentations) else 0
+                     for t in tensors_list)
+        k_max = bucket_k_max(max_id)
+
+    step = _cached_step(mesh, cfg, k_max)
+    args = pad_scene_batch(tensors_list, f_pad, n_pad, num_scenes)
+    out = jax.block_until_ready(step(*args))
+    return [fused_scene_objects(out, i, tensors_list[i], cfg, k_max)
+            for i in range(len(tensors_list))]
+
+
+def make_run_mesh(cfg: PipelineConfig):
+    """Mesh from cfg.mesh_shape over the available devices."""
+    return make_mesh(tuple(cfg.mesh_shape))

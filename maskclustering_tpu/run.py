@@ -45,9 +45,11 @@ DEFAULT_STEPS = ("masks", "cluster", "eval_ca", "features", "label_features",
 TASMAP_STEPS = ("masks", "cluster", "vis", "top_images")
 ALL_STEPS = DEFAULT_STEPS + ("vis", "top_images")
 
-# dataset -> (gt dir, split file) under data_root (reference run.py:19-31,64-79)
+# dataset -> (gt dir, split file) under data_root (reference run.py:19-31,64-79).
+# The reference reads splits/scannet_test.txt, which it ships EMPTY (a known
+# quirk, SURVEY.md §7) — the real 311-scene val list lives in scannet.txt.
 _DATASET_LAYOUT = {
-    "scannet": ("scannet/gt", "scannet_test.txt"),
+    "scannet": ("scannet/gt", "scannet.txt"),
     "scannetpp": ("scannetpp/gt", "scannetpp.txt"),
     "matterport3d": ("matterport3d/gt", "matterport3d.txt"),
     "tasmap": ("tasmap/gt", "tasmap.txt"),
@@ -69,10 +71,15 @@ class RunReport:
     config_name: str
     step_seconds: Dict[str, float] = dataclasses.field(default_factory=dict)
     scenes: List[SceneStatus] = dataclasses.field(default_factory=list)
+    step_errors: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     @property
     def failed(self) -> List[SceneStatus]:
         return [s for s in self.scenes if s.status == "failed"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.failed and not self.step_errors
 
     def save(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -81,6 +88,7 @@ class RunReport:
                 "config_name": self.config_name,
                 "step_seconds": self.step_seconds,
                 "scenes": [dataclasses.asdict(s) for s in self.scenes],
+                "step_errors": self.step_errors,
             }, f, indent=2)
 
 
@@ -137,9 +145,15 @@ def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
         from maskclustering_tpu.mask_prediction import predict_scene_masks
 
         for seq in missing:
-            ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
-            log.info("predicting masks for %s", seq)
-            predict_scene_masks(ds, mask_predictor, stride=cfg.step)
+            try:
+                ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
+                log.info("predicting masks for %s", seq)
+                predict_scene_masks(ds, mask_predictor, stride=cfg.step)
+            except Exception:
+                # one corrupt scene must not abort the whole masks step; the
+                # scene stays in the missing list (mask_command fallback /
+                # exclusion), like the mask_command path's non-zero-exit case
+                log.exception("mask prediction failed for %s", seq)
         # keep mask_command as the fallback for scenes the predictor
         # could not fill (e.g. empty frame lists)
         return check_masks(cfg, missing, mask_command=mask_command)
@@ -189,14 +203,85 @@ def _cluster_worker(payload):
     return [cluster_scene(cfg, s, resume=resume) for s in seq_names]
 
 
+def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
+                        resume: bool = True,
+                        prediction_root: Optional[str] = None) -> List[SceneStatus]:
+    """Step 2 over a device mesh: fused batches -> per-scene artifacts.
+
+    Scenes stream through the (scene, frame) mesh in batches of the scene
+    axis size; each batch runs the fully-jitted fused step
+    (parallel/batch.cluster_scene_batch), then post-process + export write
+    the exact artifacts the single-chip path does. Per-scene failures are
+    captured without sinking the batch queue.
+    """
+    from maskclustering_tpu.models.postprocess import export_artifacts
+    from maskclustering_tpu.parallel.batch import cluster_scene_batch, make_run_mesh
+
+    prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
+    mesh = make_run_mesh(cfg)
+    s_axis = int(mesh.shape["scene"])
+    statuses: Dict[str, SceneStatus] = {}
+    pending: List[tuple] = []  # (seq, dataset, tensors)
+
+    def flush():
+        if not pending:
+            return
+        batch, pending[:] = list(pending), []
+        t0 = time.perf_counter()
+        try:
+            objects_list = cluster_scene_batch(cfg, mesh, [b[2] for b in batch])
+        except Exception:
+            log.exception("mesh batch %s failed", [b[0] for b in batch])
+            err = traceback.format_exc(limit=20)
+            for seq, _, _ in batch:
+                statuses[seq] = SceneStatus(seq, "failed", time.perf_counter() - t0,
+                                            error=err)
+            return
+        per_scene = (time.perf_counter() - t0) / len(batch)
+        for (seq, ds, _), objects in zip(batch, objects_list):
+            try:
+                export_artifacts(objects, seq, cfg.config_name, ds.object_dict_dir,
+                                 prediction_root=prediction_root,
+                                 top_k_repre=cfg.num_representative_masks)
+                statuses[seq] = SceneStatus(seq, "ok", per_scene,
+                                            num_objects=len(objects.point_ids_list))
+            except Exception:
+                log.exception("scene %s export failed", seq)
+                statuses[seq] = SceneStatus(seq, "failed", per_scene,
+                                            error=traceback.format_exc(limit=20))
+
+    for seq in seq_names:
+        try:
+            ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
+            npz_path = os.path.join(prediction_root,
+                                    cfg.config_name + "_class_agnostic", f"{seq}.npz")
+            if resume and os.path.exists(npz_path):
+                statuses[seq] = SceneStatus(seq, "skipped")
+                continue
+            pending.append((seq, ds, ds.load_scene_tensors(cfg.step)))
+        except Exception:
+            log.exception("scene %s failed to load", seq)
+            statuses[seq] = SceneStatus(seq, "failed",
+                                        error=traceback.format_exc(limit=20))
+            continue
+        if len(pending) == s_axis:
+            flush()
+    flush()
+    return [statuses[s] for s in seq_names if s in statuses]
+
+
 def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
                    workers: int = 1, resume: bool = True) -> List[SceneStatus]:
     """Step 2: the scene work queue.
 
-    ``workers == 1`` runs in-process (the TPU path: one chip, intra-scene
-    sharding). ``workers > 1`` spawns processes with round-robin scene shards
-    — the CPU / multi-host shape, mirroring run.py:33-45 without os.system.
+    ``cfg.mesh_shape`` set routes through the fused multi-chip path
+    (cluster_scenes_mesh). Otherwise ``workers == 1`` runs in-process (the
+    single-chip TPU path: intra-scene device parallelism) and ``workers > 1``
+    spawns processes with round-robin scene shards — the CPU / multi-host
+    shape, mirroring run.py:33-45 without os.system.
     """
+    if cfg.mesh_shape:
+        return cluster_scenes_mesh(cfg, seq_names, resume=resume)
     if workers <= 1:
         return [cluster_scene(cfg, s, resume=resume) for s in seq_names]
     import multiprocessing as mp
@@ -240,8 +325,12 @@ def evaluate_step(cfg: PipelineConfig, *, no_class: bool,
     gt_files = [os.path.join(gt_dir, n.replace(".npz", ".txt")) for n in names]
     missing_gt = [g for g in gt_files if not os.path.isfile(g)]
     if missing_gt:
-        log.warning("missing GT for %d scenes; skipping evaluation", len(missing_gt))
-        return None
+        # a mispointed gt_dir must fail the run, not silently yield no AP
+        # (the reference raises here too, evaluate.py:407-411); run_pipeline
+        # records the failure in RunReport.step_errors
+        raise FileNotFoundError(
+            f"missing GT for {len(missing_gt)}/{len(gt_files)} scenes under "
+            f"{gt_dir}, e.g. {missing_gt[:3]}")
     out = os.path.join(cfg.data_root, "evaluation", cfg.dataset,
                        f"{cfg.config_name}{suffix}.txt")
     return evaluate_scans(pred_files, gt_files, vocab_name(cfg.dataset),
@@ -393,14 +482,28 @@ def run_pipeline(
 
         trace_ctx = jax.profiler.trace(profile_dir)
 
+    if cfg.debug:
+        log.setLevel(logging.DEBUG)
+
     def timed(name, fn):
         t0 = time.perf_counter()
-        out = fn()
+        try:
+            out = fn()
+        except Exception:
+            # a failed step is recorded (and fails the run via RunReport.ok /
+            # main's exit code) without sinking the steps that can still run
+            log.exception("step %s failed", name)
+            report.step_errors[name] = traceback.format_exc(limit=20)
+            out = None
         report.step_seconds[name] = time.perf_counter() - t0
         log.info("step %s: %.1fs", name, report.step_seconds[name])
         return out
 
     if "masks" in steps:
+        if mask_predictor is None and cfg.cropformer_path:
+            from maskclustering_tpu.mask_prediction import predictor_from_spec
+
+            mask_predictor = predictor_from_spec(cfg.cropformer_path)
         missing = timed("masks", lambda: check_masks(
             cfg, seq_names, mask_command, mask_predictor=mask_predictor))
         if missing:
@@ -412,7 +515,7 @@ def run_pipeline(
             trace_ctx.__enter__()
         try:
             report.scenes = timed("cluster", lambda: cluster_scenes(
-                cfg, seq_names, workers=workers, resume=resume))
+                cfg, seq_names, workers=workers, resume=resume)) or []
         finally:
             if trace_ctx is not None:
                 trace_ctx.__exit__(None, None, None)
@@ -499,7 +602,7 @@ def main(argv=None) -> int:
     total = time.time() - t0
     log.info("total time %.1f min (%.1f s/scene)", total / 60,
              total / max(len(seq_names), 1))
-    return 1 if report.failed else 0
+    return 0 if report.ok else 1
 
 
 if __name__ == "__main__":

@@ -81,9 +81,14 @@ def frames_to_gif(image_paths: Sequence[str], out_path: str,
     """
     from PIL import Image
 
-    frames: List[Image.Image] = [Image.open(p).convert("P") for p in image_paths]
-    if not frames:
+    rgb_frames = [Image.open(p).convert("RGB") for p in image_paths]
+    if not rgb_frames:
         raise ValueError("no frames to animate")
+    # GIF honors only the first frame's palette: quantize every frame against
+    # one shared adaptive palette or later frames render with wrong colors
+    first = rgb_frames[0].quantize(colors=256)
+    frames: List[Image.Image] = [first]
+    frames += [f.quantize(palette=first) for f in rgb_frames[1:]]
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
     frames[0].save(out_path, save_all=True, append_images=frames[1:],
                    duration=max(1, int(1000 / fps)), loop=0)

@@ -35,8 +35,9 @@ def project_zbuffer(
     Returns (image uint8, zbuffer f32 (inf where empty), visible bool (N,)).
     The reference walks points serially updating a z-buffer
     (get_top_images.py:147-169); the scatter formulation computes the same
-    front-most surface: scatter-min depths per pixel, then a point is
-    visible iff it attains its pixel's minimum (color ties broken by max).
+    front-most surface: scatter-min depths per pixel. Depth ties are broken
+    by a scatter-max over the RGB packed into ONE comparable integer, so a
+    single point's color wins wholesale — no cross-point channel blending.
     """
     world_to_cam = invert_se3(cam_to_world)
     cam = points @ world_to_cam[:3, :3].T + world_to_cam[:3, 3]
@@ -52,9 +53,13 @@ def project_zbuffer(
     zbuf = jnp.full(height * width + 1, jnp.inf, dtype=jnp.float32)
     zbuf = zbuf.at[lin].min(jnp.where(valid, z, jnp.inf).astype(jnp.float32))
     visible = valid & (z.astype(jnp.float32) <= zbuf[lin])
-    img = jnp.zeros((height * width + 1, 3), dtype=jnp.float32)
-    img = img.at[lin].max(jnp.where(visible[:, None], colors, 0.0).astype(jnp.float32))
-    image = (img[:height * width].reshape(height, width, 3) * 255).astype(jnp.uint8)
+    rgb8 = jnp.clip((colors * 255.0).astype(jnp.int32), 0, 255)
+    code = (rgb8[:, 0] << 16) | (rgb8[:, 1] << 8) | rgb8[:, 2]
+    codebuf = jnp.zeros(height * width + 1, dtype=jnp.int32)
+    codebuf = codebuf.at[lin].max(jnp.where(visible, code, 0))
+    flat = codebuf[:height * width]
+    image = jnp.stack([(flat >> 16) & 0xFF, (flat >> 8) & 0xFF, flat & 0xFF],
+                      axis=-1).astype(jnp.uint8).reshape(height, width, 3)
     return image, zbuf[:height * width].reshape(height, width), visible
 
 

@@ -1,0 +1,43 @@
+"""Profile postprocess_scene at bench scale (host-side; device platform irrelevant).
+
+Run from the repo root:  PYTHONPATH=. JAX_PLATFORMS=cpu python scripts/profile_postprocess.py
+"""
+import sys
+import time
+
+import numpy as np
+
+from maskclustering_tpu.config import PipelineConfig
+from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+
+def main():
+    frames, points, boxes, k_max = 150, 196608, 12, 63
+    t0 = time.time()
+    scene = make_scene(num_boxes=boxes, num_frames=frames, image_hw=(240, 320),
+                       spacing=0.02, seed=0)
+    tensors = to_scene_tensors(scene)
+    pts = tensors.scene_points
+    if pts.shape[0] < points:
+        pts = np.tile(pts, (-(-points // pts.shape[0]), 1))[:points]
+    else:
+        pts = pts[np.random.default_rng(0).choice(pts.shape[0], points, replace=False)]
+    tensors.scene_points = np.ascontiguousarray(pts, dtype=np.float32)
+    print(f"scene ready {time.time()-t0:.1f}s", file=sys.stderr)
+
+    cfg = PipelineConfig(config_name="bench", dataset="demo",
+                         distance_threshold=0.03, few_points_threshold=25,
+                         point_chunk=8192)
+
+    from maskclustering_tpu.models.pipeline import run_scene
+
+    for i in range(3):
+        t0 = time.time()
+        result = run_scene(tensors, cfg, k_max=k_max)
+        print(f"run {i}: {time.time()-t0:.2f}s  "
+              f"{ {k: round(v, 2) for k, v in result.timings.items()} }",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -127,6 +127,36 @@ def test_boundary_points_zeroed_but_tracked():
     np.testing.assert_array_equal(np.asarray(out.point_visible), first > 0)
 
 
+def test_ids_above_k_max_dropped_not_merged(scene):
+    """Ids > k_max must vanish, never alias into mask k_max (ref handles
+    arbitrary uint16 ids, mask_backprojection.py:89-94)."""
+    k_max = 15
+    fa_ref = _assoc_frame(scene, 0)
+    seg = np.asarray(scene.segmentations[0])
+    big = int(seg.max())
+    assert big > 0
+    seg_big = np.where(seg == big, k_max + 37, seg).astype(np.int32)
+    fa = associate_frame(
+        jnp.asarray(scene.scene_points),
+        jnp.asarray(scene.depths[0]),
+        jnp.asarray(seg_big),
+        jnp.asarray(scene.intrinsics[0]),
+        jnp.asarray(scene.cam_to_world[0]),
+        jnp.asarray(scene.frame_valid[0]),
+        k_max=k_max, window=1, distance_threshold=DT, depth_trunc=20.0,
+        few_points_threshold=25, coverage_threshold=COV,
+    )
+    mop_ref = np.asarray(fa_ref.mask_of_point)
+    # points the relabeled mask uniquely claimed are unclaimed now
+    assert (np.asarray(fa.mask_of_point)[mop_ref == big] == 0).all()
+    # and no other mask absorbed them: per-mask claim counts unchanged
+    n_ref = np.asarray(fa_ref.n_claimed)
+    n_new = np.asarray(fa.n_claimed)
+    keep = np.arange(k_max + 1) != big
+    np.testing.assert_array_equal(n_new[keep], n_ref[keep])
+    assert n_new[big] == 0
+
+
 def test_invalid_frame_produces_nothing(scene):
     fa = _assoc_frame(scene, 0)
     fa_invalid = associate_frame(

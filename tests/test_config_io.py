@@ -74,3 +74,21 @@ def test_vocab():
 def test_load_config_missing_raises():
     with pytest.raises(FileNotFoundError):
         load_config("scannet_typo")
+
+
+def test_no_unread_config_fields():
+    """Tripwire: every PipelineConfig field must be read somewhere outside
+    config.py (dead knobs accumulate silently otherwise)."""
+    import dataclasses
+    import pathlib
+    import re
+
+    import maskclustering_tpu
+    from maskclustering_tpu.config import PipelineConfig
+
+    pkg = pathlib.Path(maskclustering_tpu.__file__).parent
+    src = "\n".join(p.read_text() for p in pkg.rglob("*.py")
+                    if p.name != "config.py")
+    unread = [f.name for f in dataclasses.fields(PipelineConfig)
+              if not re.search(rf"\.{f.name}\b", src)]
+    assert not unread, f"config fields never read outside config.py: {unread}"

@@ -61,6 +61,33 @@ def test_pipeline_mask_lists(result_and_scene):
             assert scene.object_of_mask[frame_id, mask_id] > 0
 
 
+def test_auto_k_max_handles_ids_beyond_128(result_and_scene):
+    """run_scene derives k_max from the data: relabeling the id-maps with
+    sparse ids > 127 (CropFormer id-maps are uint16) must reproduce the
+    exact same object point sets, with no cross-mask contamination."""
+    from dataclasses import replace
+
+    from maskclustering_tpu.models.pipeline import bucket_k_max
+    from maskclustering_tpu.utils.synthetic import make_scene as _mk
+
+    assert bucket_k_max(0) == 63
+    assert bucket_k_max(63) == 63
+    assert bucket_k_max(64) == 127
+    assert bucket_k_max(200) == 255
+
+    scene, res_ref = result_and_scene
+    t = to_scene_tensors(scene)
+    # order-preserving relabel 1..15 -> 120..400: ids now exceed 127
+    seg = t.segmentations
+    t_big = replace(t, segmentations=np.where(seg > 0, seg * 20 + 100, 0).astype(np.int32))
+    res = run_scene(t_big, _config())  # k_max=None -> derived (bucket of 400)
+    assert len(res.objects.point_ids_list) == len(res_ref.objects.point_ids_list)
+    for a, b in zip(res.objects.point_ids_list, res_ref.objects.point_ids_list):
+        np.testing.assert_array_equal(a, b)
+    for ml_big, ml_ref in zip(res.objects.mask_list, res_ref.objects.mask_list):
+        assert [(fr, m * 20 + 100, cov) for fr, m, cov in ml_ref] == ml_big
+
+
 def test_export_artifacts(tmp_path, result_and_scene):
     from maskclustering_tpu.models.postprocess import export_artifacts
 

@@ -77,11 +77,68 @@ def test_cluster_scenes_worker_pool(scene_root):
     assert statuses[0].num_objects == 3
 
 
+def test_cluster_scenes_mesh_writes_identical_artifacts(tmp_path):
+    """cfg.mesh_shape routes the cluster step through the fused mesh path
+    and produces the same npz + object_dict artifacts as the host path."""
+    from maskclustering_tpu.run import cluster_scenes
+
+    root = str(tmp_path / "data")
+    names = []
+    for i in range(3):
+        scene = make_scene(num_boxes=3, num_frames=8, image_hw=(48, 64), seed=20 + i)
+        names.append(f"scene{i:04d}_00")
+        write_scannet_layout(scene, root, names[-1])
+    base = load_config("scannet").replace(
+        data_root=root, config_name="meshrun", step=1,
+        distance_threshold=0.05, mask_pad_multiple=32, frame_pad_multiple=4,
+        point_chunk=2048)
+
+    host = cluster_scenes(base.replace(config_name="hostrun"), names, resume=False)
+    meshed = cluster_scenes(base.replace(mesh_shape=(2, 4)), names, resume=False)
+    assert [s.status for s in host] == ["ok"] * 3
+    assert [s.status for s in meshed] == ["ok"] * 3
+
+    pred = os.path.join(root, "prediction")
+    for name in names:
+        a = np.load(os.path.join(pred, "hostrun_class_agnostic", f"{name}.npz"))
+        b = np.load(os.path.join(pred, "meshrun_class_agnostic", f"{name}.npz"))
+        for key in ("pred_masks", "pred_score", "pred_classes"):
+            np.testing.assert_array_equal(a[key], b[key])
+        od_dir = os.path.join(root, "scannet", "processed", name, "output", "object")
+        od_a = np.load(os.path.join(od_dir, "hostrun", "object_dict.npy"),
+                       allow_pickle=True).item()
+        od_b = np.load(os.path.join(od_dir, "meshrun", "object_dict.npy"),
+                       allow_pickle=True).item()
+        assert od_a.keys() == od_b.keys()
+        for k in od_a:
+            np.testing.assert_array_equal(od_a[k]["point_ids"], od_b[k]["point_ids"])
+            assert od_a[k]["mask_list"] == od_b[k]["mask_list"]
+            assert od_a[k]["repre_mask_list"] == od_b[k]["repre_mask_list"]
+
+
 def test_failure_is_captured_not_raised(scene_root):
     cfg = _cfg(scene_root)
     status = cluster_scene(cfg, "scene_does_not_exist", resume=False)
     assert status.status == "failed"
     assert "Error" in status.error or "Traceback" in status.error
+
+
+def test_missing_gt_is_a_recorded_failure(tmp_path):
+    """A mispointed gt_dir must fail the run (reference evaluate.py:407-411
+    raises), recorded in RunReport.step_errors — not a silent no-AP pass."""
+    import shutil
+
+    from maskclustering_tpu.run import run_pipeline
+
+    root = str(tmp_path / "data")
+    scene = make_scene(num_boxes=2, num_frames=8, image_hw=(48, 64), seed=5)
+    write_scannet_layout(scene, root, "scene0009_00")
+    shutil.rmtree(os.path.join(root, "scannet", "gt"))
+    cfg = _cfg(root).replace(config_name="nogt")
+    report = run_pipeline(cfg, ["scene0009_00"], steps=("cluster", "eval_ca"))
+    assert [s.status for s in report.scenes] == ["ok"]
+    assert "eval_ca" in report.step_errors
+    assert not report.ok
 
 
 def test_check_masks_reports_missing(scene_root):
@@ -91,7 +148,7 @@ def test_check_masks_reports_missing(scene_root):
 
 
 def test_seq_name_list_sources(tmp_path):
-    (tmp_path / "scannet_test.txt").write_text("a\nb\n\n")
+    (tmp_path / "scannet.txt").write_text("a\nb\n\n")
     assert get_seq_name_list("scannet", str(tmp_path)) == ["a", "b"]
     assert get_seq_name_list("scannet", str(tmp_path), "x+y") == ["x", "y"]
     with pytest.raises(FileNotFoundError):
