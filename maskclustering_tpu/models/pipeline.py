@@ -39,11 +39,24 @@ class SceneResult(NamedTuple):
     timings: Dict[str, float]
 
 
-def bucket_k_max(max_id: int, minimum: int = 63) -> int:
-    """Smallest (2^b - 1) >= max(max_id, minimum): few jit buckets, no aliasing."""
+K_MAX_CEILING = 1023
+
+
+def bucket_k_max(max_id: int, minimum: int = 63, ceiling: int = K_MAX_CEILING) -> int:
+    """Smallest (2^b - 1) >= max(max_id, minimum): few jit buckets, no aliasing.
+
+    Clamped at ``ceiling``: one corrupt id in a uint16 id-map (e.g. 65535)
+    would otherwise blow up the dense f*k_max slot tables and (M,M) matrices.
+    Ids above k_max are dropped as background by associate_frame, so a clamp
+    degrades gracefully to ignoring the corrupt masks.
+    """
     k = minimum
-    while k < max_id:
+    while k < max_id and k < ceiling:
         k = k * 2 + 1
+    if max_id > k:
+        log.warning(
+            "segmentation ids up to %d exceed k_max ceiling %d; "
+            "masks with larger ids are treated as background", max_id, k)
     return k
 
 

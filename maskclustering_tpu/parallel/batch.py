@@ -118,8 +118,11 @@ def fused_scene_objects(
     )
     n_real = tensors.num_points
     for pids in objects.point_ids_list:
-        assert pids.size == 0 or int(pids.max()) < n_real, \
-            "sentinel pad point claimed — padding invariant violated"
+        # not an assert: this guards exported artifacts and must survive -O
+        if pids.size and int(pids.max()) >= n_real:
+            raise RuntimeError(
+                "sentinel pad point claimed — padding invariant violated "
+                f"(max point id {int(pids.max())} >= num_points {n_real})")
     return SceneObjects(point_ids_list=objects.point_ids_list,
                         mask_list=objects.mask_list, num_points=n_real)
 

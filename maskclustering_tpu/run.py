@@ -125,7 +125,8 @@ def make_encoder(spec: str):
 
 def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
                 mask_command: Optional[str] = None,
-                mask_predictor=None) -> List[str]:
+                mask_predictor=None,
+                predictor_spec: Optional[str] = None) -> List[str]:
     """Step 1: ensure 2D mask id-maps exist for every scene.
 
     Mask prediction is a pluggable external stage (CropFormer in the
@@ -134,6 +135,12 @@ def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
     ``mask_predictor`` (a mask_prediction.MaskPredictor run in-process)
     or ``mask_command`` (template with ``{seq_name}``, one subprocess per
     scene, the reference's shape); otherwise they are reported.
+
+    ``predictor_spec`` (e.g. ``cfg.cropformer_path``) is resolved into a
+    predictor lazily, and only once some scene actually misses masks: every
+    reference config carries a bare ``.pth`` cropformer_path, so eagerly
+    building the predictor would crash fully-precomputed runs on a spec
+    that is never needed.
     """
     missing = []
     for seq in seq_names:
@@ -141,6 +148,17 @@ def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
         seg_dir = ds.segmentation_dir
         if not (os.path.isdir(seg_dir) and os.listdir(seg_dir)):
             missing.append(seq)
+    if missing and mask_predictor is None and predictor_spec:
+        from maskclustering_tpu.mask_prediction import predictor_from_spec
+
+        try:
+            mask_predictor = predictor_from_spec(predictor_spec)
+        except Exception:
+            # a bad spec (e.g. a reference config's bare .pth path on a
+            # machine without the adapter) must not abort the step — fall
+            # through to the mask_command / report-missing paths
+            log.exception("could not build mask predictor from spec %r",
+                          predictor_spec)
     if missing and mask_predictor is not None:
         from maskclustering_tpu.mask_prediction import predict_scene_masks
 
@@ -500,12 +518,12 @@ def run_pipeline(
         return out
 
     if "masks" in steps:
-        if mask_predictor is None and cfg.cropformer_path:
-            from maskclustering_tpu.mask_prediction import predictor_from_spec
-
-            mask_predictor = predictor_from_spec(cfg.cropformer_path)
+        # the predictor is built lazily inside check_masks (and therefore
+        # inside timed(), so spec/import failures land in step_errors rather
+        # than crashing runs whose masks are all precomputed)
         missing = timed("masks", lambda: check_masks(
-            cfg, seq_names, mask_command, mask_predictor=mask_predictor))
+            cfg, seq_names, mask_command, mask_predictor=mask_predictor,
+            predictor_spec=cfg.cropformer_path))
         if missing:
             log.warning("scenes with no 2D masks (excluded): %s", missing)
             seq_names = [s for s in seq_names if s not in set(missing)]
