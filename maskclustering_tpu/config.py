@@ -67,6 +67,10 @@ class PipelineConfig:
     # parity mode: run the reference's ball-query association
     # (models/exact_backprojection.py) instead of projective association
     use_exact_ball_query: bool = False
+    # post-process claim/ratio/mask-assign statistics on device (bit-packed
+    # transfers) instead of pulling the (F, N) tensors to host numpy; both
+    # paths produce byte-identical artifacts (tests/test_postprocess_device.py)
+    device_postprocess: bool = True
     # (scene, frame) device-mesh factorization for the fused multi-chip path
     # (parallel/batch.py); empty = single-device host pipeline
     mesh_shape: Tuple[int, ...] = ()

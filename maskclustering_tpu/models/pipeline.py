@@ -115,26 +115,46 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
     timings["cluster"] = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    first_h = np.asarray(assoc.first_id)
-    objects = postprocess_scene(
-        np.asarray(tensors.scene_points),
-        first_h,
-        np.asarray(assoc.last_id),
-        first_h > 0,  # == assoc.point_visible, minus one (F, N) transfer
-        table.frame,
-        table.mask_id,
-        np.asarray(active),
-        assignment,
-        np.asarray(result.node_visible),
-        tensors.frame_ids,
+    post_timings: Dict[str, float] = {}
+    post_kwargs = dict(
         k_max=k_max,
         point_filter_threshold=cfg.point_filter_threshold,
         dbscan_eps=cfg.dbscan_split_eps,
         dbscan_min_points=cfg.dbscan_split_min_points,
         overlap_merge_ratio=cfg.overlap_merge_ratio,
         min_masks_per_object=cfg.min_masks_per_object,
-        timings=(post_timings := {}),
+        timings=post_timings,
     )
+    if cfg.device_postprocess:
+        from maskclustering_tpu.models.postprocess_device import postprocess_scene_device
+
+        objects = postprocess_scene_device(
+            np.asarray(tensors.scene_points),
+            assoc.first_id,
+            assoc.last_id,
+            table.frame,
+            table.mask_id,
+            np.asarray(active),
+            assignment,
+            result.node_visible,
+            tensors.frame_ids,
+            **post_kwargs,
+        )
+    else:
+        first_h = np.asarray(assoc.first_id)
+        objects = postprocess_scene(
+            np.asarray(tensors.scene_points),
+            first_h,
+            np.asarray(assoc.last_id),
+            first_h > 0,  # == assoc.point_visible, minus one (F, N) transfer
+            table.frame,
+            table.mask_id,
+            np.asarray(active),
+            assignment,
+            np.asarray(result.node_visible),
+            tensors.frame_ids,
+            **post_kwargs,
+        )
     timings["postprocess"] = time.perf_counter() - t0
     timings.update({f"post.{k}": v for k, v in post_timings.items()})
 

@@ -1,0 +1,324 @@
+"""Device-side post-process: node/claim statistics as on-TPU tensor passes.
+
+The host post-process (models/postprocess.py) reproduces the reference's
+pipeline (reference utils/post_process.py:40-170) with vectorized numpy over
+COO claim structures — but building those structures requires pulling the
+(F, N) ``first_id``/``last_id`` tensors off the device (hundreds of MB per
+scene) and running multi-million-row nonzero/sort passes on host. At bench
+scale that is 12-16 s/scene, the dominant pipeline cost.
+
+Everything except the per-object DBSCAN split is segment arithmetic over
+tensors the device already holds, so this module keeps it there:
+
+- ``_node_stats_kernel``: one lax.scan over frames accumulates, for every
+  (live representative r, point p): ``claimed`` (p is a node point of r),
+  ``num`` (frames where p is claimed by a node mask with node-visibility,
+  the OVIR detection-ratio numerator, reference post_process.py:56-76) and
+  ``den`` (node-visible frames where p is visible at all). Claim ids map to
+  dense representative indices through a tiny per-frame lookup table; the
+  per-frame (R, N) updates are one-hot products — vector ops, no scatters,
+  no gathers from large tables (both are slow on TPU; measured in
+  scripts/micro_tpu.py).
+- results return as bit-packed uint8 planes (8x smaller transfer).
+- host runs DBSCAN per representative on the compact node point lists
+  (reference post_process.py:104-123 uses Open3D's C++ DBSCAN on host too)
+  and uploads a compact (point id, global group) list back.
+- ``_mask_group_counts_kernel``: a second scan over frames counts each
+  mask's claims per DBSCAN group via (K, N) x (N, S) matmuls on the MXU and
+  reduces to the best group + count per mask on device, replacing the
+  reference's per-(mask x group) intersect1d loop (post_process.py:~150).
+
+Net device->host traffic: ~2 x R_pad x N/8 bytes + O(M_pad) scalars instead
+of 2-3 (F, N) int32 tensors.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from maskclustering_tpu.models.postprocess import (
+    SceneObjects,
+    _merge_overlapping,
+    _PhaseTimer,
+)
+from maskclustering_tpu.ops.dbscan import dbscan_labels
+
+
+def _bucket_pow2(value: int, minimum: int = 8) -> int:
+    """Smallest power-of-two >= max(value, minimum) — jit shape buckets."""
+    b = minimum
+    while b < value:
+        b *= 2
+    return b
+
+
+@functools.partial(jax.jit, static_argnames=("r_pad", "point_filter_threshold"))
+def _node_stats_kernel(
+    first: jnp.ndarray,  # (F, N) int32 smallest valid claiming id per (frame, point)
+    last: jnp.ndarray,  # (F, N) int32 largest valid claiming id
+    rep_tab: jnp.ndarray,  # (F, K+2) int32: local mask id -> dense live-rep index, -1 none
+    node_visible: jnp.ndarray,  # (M_pad, F) bool per-representative visibility
+    live_slots: jnp.ndarray,  # (r_pad,) int32 global slot of each live rep (pad: 0)
+    live_valid: jnp.ndarray,  # (r_pad,) bool
+    *,
+    r_pad: int,
+    point_filter_threshold: float,
+):
+    """Per-(rep, point) claim statistics, bit-packed.
+
+    Returns (claimed_packed, ratio_packed, nv_rep): (r_pad, N8/8) uint8 x2
+    plus the (r_pad, F) bool node-visibility rows for the live reps.
+    """
+    f, n = first.shape
+    nv_rep = jnp.take(node_visible, live_slots, axis=0) & live_valid[:, None]
+
+    def step(carry, inp):
+        claimed, num, den = carry
+        a, b, rt, nv_f = inp
+        rep_a = jnp.take(rt, a)  # (N,) dense rep index or -1
+        rep_b = jnp.take(rt, b)
+        oh_a = jax.nn.one_hot(rep_a, r_pad, axis=0, dtype=jnp.float32)  # (R, N)
+        oh_b = jax.nn.one_hot(rep_b, r_pad, axis=0, dtype=jnp.float32)
+        # a claim by either extreme id of the cell; max() dedupes two masks of
+        # the same rep claiming the same (frame, point) — one triple, counted
+        # once (matches the host path's unique-(rep,point,frame) sort)
+        both = jnp.maximum(oh_a, oh_b)
+        nvf = nv_f.astype(jnp.float32)[:, None]
+        claimed = claimed | (both > 0)
+        num = num + both * nvf
+        den = den + nvf * (a > 0).astype(jnp.float32)[None, :]
+        return (claimed, num, den), None
+
+    init = (
+        jnp.zeros((r_pad, n), bool),
+        jnp.zeros((r_pad, n), jnp.float32),
+        jnp.zeros((r_pad, n), jnp.float32),
+    )
+    (claimed, num, den), _ = jax.lax.scan(
+        step, init, (first, last, rep_tab, nv_rep.T))
+
+    ratio_ok = num / (den + 1e-6) > point_filter_threshold
+    return _pack_bits(claimed), _pack_bits(ratio_ok), nv_rep
+
+
+def _pack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """(R, N) bool -> (R, ceil(N/8)) uint8, np.unpackbits-compatible (big-endian)."""
+    r, n = x.shape
+    n8 = -(-n // 8) * 8
+    xp = jnp.pad(x, ((0, 0), (0, n8 - n))).reshape(r, n8 // 8, 8)
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.int32)
+    return jnp.sum(xp.astype(jnp.int32) * weights, axis=-1).astype(jnp.uint8)
+
+
+def _unpack_bits(packed: np.ndarray, n: int) -> np.ndarray:
+    return np.unpackbits(np.asarray(packed), axis=1)[:, :n].astype(bool)
+
+
+@functools.partial(jax.jit, static_argnames=("k2", "s_pad"))
+def _mask_group_counts_kernel(
+    first: jnp.ndarray,  # (F, N) int32
+    last: jnp.ndarray,  # (F, N) int32
+    pt_ids: jnp.ndarray,  # (C_pad,) int32 node point ids (pad: N — dropped)
+    pt_group: jnp.ndarray,  # (C_pad,) int32 global group ids (pad: s_pad — dropped)
+    mask_flat: jnp.ndarray,  # (M_pad,) int32 = frame * k2 + id of each mask slot
+    group_lo: jnp.ndarray,  # (M_pad,) int32 first global group of the mask's rep
+    group_hi: jnp.ndarray,  # (M_pad,) int32 one past the rep's last group (0 width = dead)
+    *,
+    k2: int,
+    s_pad: int,
+):
+    """Best DBSCAN group (+ claim count) per mask slot.
+
+    counts[m, g] = |claims of mask m with group label g| computed as per-frame
+    one-hot matmuls against the (N, s_pad) group membership plane; the argmax
+    is restricted to the mask's own rep's group range (ties -> lowest group,
+    like the host path's packed reduceat).
+    """
+    f, n = first.shape
+    goh = jnp.zeros((n, s_pad), jnp.bfloat16)
+    goh = goh.at[pt_ids, pt_group].set(1.0, mode="drop")
+
+    def step(_, inp):
+        a, b = inp
+        # a cell where last == first holds ONE claim (one mask) — drop b
+        b = jnp.where(b == a, k2 - 1, b)  # k2-1 is an unused sentinel row
+        oh_a = jax.nn.one_hot(a, k2, axis=0, dtype=jnp.bfloat16)  # (k2, N)
+        oh_b = jax.nn.one_hot(b, k2, axis=0, dtype=jnp.bfloat16)
+        cnt = jnp.dot(oh_a, goh, preferred_element_type=jnp.float32)
+        cnt = cnt + jnp.dot(oh_b, goh, preferred_element_type=jnp.float32)
+        return None, cnt  # (k2, s_pad) exact integer counts in f32
+
+    _, counts = jax.lax.scan(step, None, (first, last))  # (F, k2, s_pad)
+    per_mask = jnp.take(counts.reshape(f * k2, s_pad),
+                        jnp.clip(mask_flat, 0, f * k2 - 1), axis=0)  # (M_pad, S)
+    slots = jnp.arange(s_pad, dtype=jnp.int32)[None, :]
+    in_range = (slots >= group_lo[:, None]) & (slots < group_hi[:, None])
+    masked = jnp.where(in_range, per_mask, -1.0)
+    best_group = jnp.argmax(masked, axis=1).astype(jnp.int32)
+    best_count = jnp.max(masked, axis=1)
+    return best_group, best_count
+
+
+def postprocess_scene_device(
+    scene_points: np.ndarray,  # (N, 3) float32, host
+    first: jnp.ndarray,  # (F, N) int32, device
+    last: jnp.ndarray,  # (F, N) int32, device
+    mask_frame: np.ndarray,  # (M_pad,) int32, host
+    mask_id: np.ndarray,  # (M_pad,) int32, host (-1 padding)
+    mask_active: np.ndarray,  # (M_pad,) bool, host
+    assignment: np.ndarray,  # (M_pad,) int32, host
+    node_visible: jnp.ndarray,  # (M_pad, F) bool, device
+    frame_ids: Sequence,  # original frame identifiers, len >= F real frames
+    *,
+    k_max: int = 127,
+    point_filter_threshold: float = 0.5,
+    dbscan_eps: float = 0.1,
+    dbscan_min_points: int = 4,
+    overlap_merge_ratio: float = 0.8,
+    min_masks_per_object: int = 2,
+    timings: Optional[Dict[str, float]] = None,
+) -> SceneObjects:
+    """Same contract and outputs as postprocess_scene, minus the (F, N) pulls.
+
+    first/last/node_visible stay on device; only bit-packed (R, N/8) planes
+    and O(M_pad) scalars cross the host boundary. The DBSCAN split and the
+    final merge/emit run on host exactly as in the host path, so artifacts
+    are byte-identical (asserted by tests/test_postprocess_device.py).
+    """
+    t = _PhaseTimer(timings)
+    f, n = first.shape
+    m_pad = mask_frame.shape[0]
+    k2 = k_max + 2
+
+    # ---- live representatives (>= min_masks members) ----
+    sizes = np.bincount(assignment[mask_active], minlength=m_pad)
+    reps = np.nonzero(sizes >= min_masks_per_object)[0]
+    if len(reps) == 0:
+        t.mark("claims")
+        return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
+    r_pad = _bucket_pow2(len(reps))
+    rep_lut = np.full(m_pad, -1, dtype=np.int32)
+    rep_lut[reps] = np.arange(len(reps), dtype=np.int32)
+
+    # local (frame, id) -> dense live-rep index of the claiming mask's cluster
+    gmap = np.full((f, k2), -1, dtype=np.int64)
+    act_idx = np.nonzero(mask_active)[0]
+    gmap[mask_frame[act_idx], mask_id[act_idx]] = act_idx
+    rep_tab = np.full((f, k2), -1, dtype=np.int32)
+    mapped = gmap >= 0
+    rep_tab[mapped] = rep_lut[assignment[gmap[mapped]]]
+
+    live_slots = np.zeros(r_pad, dtype=np.int32)
+    live_slots[: len(reps)] = reps
+    live_valid = np.zeros(r_pad, dtype=bool)
+    live_valid[: len(reps)] = True
+
+    claimed_p, ratio_p, nv_rep_d = _node_stats_kernel(
+        first, last, jnp.asarray(rep_tab), node_visible,
+        jnp.asarray(live_slots), jnp.asarray(live_valid),
+        r_pad=r_pad, point_filter_threshold=float(point_filter_threshold))
+    claimed = _unpack_bits(np.asarray(claimed_p), n)
+    ratio_ok = _unpack_bits(np.asarray(ratio_p), n)
+    nv_any = np.asarray(nv_rep_d).any(axis=1)
+    t.mark("claims")
+
+    # ---- DBSCAN split per live rep (host, native C++/sklearn) ----
+    # group numbering matches the host path: offsets accumulate over reps in
+    # ascending slot order, label 0 (noise) is kept as its own candidate
+    rep_slices: List[Tuple[int, int, np.ndarray, np.ndarray]] = []
+    goff_by_ridx = np.zeros(len(reps), dtype=np.int64)
+    ngrp_by_ridx = np.zeros(len(reps), dtype=np.int64)
+    pt_chunks: List[np.ndarray] = []
+    grp_chunks: List[np.ndarray] = []
+    group_offset = 0
+    for ridx in range(len(reps)):
+        if not nv_any[ridx]:
+            continue
+        node_pts = np.nonzero(claimed[ridx])[0].astype(np.int32)
+        if len(node_pts) == 0:
+            continue
+        labels = dbscan_labels(scene_points[node_pts], eps=dbscan_eps,
+                               min_points=dbscan_min_points)
+        groups = (labels + 1).astype(np.int64)
+        ngrp = int(groups.max()) + 1
+        rep_slices.append((ridx, group_offset, node_pts, groups))
+        goff_by_ridx[ridx] = group_offset
+        ngrp_by_ridx[ridx] = ngrp
+        pt_chunks.append(node_pts)
+        grp_chunks.append(group_offset + groups)
+        group_offset += ngrp
+    t.mark("dbscan")
+
+    if group_offset == 0:
+        return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
+    s_pad = _bucket_pow2(group_offset)
+    all_pts = np.concatenate(pt_chunks)
+    all_grps = np.concatenate(grp_chunks)
+    group_size = np.bincount(all_grps, minlength=s_pad)
+    c_pad = _bucket_pow2(len(all_pts), minimum=1024)
+    pt_ids = np.full(c_pad, n, dtype=np.int32)  # sentinel n -> dropped scatter
+    pt_grp = np.full(c_pad, s_pad, dtype=np.int32)
+    pt_ids[: len(all_pts)] = all_pts
+    pt_grp[: len(all_pts)] = all_grps
+
+    # per-mask global group range of its rep (0-width for dead masks)
+    ridx_of_mask = rep_lut[assignment]
+    alive = mask_active & (ridx_of_mask >= 0)
+    glo = np.zeros(m_pad, dtype=np.int32)
+    ghi = np.zeros(m_pad, dtype=np.int32)
+    glo[alive] = goff_by_ridx[ridx_of_mask[alive]]
+    ghi[alive] = glo[alive] + ngrp_by_ridx[ridx_of_mask[alive]]
+    mask_flat = (mask_frame.astype(np.int64) * k2
+                 + np.clip(mask_id, 0, k2 - 1)).astype(np.int32)
+    mask_flat[~alive] = 0
+
+    best_group_d, best_count_d = _mask_group_counts_kernel(
+        first, last, jnp.asarray(pt_ids), jnp.asarray(pt_grp),
+        jnp.asarray(mask_flat), jnp.asarray(glo), jnp.asarray(ghi),
+        k2=k2, s_pad=s_pad)
+    best_group = np.asarray(best_group_d)
+    best_count = np.asarray(best_count_d)
+    t.mark("mask_assign")
+
+    # ---- assemble mask lists per global group (ascending mask order) ----
+    obj_masks: Dict[int, List[Tuple]] = {}
+    for m in np.nonzero(alive & (ghi > glo))[0]:
+        cnt = best_count[m]
+        if cnt <= 0:  # no surviving claims (all mid-id overlaps)
+            continue
+        gl = int(best_group[m])
+        obj_masks.setdefault(gl, []).append(
+            (frame_ids[mask_frame[m]], int(mask_id[m]),
+             float(cnt / group_size[gl])))
+
+    # ---- emit candidate objects (same order/filters as the host path) ----
+    total_point_ids: List[np.ndarray] = []
+    total_bboxes: List[Tuple[np.ndarray, np.ndarray]] = []
+    total_masks: List[List[Tuple]] = []
+    for ridx, goff, node_pts, groups in rep_slices:
+        r_ok = ratio_ok[ridx][node_pts]
+        for g in range(int(groups.max()) + 1):
+            sel = groups == g
+            if not sel.any():
+                continue
+            masks_g = obj_masks.get(goff + g, [])
+            obj_pts_all = node_pts[sel]
+            obj_pts = obj_pts_all[r_ok[sel]]
+            if len(obj_pts) == 0 or len(masks_g) < min_masks_per_object:
+                continue
+            pts3d = scene_points[obj_pts_all]
+            total_point_ids.append(obj_pts)
+            total_bboxes.append((pts3d.min(axis=0), pts3d.max(axis=0)))
+            total_masks.append(masks_g)
+    t.mark("emit")
+
+    point_ids_list, mask_list = _merge_overlapping(
+        total_point_ids, total_bboxes, total_masks, overlap_merge_ratio)
+    t.mark("merge")
+    return SceneObjects(point_ids_list=point_ids_list, mask_list=mask_list,
+                        num_points=n)

@@ -90,24 +90,13 @@ def fused_scene_objects(
     artifact bytes match the single-chip path because both enumerate masks
     ascending by (frame, id) and representatives are min-index labels.
     """
-    first = np.asarray(out.first_id[index])
-    f_pad = first.shape[0]
+    f_pad, n_pad = out.first_id.shape[1], out.first_id.shape[2]
     mask_frame = np.repeat(np.arange(f_pad, dtype=np.int32), k_max)
     mask_id = np.tile(np.arange(1, k_max + 1, dtype=np.int32), f_pad)
     frame_ids = list(tensors.frame_ids)
     frame_ids += [None] * (f_pad - len(frame_ids))
-
-    objects = postprocess_scene(
-        np.asarray(out_scene_points(tensors, first.shape[1])),
-        first,
-        np.asarray(out.last_id[index]),
-        first > 0,
-        mask_frame,
-        mask_id,
-        np.asarray(out.mask_active[index]),
-        np.asarray(out.assignment[index]),
-        np.asarray(out.node_visible[index]),
-        frame_ids,
+    scene_points = np.asarray(out_scene_points(tensors, n_pad))
+    kwargs = dict(
         k_max=k_max,
         point_filter_threshold=cfg.point_filter_threshold,
         dbscan_eps=cfg.dbscan_split_eps,
@@ -116,6 +105,37 @@ def fused_scene_objects(
         min_masks_per_object=cfg.min_masks_per_object,
         timings=timings,
     )
+
+    if cfg.device_postprocess:
+        from maskclustering_tpu.models.postprocess_device import postprocess_scene_device
+
+        objects = postprocess_scene_device(
+            scene_points,
+            out.first_id[index],
+            out.last_id[index],
+            mask_frame,
+            mask_id,
+            np.asarray(out.mask_active[index]),
+            np.asarray(out.assignment[index]),
+            out.node_visible[index],
+            frame_ids,
+            **kwargs,
+        )
+    else:
+        first = np.asarray(out.first_id[index])
+        objects = postprocess_scene(
+            scene_points,
+            first,
+            np.asarray(out.last_id[index]),
+            first > 0,
+            mask_frame,
+            mask_id,
+            np.asarray(out.mask_active[index]),
+            np.asarray(out.assignment[index]),
+            np.asarray(out.node_visible[index]),
+            frame_ids,
+            **kwargs,
+        )
     n_real = tensors.num_points
     for pids in objects.point_ids_list:
         # not an assert: this guards exported artifacts and must survive -O

@@ -1,0 +1,131 @@
+"""Micro-benchmarks of TPU primitive costs that drive postprocess/association design.
+
+Run on the live chip: python scripts/micro_tpu.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    # force a real device->host roundtrip of one element: block_until_ready
+    # can be a no-op on tunneled platforms
+    for x in leaves:
+        np.asarray(jax.device_get(x.ravel()[:1] if hasattr(x, "ravel") else x))
+
+
+def timeit(name, fn, *args, iters=5):
+    _sync(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        _sync(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:55s} {dt*1e3:9.2f} ms")
+    return dt
+
+
+def main():
+    print("devices:", jax.devices())
+    rng = np.random.default_rng(0)
+    F, N, R = 150, 192 * 1024, 128
+    HW = 240 * 320
+
+    # 1. segment_sum: claims-scale scatter into R*N segments
+    ids_big = jnp.asarray(rng.integers(0, R * N, size=2 * F * N // 8, dtype=np.int32))  # 7.3M updates
+    data = jnp.ones_like(ids_big, dtype=jnp.int32)
+    f = jax.jit(lambda d, i: jax.ops.segment_sum(d, i, num_segments=R * N))
+    timeit(f"segment_sum 7.3M -> {R*N/1e6:.1f}M segs", f, data, ids_big, iters=2)
+
+    # 1b. segment_sum into small segment count (mask_assign slots)
+    ids_small = jnp.asarray(rng.integers(0, 65536, size=2 * F * N, dtype=np.int32))  # 58M updates
+    d2 = jnp.ones_like(ids_small, dtype=jnp.int32)
+    f2 = jax.jit(lambda d, i: jax.ops.segment_sum(d, i, num_segments=65536))
+    timeit("segment_sum 58M -> 64k segs", f2, d2, ids_small, iters=2)
+
+    # 2. per-rep dense loop: R x (F,N) compares via lax.map
+    A = jnp.asarray(rng.integers(-1, R, size=(F, N), dtype=np.int16))
+    nv = jnp.asarray(rng.random((R, F)) < 0.5)
+
+    def perrep(A, nv):
+        def one(r):
+            eq = A == r.astype(jnp.int16)
+            claimed = jnp.any(eq, axis=0)
+            num = jnp.sum(eq & nv[r][:, None], axis=0, dtype=jnp.int32)
+            return claimed, num
+        return jax.lax.map(one, jnp.arange(R))
+    f3 = jax.jit(perrep)
+    timeit(f"per-rep loop R={R} over (F,N) int16", f3, A, nv, iters=2)
+
+    # 3. column sort along frame axis (2F, N)
+    K2 = jnp.asarray(rng.integers(0, R, size=(2 * F, N), dtype=np.int32))
+    f4 = jax.jit(lambda k: jnp.sort(k, axis=0))
+    timeit("sort (300, 192k) along axis0", f4, K2, iters=2)
+
+    # 4. big flat sort (claims sort, node_structs scale)
+    flat = jnp.asarray(rng.integers(0, 2**31 - 1, size=2 * F * N, dtype=np.int32))
+    f5 = jax.jit(jnp.sort)
+    timeit("flat sort 58M int32", f5, flat, iters=1)
+
+    # 5. random gather: association window reads (N gathers from HW table) x9 x3
+    table = jnp.asarray(rng.random(HW, dtype=np.float32))
+    idx = jnp.asarray(rng.integers(0, HW, size=N, dtype=np.int32))
+
+    def gather9x3(t, i):
+        acc = jnp.zeros(N)
+        for k in range(27):
+            acc = acc + jnp.take(t, (i + k) % HW)
+        return acc
+    f6 = jax.jit(gather9x3)
+    timeit("27x take(192k from 76.8k)  [1 frame assoc]", f6, table, idx, iters=5)
+
+    # 6. matmul (R,F)@(F,N) bf16
+    nvb = nv.astype(jnp.bfloat16)
+    pv = jnp.asarray(rng.random((F, N)) < 0.5).astype(jnp.bfloat16)
+    f7 = jax.jit(lambda a, b: jnp.matmul(a, b, preferred_element_type=jnp.float32))
+    timeit("matmul (128,150)@(150,192k) bf16", f7, nvb, pv, iters=5)
+
+    # 7. one-hot matmul claims: onehot(A) per frame scan accumulate
+    def onehot_scan(A, nv):
+        def step(acc, fa):
+            a, nvf = fa
+            oh = jax.nn.one_hot(a, R, dtype=jnp.bfloat16, axis=0)  # (R, N)
+            return acc + oh * nvf[:, None], None
+        acc0 = jnp.zeros((R, N), jnp.bfloat16)
+        out, _ = jax.lax.scan(step, acc0, (A.astype(jnp.int32), nv.T.astype(jnp.bfloat16)))
+        return out
+    f8 = jax.jit(onehot_scan)
+    timeit("scan-F onehot accumulate (R,N)", f8, A, nv, iters=2)
+
+    # 8. scatter .at[].add columns: (F scans of N-updates into (R,N))
+    def scatter_cols(A, nv):
+        def step(acc, fa):
+            a, nvf = fa
+            ac = jnp.clip(a, 0, R - 1).astype(jnp.int32)
+            w = jnp.take(nvf, ac).astype(jnp.int32)
+            return acc.at[ac, jnp.arange(N)].add(w), None
+        out, _ = jax.lax.scan(step, jnp.zeros((R, N), jnp.int32),
+                              (A, nv.T.astype(jnp.int32)))
+        return out
+    f9 = jax.jit(scatter_cols)
+    timeit("scan-F scatter-add cols into (R,N)", f9, A, nv, iters=1)
+
+
+if __name__ == "__main__":
+    main()
+
+
+def overhead():
+    import jax, numpy as np, time
+    import jax.numpy as jnp
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(())
+    _sync(f(x))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        _sync(f(x))
+    print(f"sync+trivial-op roundtrip: {(time.perf_counter()-t0)/10*1e3:.2f} ms")
+    # amortized: run op 10x chained inside one jit to separate compute from latency
