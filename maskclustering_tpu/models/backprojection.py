@@ -30,22 +30,72 @@ Mask-level filters mirror the reference:
   (FEW_POINTS_THRESHOLD, mask_backprojection.py:101-110);
 - masks whose backprojection is absent from the reconstructed cloud are
   dropped by a coverage test. Coverage here = (#scene points claimed) /
-  (#occupied distance_threshold-sized voxels of the mask's backprojection),
-  a density-calibrated analog of the reference's "fraction of mask points
-  with a scene neighbor" (mask_backprojection.py:143-145). The exact
-  ball-query semantics are available via ops/neighbor.py in parity mode.
+  (#occupied voxels of the mask's backprojection), a density-calibrated
+  analog of the reference's "fraction of downsampled mask points with a
+  scene neighbor" (mask_backprojection.py:105,143-145). The voxel size is
+  ``max(distance_threshold, scene point spacing)``: with voxels at the
+  cloud's own spacing, a fully reconstructed mask has ~1 claimed point per
+  occupied voxel regardless of how dense the scan is, mirroring the
+  reference's ratio (which self-calibrates because both its numerator and
+  denominator count downsampled MASK points). A fixed distance_threshold
+  voxel would undercount coverage ~4x on a 2 cm cloud at the reference's
+  radius 0.01 and reject every mask. The exact ball-query semantics are
+  available via models/exact_backprojection.py in parity mode.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from maskclustering_tpu.ops.geometry import invert_se3, unproject_depth
+
+
+@functools.partial(jax.jit, static_argnames=("sample", "chunk"))
+def estimate_spacing(points: jnp.ndarray, *, sample: int = 2048,
+                     chunk: int = 32768) -> jnp.ndarray:
+    """Median nearest-neighbor distance of a point sample vs the full cloud.
+
+    Calibrates the coverage voxel size to the reconstruction's density (the
+    reference's analog is voxel-downsampling mask points before its coverage
+    ratio, mask_backprojection.py:105). Two padding artifacts are excluded
+    from the median: zero distances (exact duplicates from tile-padding, or
+    sentinel pad points stacked at one coordinate) and absurdly large ones
+    (a sentinel's distance to the nearest REAL point — finite and huge; in a
+    majority-padded cloud of the fused batch path those would otherwise
+    dominate the median). No indoor reconstruction has metre-scale spacing,
+    so entries >= 10 m count as padding.
+    """
+    n = points.shape[0]
+    stride = max(n // sample, 1)
+    sub = points[::stride][:sample]  # (S, 3); may be < sample for tiny clouds
+    s = sub.shape[0]
+    best = jnp.full((s,), jnp.inf, jnp.float32)
+    n_chunks = -(-n // chunk)
+    padded = jnp.pad(points, ((0, n_chunks * chunk - n), (0, 0)),
+                     constant_values=jnp.inf)
+
+    def body(best, c):
+        blk = jax.lax.dynamic_slice(padded, (c * chunk, 0), (chunk, 3))
+        d2 = jnp.sum((sub[:, None, :] - blk[None, :, :]) ** 2, axis=-1)
+        # self / exact duplicates (d=0) and inf-pad rows (inf or nan) -> inf
+        d2 = jnp.where(jnp.isfinite(d2) & (d2 > 1e-12), d2, jnp.inf)
+        return jnp.minimum(best, jnp.min(d2, axis=1)), None
+
+    best, _ = jax.lax.scan(body, best, jnp.arange(n_chunks))
+    d = jnp.sqrt(best)
+    valid = jnp.isfinite(d) & (d < 10.0)
+    # median over valid entries: sort with inf padding, index count/2
+    ds = jnp.sort(jnp.where(valid, d, jnp.inf))
+    cnt = jnp.sum(valid)
+    med = ds[jnp.clip(cnt // 2, 0, s - 1)]
+    # all-padding degenerate sample: fall back to 0 (callers take
+    # max(distance_threshold, estimate))
+    return jnp.where(cnt > 0, med, 0.0)
 
 
 class FrameAssociation(NamedTuple):
@@ -82,6 +132,19 @@ def _hash_voxel(keys: jnp.ndarray, bits: int) -> jnp.ndarray:
     return jnp.abs(h) & ((1 << bits) - 1)
 
 
+def _counts_by_id(weights: jnp.ndarray, ids: jnp.ndarray, num_ids: int) -> jnp.ndarray:
+    """Per-id weighted counts as a one-hot matvec (MXU), not a scatter.
+
+    TPU scatters cost ~6.6 ns/element (scripts/micro_tpu.py) — at N x window
+    candidates per frame that is ~10 ms/frame; the (E, num_ids) one-hot
+    contraction is bandwidth-bound and ~100x cheaper. Exact: 0/1 bf16
+    operands with f32 accumulation.
+    """
+    oh = jax.nn.one_hot(ids, num_ids, dtype=jnp.bfloat16)
+    return jnp.dot(weights.astype(jnp.bfloat16), oh,
+                   preferred_element_type=jnp.float32)
+
+
 def _count_distinct_per_mask(ids: jnp.ndarray, vox_hash: jnp.ndarray, valid: jnp.ndarray,
                              num_ids: int, bits: int) -> jnp.ndarray:
     """Count distinct (id, voxel-hash) pairs per id via one sort (no scatter).
@@ -97,7 +160,7 @@ def _count_distinct_per_mask(ids: jnp.ndarray, vox_hash: jnp.ndarray, valid: jnp
     skey = jnp.sort(key)
     new = jnp.concatenate([jnp.array([True]), skey[1:] != skey[:-1]])
     sid = skey >> bits
-    return jax.ops.segment_sum(new.astype(jnp.int32), sid, num_segments=num_ids)
+    return _counts_by_id(new, sid, num_ids)
 
 
 @functools.partial(
@@ -112,6 +175,7 @@ def associate_frame(
     intrinsics: jnp.ndarray,  # (3, 3)
     cam_to_world: jnp.ndarray,  # (4, 4)
     frame_valid: jnp.ndarray,  # () bool
+    vox_size: Optional[jnp.ndarray] = None,  # () f32 coverage voxel size (traced)
     *,
     k_max: int = 127,
     window: int = 1,
@@ -144,36 +208,58 @@ def associate_frame(
     vi = jnp.round(py / safe_z * fy + cy).astype(jnp.int32)
 
     # ---- gather the pixel window; record claiming mask id per candidate ----
-    offsets = [(du, dv) for dv in range(-window, window + 1) for du in range(-window, window + 1)]
+    # One take per window ROW instead of one per (pixel, channel): depth and
+    # seg interleave into a (H*W, 2*(2w+1)) table whose row at (v, u) holds
+    # the horizontal strip [u-w .. u+w] of both channels, so a single gather
+    # fetches the whole strip. Gathers dominate association on TPU
+    # (~1.5 ms per 192k-index take, scripts/micro_tpu.py); this cuts them
+    # from 3*(2w+1)^2 to (2w+1) per frame. Horizontal out-of-bounds pixels
+    # read the zero padding (depth 0 -> never claims), replacing the
+    # per-offset bounds mask.
+    ww = 2 * window + 1
+    dz = jnp.where(depth_ok, depth, 0.0)
+    padded = jnp.pad(
+        jnp.stack([dz, seg.astype(jnp.float32)], axis=-1),
+        ((0, 0), (window, window), (0, 0)))  # (H, W+2w, 2)
+    strips = jnp.concatenate(
+        [padded[:, k : k + w] for k in range(ww)], axis=-1)  # (H, W, 2*ww)
+    strip_tab = strips.reshape(h * w, 2 * ww)
+
     r2 = distance_threshold * distance_threshold
+    # clip the center column; strips at a clipped center still contain every
+    # in-bounds pixel of the ORIGINAL [ui-w .. ui+w] window (the clip shifts
+    # by <= window), and the |u - ui| <= window test keeps exactly those —
+    # border behavior is identical to the per-offset formulation
+    uc = jnp.clip(ui, 0, w - 1)
     cand_cols = []
-    for du, dv in offsets:
-        uu = ui + du
+    for dv in range(-window, window + 1):
         vv = vi + dv
-        inb = (uu >= 0) & (uu < w) & (vv >= 0) & (vv < h) & in_front
-        uc = jnp.clip(uu, 0, w - 1)
+        row_ok = in_front & (vv >= 0) & (vv < h)
         vc = jnp.clip(vv, 0, h - 1)
-        flat = vc * w + uc
-        d = jnp.take(depth.reshape(-1), flat)
-        s = jnp.take(seg.reshape(-1), flat)
-        dok = jnp.take(depth_ok.reshape(-1), flat)
-        # 3D position of this pixel's backprojection, in camera frame
-        qx = (uc - cx) * d / fx
-        qy = (vc - cy) * d / fy
-        dist2 = (qx - px) ** 2 + (qy - py) ** 2 + (d - pz) ** 2
-        claim = inb & dok & (s > 0) & (dist2 <= r2)
-        cand_cols.append(jnp.where(claim, s, 0))
+        g = jnp.take(strip_tab, vc * w + uc, axis=0)  # (N, 2*ww)
+        for j, du in enumerate(range(-window, window + 1)):
+            d = g[:, 2 * j]
+            s = g[:, 2 * j + 1].astype(jnp.int32)
+            win_ok = jnp.abs(uc + du - ui) <= window
+            # 3D position of this pixel's backprojection, in camera frame
+            qx = (uc + du - cx) * d / fx
+            qy = (vc - cy) * d / fy
+            dist2 = (qx - px) ** 2 + (qy - py) ** 2 + (d - pz) ** 2
+            claim = row_ok & win_ok & (d > 0) & (s > 0) & (dist2 <= r2)
+            cand_cols.append(jnp.where(claim, s, 0))
     cand = jnp.stack(cand_cols, axis=1)  # (N, (2w+1)^2) claiming mask ids, 0 = none
 
     # ---- per-mask statistics ----
     seg_flat = seg.reshape(-1)
     dok_flat = depth_ok.reshape(-1)
     pix_ids = jnp.where(dok_flat, seg_flat, 0)
-    n_pixels = jax.ops.segment_sum(jnp.ones_like(pix_ids), pix_ids, num_segments=k_max + 1)
+    n_pixels = _counts_by_id(jnp.ones_like(pix_ids), pix_ids, k_max + 1)
 
     # occupied voxels of the mask's backprojected pixels (coverage denominator)
+    if vox_size is None:
+        vox_size = jnp.float32(distance_threshold)
     world_pix, _ = unproject_depth(depth, intrinsics, cam_to_world, depth_trunc)
-    vox = jnp.floor(world_pix.reshape(-1, 3) / distance_threshold).astype(jnp.int32)
+    vox = jnp.floor(world_pix.reshape(-1, 3) / vox_size).astype(jnp.int32)
     bits = _hash_bits(k_max + 1)
     n_voxels = _count_distinct_per_mask(pix_ids, _hash_voxel(vox, bits),
                                         dok_flat & (seg_flat > 0), k_max + 1, bits)
@@ -185,9 +271,7 @@ def associate_frame(
         [cand_sorted[:, :1] > 0, (cand_sorted[:, 1:] != cand_sorted[:, :-1]) & (cand_sorted[:, 1:] > 0)],
         axis=1,
     )
-    n_claimed = jax.ops.segment_sum(
-        row_new.reshape(-1).astype(jnp.int32), cand_sorted.reshape(-1), num_segments=k_max + 1
-    )
+    n_claimed = _counts_by_id(row_new.reshape(-1), cand_sorted.reshape(-1), k_max + 1)
 
     coverage = n_claimed / jnp.maximum(n_voxels, 1)
     mask_valid = (
@@ -212,9 +296,9 @@ def associate_frame(
         first_id=first,
         last_id=last,
         mask_valid=mask_valid,
-        n_pixels=n_pixels,
-        n_voxels=n_voxels,
-        n_claimed=n_claimed,
+        n_pixels=n_pixels.astype(jnp.int32),
+        n_voxels=n_voxels.astype(jnp.int32),
+        n_claimed=n_claimed.astype(jnp.int32),
     )
 
 
@@ -225,6 +309,7 @@ def _associate_scene_impl(
     intrinsics: jnp.ndarray,  # (F, 3, 3)
     cam_to_world: jnp.ndarray,  # (F, 4, 4)
     frame_valid: jnp.ndarray,  # (F,) bool
+    vox_size: Optional[jnp.ndarray] = None,  # () f32, traced
     *,
     k_max: int = 127,
     window: int = 1,
@@ -244,7 +329,7 @@ def _associate_scene_impl(
     def one(args):
         depth, seg, intr, c2w, fv = args
         fa = associate_frame(
-            scene_points, depth, seg, intr, c2w, fv,
+            scene_points, depth, seg, intr, c2w, fv, vox_size,
             k_max=k_max, window=window, distance_threshold=distance_threshold,
             depth_trunc=depth_trunc, few_points_threshold=few_points_threshold,
             coverage_threshold=coverage_threshold,
@@ -286,16 +371,25 @@ def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
 
 
 def associate_scene(
-    scene_points, depths, segs, intrinsics, cam_to_world, frame_valid, *,
+    scene_points, depths, segs, intrinsics, cam_to_world, frame_valid,
+    vox_size=None, *,
     k_max: int = 127, window: int = 1, distance_threshold: float = 0.01,
     depth_trunc: float = 20.0, few_points_threshold: int = 25,
     coverage_threshold: float = 0.3,
 ) -> SceneAssociation:
-    """Run projective association over all frames (jit-cached)."""
+    """Run projective association over all frames (jit-cached).
+
+    ``vox_size`` (a traced scalar) calibrates the coverage voxel grid; when
+    None it is estimated as max(distance_threshold, median scene spacing).
+    """
+    if vox_size is None:
+        vox_size = jnp.maximum(jnp.float32(distance_threshold),
+                               estimate_spacing(scene_points))
     fn = _associate_scene_jit(k_max, window, float(distance_threshold),
                               float(depth_trunc), few_points_threshold,
                               float(coverage_threshold))
-    return fn(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid)
+    return fn(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid,
+              jnp.asarray(vox_size, jnp.float32))
 
 
 def associate_scene_tensors(tensors, cfg, k_max: int = 127) -> SceneAssociation:

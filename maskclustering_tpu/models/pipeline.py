@@ -27,7 +27,7 @@ from maskclustering_tpu.models.graph import (
     compute_graph_stats,
     observer_schedule,
 )
-from maskclustering_tpu.models.postprocess import SceneObjects, export_artifacts, postprocess_scene
+from maskclustering_tpu.models.postprocess import SceneObjects, export_artifacts
 
 log = logging.getLogger("maskclustering_tpu")
 
@@ -116,45 +116,12 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
 
     t0 = time.perf_counter()
     post_timings: Dict[str, float] = {}
-    post_kwargs = dict(
-        k_max=k_max,
-        point_filter_threshold=cfg.point_filter_threshold,
-        dbscan_eps=cfg.dbscan_split_eps,
-        dbscan_min_points=cfg.dbscan_split_min_points,
-        overlap_merge_ratio=cfg.overlap_merge_ratio,
-        min_masks_per_object=cfg.min_masks_per_object,
-        timings=post_timings,
-    )
-    if cfg.device_postprocess:
-        from maskclustering_tpu.models.postprocess_device import postprocess_scene_device
+    from maskclustering_tpu.models.postprocess_device import run_postprocess
 
-        objects = postprocess_scene_device(
-            np.asarray(tensors.scene_points),
-            assoc.first_id,
-            assoc.last_id,
-            table.frame,
-            table.mask_id,
-            np.asarray(active),
-            assignment,
-            result.node_visible,
-            tensors.frame_ids,
-            **post_kwargs,
-        )
-    else:
-        first_h = np.asarray(assoc.first_id)
-        objects = postprocess_scene(
-            np.asarray(tensors.scene_points),
-            first_h,
-            np.asarray(assoc.last_id),
-            first_h > 0,  # == assoc.point_visible, minus one (F, N) transfer
-            table.frame,
-            table.mask_id,
-            np.asarray(active),
-            assignment,
-            np.asarray(result.node_visible),
-            tensors.frame_ids,
-            **post_kwargs,
-        )
+    objects = run_postprocess(
+        cfg, tensors.scene_points, assoc.first_id, assoc.last_id,
+        table.frame, table.mask_id, active, assignment, result.node_visible,
+        tensors.frame_ids, k_max=k_max, timings=post_timings)
     timings["postprocess"] = time.perf_counter() - t0
     timings.update({f"post.{k}": v for k, v in post_timings.items()})
 

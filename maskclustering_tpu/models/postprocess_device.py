@@ -45,8 +45,43 @@ from maskclustering_tpu.models.postprocess import (
     SceneObjects,
     _merge_overlapping,
     _PhaseTimer,
+    postprocess_scene,
 )
 from maskclustering_tpu.ops.dbscan import dbscan_labels
+
+
+def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
+                    mask_active, assignment, node_visible, frame_ids, *,
+                    k_max: int, timings: Optional[Dict[str, float]] = None) -> SceneObjects:
+    """Single dispatch point for the device/host post-process paths.
+
+    Accepts device or host arrays for the large operands; converts to what
+    the selected path needs. Both paths produce byte-identical artifacts.
+    """
+    kwargs = dict(
+        k_max=k_max,
+        point_filter_threshold=cfg.point_filter_threshold,
+        dbscan_eps=cfg.dbscan_split_eps,
+        dbscan_min_points=cfg.dbscan_split_min_points,
+        overlap_merge_ratio=cfg.overlap_merge_ratio,
+        min_masks_per_object=cfg.min_masks_per_object,
+        timings=timings,
+    )
+    scene_points = np.asarray(scene_points)
+    mask_frame = np.asarray(mask_frame)
+    mask_id = np.asarray(mask_id)
+    mask_active = np.asarray(mask_active)
+    assignment = np.asarray(assignment)
+    if cfg.device_postprocess:
+        return postprocess_scene_device(
+            scene_points, jnp.asarray(first), jnp.asarray(last), mask_frame,
+            mask_id, mask_active, assignment, jnp.asarray(node_visible),
+            frame_ids, **kwargs)
+    first_h = np.asarray(first)
+    return postprocess_scene(
+        scene_points, first_h, np.asarray(last), first_h > 0, mask_frame,
+        mask_id, mask_active, assignment, np.asarray(node_visible),
+        frame_ids, **kwargs)
 
 
 def _bucket_pow2(value: int, minimum: int = 8) -> int:

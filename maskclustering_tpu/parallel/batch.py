@@ -28,7 +28,7 @@ import numpy as np
 from maskclustering_tpu.config import PipelineConfig
 from maskclustering_tpu.datasets.base import SceneTensors
 from maskclustering_tpu.models.pipeline import bucket_k_max
-from maskclustering_tpu.models.postprocess import SceneObjects, postprocess_scene
+from maskclustering_tpu.models.postprocess import SceneObjects
 from maskclustering_tpu.parallel.mesh import make_mesh
 from maskclustering_tpu.parallel.sharded import build_fused_step
 
@@ -95,47 +95,14 @@ def fused_scene_objects(
     mask_id = np.tile(np.arange(1, k_max + 1, dtype=np.int32), f_pad)
     frame_ids = list(tensors.frame_ids)
     frame_ids += [None] * (f_pad - len(frame_ids))
-    scene_points = np.asarray(out_scene_points(tensors, n_pad))
-    kwargs = dict(
-        k_max=k_max,
-        point_filter_threshold=cfg.point_filter_threshold,
-        dbscan_eps=cfg.dbscan_split_eps,
-        dbscan_min_points=cfg.dbscan_split_min_points,
-        overlap_merge_ratio=cfg.overlap_merge_ratio,
-        min_masks_per_object=cfg.min_masks_per_object,
-        timings=timings,
-    )
 
-    if cfg.device_postprocess:
-        from maskclustering_tpu.models.postprocess_device import postprocess_scene_device
+    from maskclustering_tpu.models.postprocess_device import run_postprocess
 
-        objects = postprocess_scene_device(
-            scene_points,
-            out.first_id[index],
-            out.last_id[index],
-            mask_frame,
-            mask_id,
-            np.asarray(out.mask_active[index]),
-            np.asarray(out.assignment[index]),
-            out.node_visible[index],
-            frame_ids,
-            **kwargs,
-        )
-    else:
-        first = np.asarray(out.first_id[index])
-        objects = postprocess_scene(
-            scene_points,
-            first,
-            np.asarray(out.last_id[index]),
-            first > 0,
-            mask_frame,
-            mask_id,
-            np.asarray(out.mask_active[index]),
-            np.asarray(out.assignment[index]),
-            np.asarray(out.node_visible[index]),
-            frame_ids,
-            **kwargs,
-        )
+    objects = run_postprocess(
+        cfg, out_scene_points(tensors, n_pad), out.first_id[index],
+        out.last_id[index], mask_frame, mask_id, out.mask_active[index],
+        out.assignment[index], out.node_visible[index], frame_ids,
+        k_max=k_max, timings=timings)
     n_real = tensors.num_points
     for pids in objects.point_ids_list:
         # not an assert: this guards exported artifacts and must survive -O

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from maskclustering_tpu.models.backprojection import associate_frame
+from maskclustering_tpu.models.backprojection import associate_frame, estimate_spacing
 from maskclustering_tpu.models.clustering import iterative_clustering
 from maskclustering_tpu.models.graph import compute_graph_stats, observer_schedule_device
 from maskclustering_tpu.parallel.mesh import constrain, sharding
@@ -76,9 +76,12 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
         m_pad = f * k_max
 
         # ---- association: vmap over frames (sequence-parallel) ----
+        vox_size = jnp.maximum(jnp.float32(cfg.distance_threshold),
+                               estimate_spacing(scene_points))
+
         def one_frame(depth, seg, intr, c2w, fv):
             fa = associate_frame(
-                scene_points, depth, seg, intr, c2w, fv,
+                scene_points, depth, seg, intr, c2w, fv, vox_size,
                 k_max=k_max, window=cfg.association_window,
                 distance_threshold=cfg.distance_threshold,
                 depth_trunc=cfg.depth_trunc,

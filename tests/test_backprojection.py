@@ -172,3 +172,59 @@ def test_invalid_frame_produces_nothing(scene):
     assert np.asarray(fa.mask_valid).any()
     assert not np.asarray(fa_invalid.mask_valid).any()
     assert (np.asarray(fa_invalid.mask_of_point) == 0).all()
+
+
+def test_spacing_estimate_and_duplicates():
+    """estimate_spacing recovers grid spacing; duplicates/sentinels ignored."""
+    from maskclustering_tpu.models.backprojection import estimate_spacing
+
+    g = np.stack(np.meshgrid(np.arange(40) * 0.02, np.arange(40) * 0.02,
+                             indexing="ij"), axis=-1).reshape(-1, 2)
+    pts = np.concatenate([g, np.zeros((len(g), 1))], axis=1).astype(np.float32)
+    est = float(estimate_spacing(jnp.asarray(pts)))
+    assert 0.018 <= est <= 0.022, est
+    # tile-padding duplicates and a block of far sentinel points must not
+    # drag the estimate toward zero
+    padded = np.concatenate([pts, pts[:400],
+                             np.full((400, 3), 1.0e4, np.float32)])
+    est2 = float(estimate_spacing(jnp.asarray(padded)))
+    assert 0.018 <= est2 <= 0.022, est2
+    # MAJORITY sentinel padding (the fused batch path pads small scenes to
+    # the batch max): a sentinel's finite distance to the nearest real point
+    # must not blow the median up either
+    mostly_pad = np.concatenate([pts, np.full((5 * len(pts), 3), 1.0e4, np.float32)])
+    est3 = float(estimate_spacing(jnp.asarray(mostly_pad)))
+    assert 0.018 <= est3 <= 0.022, est3
+
+
+def test_reference_radius_on_sparse_cloud():
+    """At the reference's radius 0.01 a ~2 cm cloud must still associate:
+    the coverage voxel grid self-calibrates to the cloud's density
+    (reference analog: voxel-downsampled mask points in the coverage ratio,
+    mask_backprojection.py:105,143-145)."""
+    # 480x640 (ScanNet depth size): pixel backprojections ~5 mm apart at
+    # 3 m, inside the radius (at the tiny default 96x128 the pixel grid
+    # itself is ~2 cm — sparser than the radius — and nothing could claim,
+    # reference or not)
+    scene = make_scene(num_boxes=3, num_frames=6, seed=11, spacing=0.02,
+                       image_hw=(480, 640))
+    out = associate_scene(
+        jnp.asarray(scene.scene_points),
+        jnp.asarray(scene.depths),
+        jnp.asarray(scene.segmentations),
+        jnp.asarray(scene.intrinsics),
+        jnp.asarray(scene.cam_to_world),
+        jnp.asarray(scene.frame_valid),
+        k_max=15, window=1, distance_threshold=0.01,
+        few_points_threshold=25, coverage_threshold=COV,
+    )
+    valid = np.asarray(out.mask_valid)
+    # every frame observes all 3 boxes head-on; the masks must survive
+    assert valid[:, 1:].sum() >= 3 * scene.depths.shape[0] * 0.8, valid.sum()
+    # most object points are claimed in >= 1 of the 6 views (oblique
+    # surfaces miss at r=0.01 — adjacent-pixel backprojections sit > 1 cm
+    # apart in 3D there, for the reference's ball query just as much —
+    # and only more viewpoints recover them)
+    first = np.asarray(out.first_id)
+    claimed_frac = (first > 0).any(axis=0)[scene.gt_instance > 0].mean()
+    assert claimed_frac > 0.6, claimed_frac

@@ -111,3 +111,25 @@ def test_export_artifacts(tmp_path, result_and_scene):
                                       np.nonzero(data["pred_masks"][:, i])[0])
         assert od[i]["repre_mask_list"] == sorted(
             od[i]["mask_list"], key=lambda t: t[2], reverse=True)[:5]
+
+
+def test_device_renderer_matches_numpy():
+    """make_scene_device's jitted renderer agrees with the host ray tracer
+    (same seed -> same boxes/cloud/perms; f32 vs f64 ray math may flip a
+    few silhouette pixels)."""
+    from maskclustering_tpu.utils.synthetic import make_scene, make_scene_device
+
+    kw = dict(num_boxes=4, num_frames=6, image_hw=(96, 128), spacing=0.02,
+              seed=7, room_half=2.0, camera_radius=3.2)
+    ref = make_scene(camera_height=2.5, **kw)
+    tensors, gt, oom = make_scene_device(floor_spacing=None, camera_height=2.5, **kw)
+
+    np.testing.assert_array_equal(ref.scene_points, tensors.scene_points)
+    np.testing.assert_array_equal(ref.gt_instance, gt)
+    np.testing.assert_array_equal(ref.object_of_mask[:, :5], oom)
+    seg_dev = np.asarray(tensors.segmentations)
+    dep_dev = np.asarray(tensors.depths)
+    agree = (seg_dev == ref.segmentations).mean()
+    assert agree > 0.999, agree
+    both = (dep_dev > 0) & (ref.depths > 0) & (seg_dev == ref.segmentations)
+    np.testing.assert_allclose(dep_dev[both], ref.depths[both], rtol=1e-4, atol=1e-3)
