@@ -114,6 +114,11 @@ def main():
 
     import numpy as np
 
+    from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
+
+    cache = setup_compilation_cache()
+    print(f"[bench] persistent compile cache: {cache}", file=sys.stderr, flush=True)
+
     from maskclustering_tpu.config import PipelineConfig
     from maskclustering_tpu.models.pipeline import run_scene
     from maskclustering_tpu.utils.synthetic import make_scene_device

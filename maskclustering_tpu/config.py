@@ -79,6 +79,10 @@ class PipelineConfig:
     data_root: str = "./data"
     cropformer_path: str = ""
     debug: bool = False
+    # persistent XLA compilation cache: None -> ~/.cache/maskclustering_tpu/xla
+    # (or $MCT_COMPILE_CACHE); "" disables. A ScanNet-val run hits a handful
+    # of (k_max, F_pad, N_pad) buckets; caching makes repeat runs compile 0.
+    compilation_cache_dir: Optional[str] = None
 
     def __post_init__(self):
         if not (0.0 <= self.mask_visible_threshold <= 1.0):

@@ -17,6 +17,14 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 
+# Sentinel coordinate for point padding: far outside any indoor scan, so a
+# padded point is never inside a frustum within depth_trunc and never
+# claimed. estimate_spacing (models/backprojection.py) relies on sentinel
+# distances exceeding PAD_DISTANCE_CUTOFF to exclude padding from its median.
+PAD_COORD = 1.0e4
+PAD_DISTANCE_CUTOFF = min(10.0, PAD_COORD / 100.0)
+
+
 @dataclasses.dataclass
 class SceneTensors:
     """Dense per-scene arrays handed to the jitted pipeline.

@@ -87,8 +87,10 @@ def estimate_spacing(points: jnp.ndarray, *, sample: int = 2048,
         return jnp.minimum(best, jnp.min(d2, axis=1)), None
 
     best, _ = jax.lax.scan(body, best, jnp.arange(n_chunks))
+    from maskclustering_tpu.datasets.base import PAD_DISTANCE_CUTOFF
+
     d = jnp.sqrt(best)
-    valid = jnp.isfinite(d) & (d < 10.0)
+    valid = jnp.isfinite(d) & (d < PAD_DISTANCE_CUTOFF)
     # median over valid entries: sort with inf padding, index count/2
     ds = jnp.sort(jnp.where(valid, d, jnp.inf))
     cnt = jnp.sum(valid)
@@ -271,7 +273,16 @@ def associate_frame(
         [cand_sorted[:, :1] > 0, (cand_sorted[:, 1:] != cand_sorted[:, :-1]) & (cand_sorted[:, 1:] > 0)],
         axis=1,
     )
-    n_claimed = _counts_by_id(row_new.reshape(-1), cand_sorted.reshape(-1), k_max + 1)
+    # scan over the window columns: 9 (N, K) one-hot matvecs instead of one
+    # (9N, K) — same FLOPs, 9x smaller peak temporary (matters under the
+    # fused path's vmap over frames, where per-frame temporaries stack)
+    def claimed_col(acc, col):
+        w, ids = col
+        return acc + _counts_by_id(w, ids, k_max + 1), None
+
+    n_claimed, _ = jax.lax.scan(
+        claimed_col, jnp.zeros(k_max + 1, jnp.float32),
+        (row_new.T.astype(jnp.float32), cand_sorted.T))
 
     coverage = n_claimed / jnp.maximum(n_voxels, 1)
     mask_valid = (

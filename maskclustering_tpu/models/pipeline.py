@@ -42,6 +42,57 @@ class SceneResult(NamedTuple):
 K_MAX_CEILING = 1023
 
 
+def bucket_size(value: int, multiple: int) -> int:
+    """Geometric shape bucket: the multiple count is rounded up to two
+    significant bits (2^k or 3*2^(k-1)).
+
+    Linear rounding gives one jit bucket per `multiple` of size variance —
+    ScanNet clouds span ~80k-400k points, which would mean dozens of
+    compiles. Two-significant-bit steps waste <= 33% padded work and bound
+    the bucket count to ~2 per octave of size range.
+    """
+    m = max(1, -(-value // multiple))
+    bit = max(m.bit_length() - 2, 0)
+    m = -(-m >> bit) << bit
+    return m * multiple
+
+
+def pad_scene_tensors(tensors: SceneTensors, f_pad: int, n_pad: int) -> SceneTensors:
+    """Pad a scene to a (F_pad, N_pad) shape bucket.
+
+    Padded frames are invalid (frame_valid=False -> no claims); padded
+    points sit at a far sentinel coordinate no frustum reaches within
+    depth_trunc (same invariants as the mesh batch path, parallel/batch.py).
+    Image-shaped arrays pad via jnp so device-resident inputs stay on
+    device; the point cloud stays host numpy (post-process reads it there).
+    """
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    f, n = tensors.num_frames, tensors.num_points
+    if f == f_pad and n == n_pad:
+        return tensors
+    if f_pad < f or n_pad < n:
+        raise ValueError(f"bucket ({f_pad}, {n_pad}) smaller than scene ({f}, {n})")
+    pts = np.full((n_pad, 3), 1.0e4, dtype=np.float32)
+    pts[:n] = tensors.scene_points
+    df = f_pad - f
+    return dataclasses.replace(
+        tensors,
+        scene_points=pts,
+        depths=jnp.pad(jnp.asarray(tensors.depths), ((0, df), (0, 0), (0, 0))),
+        segmentations=jnp.pad(jnp.asarray(tensors.segmentations), ((0, df), (0, 0), (0, 0))),
+        intrinsics=jnp.pad(jnp.asarray(tensors.intrinsics), ((0, df), (0, 0), (0, 0)),
+                           constant_values=1.0),
+        cam_to_world=jnp.pad(jnp.asarray(tensors.cam_to_world), ((0, df), (0, 0), (0, 0)),
+                             constant_values=0.0),
+        frame_valid=np.concatenate([np.asarray(tensors.frame_valid),
+                                    np.zeros(df, dtype=bool)]),
+        frame_ids=list(tensors.frame_ids) + [None] * df,
+    )
+
+
 def bucket_k_max(max_id: int, minimum: int = 63, ceiling: int = K_MAX_CEILING) -> int:
     """Smallest (2^b - 1) >= max(max_id, minimum): few jit buckets, no aliasing.
 
@@ -77,11 +128,24 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
         max_id = int(np.max(tensors.segmentations)) if np.size(tensors.segmentations) else 0
         k_max = bucket_k_max(max_id)
 
+    n_real = tensors.num_points
     if cfg.use_exact_ball_query:
+        # host-only parity path: no jit shape buckets, padding would only
+        # add pointless device round-trips
         from maskclustering_tpu.models.exact_backprojection import associate_scene_exact
 
         assoc = associate_scene_exact(tensors, cfg, k_max=k_max)
     else:
+        # shape buckets: heterogeneous scenes (ScanNet frame counts and
+        # cloud sizes vary per scan) land on a handful of padded shapes, so
+        # the jit caches — and the persistent compilation cache — hit
+        # across scenes
+        f_pad = bucket_size(tensors.num_frames, max(cfg.frame_pad_multiple, 1))
+        n_pad = bucket_size(n_real, max(cfg.point_chunk, 1))
+        tensors = pad_scene_tensors(tensors, f_pad, n_pad)
+        from maskclustering_tpu.utils.compile_cache import record_shape_bucket
+
+        record_shape_bucket("scene", k_max, f_pad, n_pad)
         assoc = associate_scene_tensors(tensors, cfg, k_max=k_max)
     mask_valid_host = np.asarray(assoc.mask_valid)
     timings["associate"] = time.perf_counter() - t0
@@ -121,7 +185,7 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
     objects = run_postprocess(
         cfg, tensors.scene_points, assoc.first_id, assoc.last_id,
         table.frame, table.mask_id, active, assignment, result.node_visible,
-        tensors.frame_ids, k_max=k_max, timings=post_timings)
+        tensors.frame_ids, k_max=k_max, timings=post_timings, n_real=n_real)
     timings["postprocess"] = time.perf_counter() - t0
     timings.update({f"post.{k}": v for k, v in post_timings.items()})
 

@@ -52,11 +52,16 @@ from maskclustering_tpu.ops.dbscan import dbscan_labels
 
 def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
                     mask_active, assignment, node_visible, frame_ids, *,
-                    k_max: int, timings: Optional[Dict[str, float]] = None) -> SceneObjects:
+                    k_max: int, timings: Optional[Dict[str, float]] = None,
+                    n_real: Optional[int] = None) -> SceneObjects:
     """Single dispatch point for the device/host post-process paths.
 
     Accepts device or host arrays for the large operands; converts to what
     the selected path needs. Both paths produce byte-identical artifacts.
+
+    ``n_real``: the scene's true point count when the inputs are padded to a
+    shape bucket; enforces the sentinel-pad invariant (no padded point may
+    be claimed) and restores the real count on the returned objects.
     """
     kwargs = dict(
         k_max=k_max,
@@ -73,15 +78,26 @@ def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
     mask_active = np.asarray(mask_active)
     assignment = np.asarray(assignment)
     if cfg.device_postprocess:
-        return postprocess_scene_device(
+        objects = postprocess_scene_device(
             scene_points, jnp.asarray(first), jnp.asarray(last), mask_frame,
             mask_id, mask_active, assignment, jnp.asarray(node_visible),
             frame_ids, **kwargs)
-    first_h = np.asarray(first)
-    return postprocess_scene(
-        scene_points, first_h, np.asarray(last), first_h > 0, mask_frame,
-        mask_id, mask_active, assignment, np.asarray(node_visible),
-        frame_ids, **kwargs)
+    else:
+        first_h = np.asarray(first)
+        objects = postprocess_scene(
+            scene_points, first_h, np.asarray(last), first_h > 0, mask_frame,
+            mask_id, mask_active, assignment, np.asarray(node_visible),
+            frame_ids, **kwargs)
+    if n_real is not None and objects.num_points != n_real:
+        for pids in objects.point_ids_list:
+            # not an assert: this guards exported artifacts and must survive -O
+            if pids.size and int(pids.max()) >= n_real:
+                raise RuntimeError(
+                    "sentinel pad point claimed — padding invariant violated "
+                    f"(max point id {int(pids.max())} >= num_points {n_real})")
+        objects = SceneObjects(point_ids_list=objects.point_ids_list,
+                               mask_list=objects.mask_list, num_points=n_real)
+    return objects
 
 
 def _bucket_pow2(value: int, minimum: int = 8) -> int:

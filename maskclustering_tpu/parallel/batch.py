@@ -32,9 +32,7 @@ from maskclustering_tpu.models.postprocess import SceneObjects
 from maskclustering_tpu.parallel.mesh import make_mesh
 from maskclustering_tpu.parallel.sharded import build_fused_step
 
-# Sentinel coordinate for point padding: far outside any indoor scan, so a
-# padded point is never inside a frustum within depth_trunc and never claimed.
-_PAD_COORD = 1.0e4
+from maskclustering_tpu.datasets.base import PAD_COORD as _PAD_COORD
 
 
 def _round_up(value: int, multiple: int) -> int:
@@ -98,20 +96,11 @@ def fused_scene_objects(
 
     from maskclustering_tpu.models.postprocess_device import run_postprocess
 
-    objects = run_postprocess(
+    return run_postprocess(
         cfg, out_scene_points(tensors, n_pad), out.first_id[index],
         out.last_id[index], mask_frame, mask_id, out.mask_active[index],
         out.assignment[index], out.node_visible[index], frame_ids,
-        k_max=k_max, timings=timings)
-    n_real = tensors.num_points
-    for pids in objects.point_ids_list:
-        # not an assert: this guards exported artifacts and must survive -O
-        if pids.size and int(pids.max()) >= n_real:
-            raise RuntimeError(
-                "sentinel pad point claimed — padding invariant violated "
-                f"(max point id {int(pids.max())} >= num_points {n_real})")
-    return SceneObjects(point_ids_list=objects.point_ids_list,
-                        mask_list=objects.mask_list, num_points=n_real)
+        k_max=k_max, timings=timings, n_real=tensors.num_points)
 
 
 def out_scene_points(tensors: SceneTensors, n_pad: int) -> np.ndarray:

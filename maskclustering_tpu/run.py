@@ -492,6 +492,9 @@ def run_pipeline(
     unknown = set(steps) - set(ALL_STEPS)
     if unknown:
         raise ValueError(f"unknown steps {sorted(unknown)}; valid: {ALL_STEPS}")
+    from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
+
+    setup_compilation_cache(cfg.compilation_cache_dir)
     report = RunReport(config_name=cfg.config_name)
     encoder = None
     trace_ctx = None

@@ -1,0 +1,76 @@
+"""Persistent XLA compilation cache + shape-bucket accounting.
+
+The pipeline jits a small family of programs keyed by static shape buckets
+(k_max from models/pipeline.bucket_k_max, F padded to cfg.frame_pad_multiple,
+N padded to cfg.point_chunk, M padded to cfg.mask_pad_multiple). Warm-up
+compilation of the association scan is the single largest fixed cost
+(~100 s on a v5e chip at ScanNet scale), so:
+
+- `setup_compilation_cache` points JAX's persistent cache at a durable
+  directory: the second process-level run of the same config compiles
+  nothing (the reference has no analog — torch re-JITs nothing but pays
+  eager kernel-launch overhead every run instead);
+- `record_shape_bucket` counts distinct buckets per process so a run can
+  assert bucket reuse (tests/test_compile_cache.py) and the log shows
+  exactly which shapes triggered compilation.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Set, Tuple
+
+log = logging.getLogger("maskclustering_tpu")
+
+_CACHE_APPLIED: Optional[str] = None
+_SEEN_BUCKETS: Set[Tuple] = set()
+
+
+def default_cache_dir() -> str:
+    return os.environ.get(
+        "MCT_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "maskclustering_tpu", "xla"))
+
+
+def setup_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Enable JAX's persistent compilation cache (idempotent).
+
+    cache_dir: explicit directory, None for the default, "" to disable.
+    Returns the directory in effect (or None when disabled).
+    """
+    global _CACHE_APPLIED
+    if cache_dir == "":
+        return None
+    path = os.path.expanduser(cache_dir or default_cache_dir())
+    if _CACHE_APPLIED == path:
+        return path
+    os.makedirs(path, exist_ok=True)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every compile that takes >= 1 s; sub-second CPU test compiles
+    # stay out of the cache (they cost more to serialize than to redo)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _CACHE_APPLIED = path
+    log.info("persistent compilation cache at %s", path)
+    return path
+
+
+def record_shape_bucket(kind: str, *bucket) -> bool:
+    """Record a jit shape bucket; returns True (and logs) if new."""
+    key = (kind, *bucket)
+    if key in _SEEN_BUCKETS:
+        return False
+    _SEEN_BUCKETS.add(key)
+    log.info("new %s shape bucket: %s", kind, bucket)
+    return True
+
+
+def seen_shape_buckets() -> Set[Tuple]:
+    return set(_SEEN_BUCKETS)
+
+
+def reset_shape_buckets() -> None:
+    _SEEN_BUCKETS.clear()

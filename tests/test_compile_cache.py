@@ -1,0 +1,115 @@
+"""Shape-bucket policy + persistent compilation cache wiring.
+
+A heterogeneous scene batch must land on a handful of padded jit shapes
+(VERDICT r3 task 5: <= 3 buckets for a 10-scene heterogeneous run), and the
+padded pipeline must produce the same objects as the exact-shape pipeline.
+"""
+
+import numpy as np
+
+from maskclustering_tpu.config import PipelineConfig
+from maskclustering_tpu.models.pipeline import pad_scene_tensors, run_scene
+from maskclustering_tpu.utils.compile_cache import (
+    record_shape_bucket,
+    reset_shape_buckets,
+    seen_shape_buckets,
+    setup_compilation_cache,
+)
+from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+
+def _config(**kw):
+    base = dict(
+        config_name="synthetic", dataset="demo", backend="cpu",
+        distance_threshold=0.03, step=1, mask_pad_multiple=64,
+        point_chunk=2048, frame_pad_multiple=8,
+    )
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+def test_bucket_size_ladder():
+    from maskclustering_tpu.models.pipeline import bucket_size
+
+    assert bucket_size(1, 8) == 8
+    assert bucket_size(40, 8) == 48  # m=5 -> 6 (3*2^1)
+    assert bucket_size(55296, 2048) == 65536  # m=27 -> 32
+    assert bucket_size(250, 32) == 256  # m=8
+    assert bucket_size(100, 32) == 128  # m=4
+    # values on the ladder stay put
+    assert bucket_size(65536, 2048) == 65536
+    assert bucket_size(6 * 2048, 2048) == 6 * 2048
+
+
+def test_bucket_accounting():
+    reset_shape_buckets()
+    assert record_shape_bucket("scene", 63, 32, 8192)
+    assert not record_shape_bucket("scene", 63, 32, 8192)
+    assert record_shape_bucket("scene", 63, 64, 8192)
+    assert len(seen_shape_buckets()) == 2
+    reset_shape_buckets()
+
+
+def test_heterogeneous_scenes_share_buckets():
+    """10 scenes with frame counts 5..14 and varying cloud sizes must hit
+    at most 3 (k_max, F_pad, N_pad) buckets."""
+    cfg = _config()
+    reset_shape_buckets()
+    for i in range(10):
+        scene = make_scene(num_boxes=3, num_frames=5 + i, seed=i)
+        run_scene(to_scene_tensors(scene), cfg, k_max=15)
+    buckets = {b for b in seen_shape_buckets() if b[0] == "scene"}
+    assert 1 <= len(buckets) <= 3, buckets
+    reset_shape_buckets()
+
+
+def test_padded_pipeline_matches_exact_shapes():
+    """Bucket padding must not change the artifacts.
+
+    The baseline run must be truly UNPADDED: the scene is trimmed to 6144
+    points (= 6*1024, on the two-significant-bit ladder for multiple 1024)
+    with 12 frames (= 3*4, on the ladder for multiple 1), so the baseline
+    config pads nothing, while the second config pads frames to 16 and
+    points to 8192."""
+    from maskclustering_tpu.models.pipeline import bucket_size
+
+    scene = make_scene(num_boxes=4, num_frames=12, seed=21)
+    t = to_scene_tensors(scene)
+    keep = 6144
+    t.scene_points = np.ascontiguousarray(t.scene_points[:keep])
+    assert bucket_size(keep, 1024) == keep
+    assert bucket_size(12, 1) == 12
+
+    reset_shape_buckets()
+    res_exact = run_scene(t, _config(frame_pad_multiple=1, point_chunk=1024), k_max=15)
+    assert ("scene", 15, 12, keep) in seen_shape_buckets()  # unpadded bucket
+    res_pad = run_scene(t, _config(frame_pad_multiple=16, point_chunk=8192), k_max=15)
+    assert ("scene", 15, 16, 8192) in seen_shape_buckets()
+    reset_shape_buckets()
+    oh, od = res_exact.objects, res_pad.objects
+    assert oh.num_points == od.num_points == t.num_points
+    assert len(oh.point_ids_list) == len(od.point_ids_list)
+    for ph, pd in zip(oh.point_ids_list, od.point_ids_list):
+        np.testing.assert_array_equal(ph, pd)
+    assert oh.mask_list == od.mask_list
+
+
+def test_pad_scene_tensors_invariants():
+    scene = make_scene(num_boxes=2, num_frames=5, seed=1)
+    t = to_scene_tensors(scene)
+    p = pad_scene_tensors(t, 8, t.num_points + 100)
+    assert p.num_frames == 8 and p.num_points == t.num_points + 100
+    assert not np.asarray(p.frame_valid)[5:].any()
+    assert (p.scene_points[t.num_points:] == 1.0e4).all()
+    assert p.frame_ids[5:] == [None, None, None]
+    # no-op when already at the bucket
+    assert pad_scene_tensors(t, t.num_frames, t.num_points) is t
+
+
+def test_setup_compilation_cache(tmp_path):
+    d = str(tmp_path / "xla")
+    assert setup_compilation_cache(d) == d
+    import os
+
+    assert os.path.isdir(d)
+    assert setup_compilation_cache("") is None  # disabled

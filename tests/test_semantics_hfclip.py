@@ -1,0 +1,137 @@
+"""Real-CLIP execution path: HFCLIPEncoder driven by a tiny local fixture.
+
+VERDICT r3 task 7: the class-aware semantics path had only ever executed
+with HashEncoder; HFCLIPEncoder was dead code. This module vendors a few-MB
+random-weight HuggingFace CLIP layout (config + Flax AND torch weights +
+tokenizer + image processor) at test time — no network — and drives:
+
+- Flax encode (the TPU path) and the torch-CPU fallback, numerically equal
+  on the same weights;
+- the full pipeline features -> label features -> query -> class-aware eval
+  with encoder_spec="hf:<path>" (reference semantics stage,
+  get_open-voc_features.py:101-143 / open-voc_query.py:32-55).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+VOCAB = ["l", "o", "w", "e", "r", "s", "t", "i", "d", "n",
+         "lo", "l</w>", "w</w>", "r</w>", "t</w>",
+         "low</w>", "er</w>", "lowest</w>", "newer</w>", "wider",
+         "<unk>", "<|startoftext|>", "<|endoftext|>"]
+MERGES = ["#version: 0.2", "l o", "lo w</w>", "e r</w>"]
+
+
+@pytest.fixture(scope="module")
+def tiny_clip_dir(tmp_path_factory):
+    import json
+
+    from transformers import (
+        CLIPConfig,
+        CLIPImageProcessor,
+        CLIPModel,
+        CLIPTextConfig,
+        CLIPTokenizer,
+        CLIPVisionConfig,
+        FlaxCLIPModel,
+    )
+
+    d = tmp_path_factory.mktemp("tiny_clip")
+    vocab_file = d / "vocab.json"
+    merges_file = d / "merges.txt"
+    vocab_file.write_text(json.dumps({tok: i for i, tok in enumerate(VOCAB)}))
+    merges_file.write_text("\n".join(MERGES))
+    tok = CLIPTokenizer(str(vocab_file), str(merges_file))
+    tok.save_pretrained(str(d))
+    CLIPImageProcessor(size={"shortest_edge": 32},
+                       crop_size={"height": 32, "width": 32}).save_pretrained(str(d))
+
+    cfg = CLIPConfig.from_text_vision_configs(
+        CLIPTextConfig(vocab_size=len(VOCAB), hidden_size=32,
+                       intermediate_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, max_position_embeddings=77,
+                       projection_dim=16),
+        CLIPVisionConfig(hidden_size=32, intermediate_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         image_size=32, patch_size=8, projection_dim=16),
+        projection_dim=16,
+    )
+    flax_model = FlaxCLIPModel(cfg, seed=0)
+    flax_model.save_pretrained(str(d))
+    # same weights in torch format so the fallback path is comparable
+    # (from_pretrained(from_flax=True) meta-init breaks save on this
+    # transformers version; convert the params in-place instead)
+    from transformers.modeling_flax_pytorch_utils import (
+        load_flax_weights_in_pytorch_model,
+    )
+
+    pt_model = CLIPModel(cfg)
+    load_flax_weights_in_pytorch_model(pt_model, flax_model.params)
+    pt_model.save_pretrained(str(d), safe_serialization=False)
+    return str(d)
+
+
+def test_flax_and_torch_paths_agree(tiny_clip_dir, monkeypatch, rng):
+    from maskclustering_tpu.semantics import HFCLIPEncoder
+
+    enc = HFCLIPEncoder(tiny_clip_dir)
+    assert enc._flax, "expected the Flax (TPU) path to load"
+    assert enc.feature_dim == 16
+    images = [rng.integers(0, 255, size=(40, 50, 3), dtype=np.uint8)
+              for _ in range(3)]
+    feats = enc.encode_images(images)
+    assert feats.shape == (3, 16)
+    np.testing.assert_allclose(np.linalg.norm(feats, axis=1), 1.0, rtol=1e-5)
+    tfeats = enc.encode_texts(["lower", "newer"])
+    assert tfeats.shape == (2, 16)
+
+    # force the torch fallback and compare on identical weights
+    import transformers as tf_mod
+
+    def boom(*a, **k):
+        raise OSError("flax disabled for test")
+
+    monkeypatch.setattr(tf_mod.FlaxCLIPModel, "from_pretrained",
+                        staticmethod(boom))
+    enc_pt = HFCLIPEncoder(tiny_clip_dir)
+    assert enc_pt._torch, "expected the torch fallback"
+    feats_pt = enc_pt.encode_images(images)
+    np.testing.assert_allclose(feats, feats_pt, rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(tfeats, enc_pt.encode_texts(["lower", "newer"]),
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_class_aware_pipeline_with_real_clip(tiny_clip_dir, tmp_path):
+    """features -> label features -> query -> class-aware eval, never
+    touching HashEncoder."""
+    from maskclustering_tpu.config import load_config
+    from maskclustering_tpu.run import run_pipeline
+    from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
+
+    data_root = str(tmp_path / "data")
+    scene = make_scene(num_boxes=3, num_frames=8, image_hw=(60, 80), seed=7)
+    write_scannet_layout(scene, data_root, "scene0042_00")
+    cfg = load_config("scannet").replace(
+        data_root=data_root, config_name="cliprun", step=1,
+        distance_threshold=0.05, mask_pad_multiple=32)
+    report = run_pipeline(
+        cfg, ["scene0042_00"],
+        steps=("cluster", "eval_ca", "features", "label_features", "query",
+               "eval"),
+        encoder_spec=f"hf:{tiny_clip_dir}")
+    assert [s.status for s in report.scenes] == ["ok"]
+    assert not report.step_errors, report.step_errors
+
+    aware = np.load(os.path.join(data_root, "prediction", "cliprun",
+                                 "scene0042_00.npz"))
+    assert aware["pred_masks"].shape[1] == 3
+    assert (aware["pred_classes"] > 0).all()
+    # label feature artifact has the checkpoint's projection dim
+    lf = np.load(os.path.join(data_root, "text_features", "scannet.npy"),
+                 allow_pickle=True).item()
+    dims = {np.asarray(v).shape[-1] for v in lf.values()}
+    assert dims == {16}
