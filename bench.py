@@ -94,6 +94,41 @@ def _init_backend(args):
     return devices
 
 
+def _validate_pallas_on_tpu():
+    """Mosaic-lower the ball-query kernel on the live chip (non-interpret).
+
+    Every CI test runs interpret=True on CPU; this is the hook that catches
+    a lowering regression the first time a real TPU is available.
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return
+    import jax.numpy as jnp
+    import numpy as np
+
+    from maskclustering_tpu.ops.neighbor import ball_query
+    from maskclustering_tpu.ops.pallas.ball_query import ball_query_pallas
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.random((2, 200, 3)), jnp.float32)
+    c = jnp.asarray(rng.random((2, 500, 3)), jnp.float32)
+    ql = jnp.asarray([200, 150], jnp.int32)
+    cl = jnp.asarray([500, 333], jnp.int32)
+    try:
+        got = np.asarray(ball_query_pallas(q, c, ql, cl, k=8, radius=0.1,
+                                           interpret=False))
+        want = np.asarray(ball_query(q, c, ql, cl, k=8, radius=0.1))
+        ok = bool((got == want).all())
+        print(f"[bench] pallas ball_query non-interpret: "
+              f"{'OK' if ok else 'MISMATCH vs jnp path'}",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 — validation must not sink the bench
+        print(f"[bench] pallas ball_query non-interpret FAILED: "
+              f"{type(e).__name__}: {str(e).splitlines()[0] if str(e) else e}",
+              file=sys.stderr, flush=True)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--frames", type=int, default=250)
@@ -118,6 +153,7 @@ def main():
 
     cache = setup_compilation_cache()
     print(f"[bench] persistent compile cache: {cache}", file=sys.stderr, flush=True)
+    _validate_pallas_on_tpu()
 
     from maskclustering_tpu.config import PipelineConfig
     from maskclustering_tpu.models.pipeline import run_scene

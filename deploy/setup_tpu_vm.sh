@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Fresh TPU-VM setup for maskclustering_tpu (no container).
+#
+#   git clone <repo> && cd <repo> && bash deploy/setup_tpu_vm.sh
+#
+# Installs pinned deps into ./.venv, builds the native C++ library, runs a
+# CPU-mesh smoke test, then a one-scene TPU smoke bench. The TPU analog of
+# the reference's dockerfile (reference dockerfile:1-78) minus the CUDA
+# model builds — 2D masks arrive as precomputed id-map PNGs.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PY=${PYTHON:-python3}
+$PY -m venv .venv
+source .venv/bin/activate
+
+pip install --upgrade pip
+pip install -r deploy/requirements.txt
+# TPU runtime (libtpu) — on a CPU-only box this still works, jax falls back
+pip install "jax[tpu]==0.9.0" \
+  -f https://storage.googleapis.com/jax-releases/libtpu_releases.html || \
+  echo "[setup] jax[tpu] unavailable (CPU-only host?) — continuing with CPU jax"
+
+echo "[setup] building native C++ runtime"
+python -m maskclustering_tpu.native.build --force
+
+echo "[setup] CPU-mesh smoke test"
+JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py tests/test_parallel.py -q -x
+
+echo "[setup] one-scene smoke bench on the default backend"
+python bench.py --frames 16 --boxes 6 --points 32768 --image-h 120 --image-w 160 \
+  --repeats 1 --spacing 0.02 --distance-threshold 0.03
+
+cat <<'DONE'
+[setup] done. Typical next steps:
+  source .venv/bin/activate
+  # full benchmark at the ScanNet operating point:
+  python bench.py
+  # real data (after preprocessing, see maskclustering_tpu/preprocess/):
+  python -m maskclustering_tpu.run --config scannet
+DONE

@@ -45,16 +45,23 @@ def statistical_outlier_mask(points: np.ndarray, nb_neighbors: int = 20,
     matching Open3D's KNN, whose search set includes the query point itself
     at distance 0 — the point's own zero distance occupies one of the
     nb_neighbors slots. Keep points whose mean distance <= global_mean +
-    std_ratio * global_std. Brute force O(P^2) — inputs are per-mask clouds
-    of at most a few thousand points after voxel downsampling.
+    std_ratio * global_std. KD-tree KNN (exact) when scipy is present; the
+    brute-force O(P^2) fallback made large masks cost ~10 s each at the
+    reference radius.
     """
     p = len(points)
     if p <= 1:
         return np.ones(p, dtype=bool)
     nb = min(nb_neighbors, p)
-    d2 = np.sum((points[:, None, :] - points[None, :, :]) ** 2, axis=-1)
-    nearest = np.sort(d2, axis=1)[:, :nb]  # row minimum is the self-distance 0
-    mean_dist = np.sqrt(np.maximum(nearest, 0.0)).mean(axis=1)
+    try:
+        from scipy.spatial import cKDTree
+
+        dist, _ = cKDTree(points).query(points, k=nb)
+        mean_dist = dist.reshape(p, nb).mean(axis=1)
+    except ImportError:  # pragma: no cover - scipy ships with sklearn here
+        d2 = np.sum((points[:, None, :] - points[None, :, :]) ** 2, axis=-1)
+        nearest = np.sort(d2, axis=1)[:, :nb]  # row min is the self-distance 0
+        mean_dist = np.sqrt(np.maximum(nearest, 0.0)).mean(axis=1)
     mu, sigma = mean_dist.mean(), mean_dist.std()
     return mean_dist <= mu + std_ratio * sigma
 
@@ -89,26 +96,35 @@ def _frame_view_points(depth: np.ndarray, intrinsics: np.ndarray,
     return pts, valid.reshape(-1)
 
 
-def _ball_query_batched(mask_points_list, cropped_list, k, radius):
-    """Pad ragged per-mask arrays and run one device ball query per frame."""
+def _pow2(value: int, minimum: int) -> int:
+    return 1 << max(minimum, int(np.ceil(np.log2(max(value, 1)))))
+
+
+def _ball_query_kdtree(q, c, ql, cl, k, radius):
+    """scipy KD-tree ball query, identical semantics to ops/neighbor.py:
+    first K candidates within radius in ASCENDING INDEX order, -1 padded
+    (pytorch3d ball_query contract, reference mask_backprojection.py:38)."""
+    from scipy.spatial import cKDTree
+
+    b, p_pad, _ = q.shape
+    out = np.full((b, p_pad, k), -1, dtype=np.int32)
+    for bi in range(b):
+        nq, nc = int(ql[bi]), int(cl[bi])
+        if nq == 0 or nc == 0:
+            continue
+        tree = cKDTree(c[bi, :nc])
+        hits = tree.query_ball_point(q[bi, :nq], r=radius, return_sorted=True)
+        for pi, idxs in enumerate(hits):
+            if idxs:
+                take = idxs[:k]
+                out[bi, pi, : len(take)] = take
+    return out
+
+
+def _ball_query_group(q, c, ql, cl, k, radius):
+    """One padded ball-query batch (Pallas on TPU, KD-tree on host CPU)."""
     from maskclustering_tpu.ops.neighbor import ball_query
 
-    # bucket ALL pad sizes (incl. batch) to powers of two so the device
-    # kernels compile O(log^3) distinct shapes across a whole scene, not
-    # one per frame's mask count
-    b = 1 << max(3, int(np.ceil(np.log2(max(len(mask_points_list), 1)))))
-    p_max = max(len(m) for m in mask_points_list)
-    s_max = max(max(len(c) for c in cropped_list), 1)
-    p_pad = 1 << max(6, int(np.ceil(np.log2(max(p_max, 1)))))
-    s_pad = 1 << max(8, int(np.ceil(np.log2(s_max))))
-    q = np.zeros((b, p_pad, 3), dtype=np.float32)
-    c = np.zeros((b, s_pad, 3), dtype=np.float32)
-    ql = np.zeros(b, dtype=np.int32)
-    cl = np.zeros(b, dtype=np.int32)
-    for i, (mp, cp) in enumerate(zip(mask_points_list, cropped_list)):
-        q[i, :len(mp)] = mp
-        c[i, :len(cp)] = cp
-        ql[i], cl[i] = len(mp), len(cp)
     try:  # Pallas TPU kernel when the backend supports it
         import jax
 
@@ -124,9 +140,46 @@ def _ball_query_batched(mask_points_list, cropped_list, k, radius):
             _PALLAS_WARNED = True  # visible, not a silent perf regression
             log.warning("Pallas ball_query failed; using the jnp fallback",
                         exc_info=True)
-    return np.asarray(ball_query(
-        jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
-        k=k, radius=radius))
+    try:
+        return _ball_query_kdtree(q, c, ql, cl, k, radius)
+    except ImportError:  # pragma: no cover - scipy ships with sklearn here
+        return np.asarray(ball_query(
+            jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
+            k=k, radius=radius))
+
+
+def _ball_query_batched(mask_points_list, cropped_list, k, radius):
+    """Ragged per-mask ball queries, grouped by power-of-two size buckets.
+
+    Masks in one frame span orders of magnitude in (P, S); padding them all
+    to the global max costs ~30x the useful distance work (the reason the
+    parity A/B never finished at the reference radius). Grouping by the
+    (P_pad, S_pad) bucket keeps padding waste < 4x while the pow2 buckets
+    still bound distinct jit shapes to O(log^2).
+    """
+    n = len(mask_points_list)
+    p_out = max(len(m) for m in mask_points_list)
+    out = np.full((n, p_out, k), -1, dtype=np.int32)
+    groups: Dict[Tuple[int, int], List[int]] = {}
+    for i, (mp, cp) in enumerate(zip(mask_points_list, cropped_list)):
+        key = (_pow2(len(mp), 6), _pow2(len(cp), 8))
+        groups.setdefault(key, []).append(i)
+    for (p_pad, s_pad), idxs in sorted(groups.items()):
+        b = _pow2(len(idxs), 0)
+        q = np.zeros((b, p_pad, 3), dtype=np.float32)
+        c = np.zeros((b, s_pad, 3), dtype=np.float32)
+        ql = np.zeros(b, dtype=np.int32)
+        cl = np.zeros(b, dtype=np.int32)
+        for j, i in enumerate(idxs):
+            mp, cp = mask_points_list[i], cropped_list[i]
+            q[j, : len(mp)] = mp
+            c[j, : len(cp)] = cp
+            ql[j], cl[j] = len(mp), len(cp)
+        nb = _ball_query_group(q, c, ql, cl, k, radius)
+        for j, i in enumerate(idxs):
+            pl = len(mask_points_list[i])
+            out[i, :pl] = nb[j, :pl]
+    return out
 
 
 def frame_backprojection_exact(

@@ -22,3 +22,8 @@ from maskclustering_tpu.visualize.top_images import (  # noqa: F401
     draw_bbox,
     save_debug_grids,
 )
+from maskclustering_tpu.visualize.debug_viewers import (  # noqa: F401
+    compare_mask_dirs,
+    depth_preview,
+    fused_cloud_preview,
+)

@@ -1,0 +1,121 @@
+"""Headless debug viewers for preprocessed scenes.
+
+Covers the reference's three remaining tasmap debug tools (SURVEY.md §2.1
+"misc tasmap debug viewers") without an interactive Open3D window — outputs
+are files, usable over SSH on a TPU-VM:
+
+- depth_preview: per-frame backprojected depth cloud to PLY + a colormapped
+  depth PNG (reference tasmap/vis_depth.py:127-148 streams the same clouds
+  into an o3d window);
+- compare_mask_dirs: stacked side-by-side composite per common frame of two
+  mask-visualization directories, separated by a black rule (reference
+  tasmap/compare_masks.py);
+- fused_cloud_preview: strided fusion of backprojected RGB-D frames with a
+  per-frame point cap, written as a colored PLY (reference
+  tasmap/visualize_preprocessed.py:54-105).
+
+All three operate on the dataset duck-type (get_depth / get_rgb /
+get_intrinsics / get_extrinsic / get_frame_list) so they work for any
+registered dataset, not just tasmap.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from maskclustering_tpu.io.ply import write_ply_points
+
+
+def _backproject_frame(dataset, frame_id, max_points: Optional[int] = None,
+                       rng: Optional[np.random.Generator] = None):
+    """(points (M, 3), colors (M, 3) uint8) of one frame's valid depth."""
+    depth = np.asarray(dataset.get_depth(frame_id), dtype=np.float64)
+    intr = np.asarray(dataset.get_intrinsics(frame_id), dtype=np.float64)
+    c2w = np.asarray(dataset.get_extrinsic(frame_id), dtype=np.float64)
+    rgb = np.asarray(dataset.get_rgb(frame_id))
+    h, w = depth.shape
+    if rgb.shape[:2] != (h, w):
+        from maskclustering_tpu.io.image import resize_nearest
+
+        rgb = resize_nearest(rgb, (w, h))
+    if not np.all(np.isfinite(c2w)):
+        return np.zeros((0, 3)), np.zeros((0, 3), np.uint8)
+    v, u = np.mgrid[0:h, 0:w]
+    ok = depth > 0
+    z = depth[ok]
+    fx, fy, cx, cy = intr[0, 0], intr[1, 1], intr[0, 2], intr[1, 2]
+    pts = np.stack([(u[ok] - cx) / fx * z, (v[ok] - cy) / fy * z, z], axis=1)
+    pts = pts @ c2w[:3, :3].T + c2w[:3, 3]
+    cols = rgb[ok]
+    if max_points is not None and len(pts) > max_points:
+        rng = rng or np.random.default_rng(0)
+        idx = rng.choice(len(pts), max_points, replace=False)
+        pts, cols = pts[idx], cols[idx]
+    return pts, cols
+
+
+def depth_preview(dataset, frame_id, out_dir: str) -> List[str]:
+    """One frame's depth as a colormapped PNG + backprojected PLY."""
+    from PIL import Image
+
+    os.makedirs(out_dir, exist_ok=True)
+    depth = np.asarray(dataset.get_depth(frame_id), dtype=np.float64)
+    dmax = float(depth.max()) or 1.0
+    norm = np.clip(depth / dmax, 0.0, 1.0)
+    # simple turbo-ish ramp: near = warm, far = cold, invalid = black
+    r = np.clip(1.5 - np.abs(2.0 * norm - 0.5) * 2.0, 0, 1)
+    g = np.clip(1.5 - np.abs(2.0 * norm - 1.0) * 2.0, 0, 1)
+    b = np.clip(1.5 - np.abs(2.0 * norm - 1.5) * 2.0, 0, 1)
+    img = (np.stack([r, g, b], axis=-1) * 255).astype(np.uint8)
+    img[depth <= 0] = 0
+    png_path = os.path.join(out_dir, f"depth_{frame_id}.png")
+    Image.fromarray(img).save(png_path)
+
+    pts, cols = _backproject_frame(dataset, frame_id)
+    ply_path = os.path.join(out_dir, f"depth_{frame_id}.ply")
+    write_ply_points(ply_path, pts.astype(np.float32), cols)
+    return [png_path, ply_path]
+
+
+def compare_mask_dirs(dir_a: str, dir_b: str, out_dir: str,
+                      separator_height: int = 2) -> List[str]:
+    """Stack same-named images from two directories with a black rule."""
+    from PIL import Image
+
+    os.makedirs(out_dir, exist_ok=True)
+    common = sorted(set(os.listdir(dir_a)) & set(os.listdir(dir_b)))
+    written = []
+    for name in common:
+        a = Image.open(os.path.join(dir_a, name)).convert("RGB")
+        b = Image.open(os.path.join(dir_b, name)).convert("RGB")
+        out = Image.new("RGB", (max(a.width, b.width),
+                                a.height + separator_height + b.height),
+                        (0, 0, 0))
+        out.paste(a, (0, 0))
+        out.paste(b, (0, a.height + separator_height))
+        path = os.path.join(out_dir, name)
+        out.save(path)
+        written.append(path)
+    return written
+
+
+def fused_cloud_preview(dataset, out_path: str, stride: int = 1,
+                        max_points_per_frame: int = 200_000,
+                        frame_ids: Optional[Sequence] = None) -> str:
+    """Fuse strided backprojected RGB-D frames into one colored PLY."""
+    rng = np.random.default_rng(0)
+    ids = list(frame_ids) if frame_ids is not None else dataset.get_frame_list(stride)
+    all_pts, all_cols = [], []
+    for fid in ids:
+        pts, cols = _backproject_frame(dataset, fid,
+                                       max_points=max_points_per_frame, rng=rng)
+        all_pts.append(pts)
+        all_cols.append(cols)
+    pts = np.concatenate(all_pts) if all_pts else np.zeros((0, 3))
+    cols = np.concatenate(all_cols) if all_cols else np.zeros((0, 3), np.uint8)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    write_ply_points(out_path, pts.astype(np.float32), cols)
+    return out_path

@@ -71,10 +71,15 @@ def main():
     p.add_argument("--scenes", type=int, default=3)
     p.add_argument("--frames", type=int, default=16)
     p.add_argument("--boxes", type=int, default=4)
-    p.add_argument("--spacing", type=float, default=0.006)
+    p.add_argument("--spacing", type=float, default=0.008)
+    p.add_argument("--floor-spacing", type=float, default=0.016)
     p.add_argument("--noise", type=float, default=0.002, help="depth noise sigma (m)")
-    p.add_argument("--image-h", type=int, default=240)
-    p.add_argument("--image-w", type=int, default=320)
+    # 480x640 = ScanNet depth size; at r = 0.01 the pixel grid must be finer
+    # than the radius or NEITHER path can claim (pixel 3D spacing ~5 mm at 3 m)
+    p.add_argument("--image-h", type=int, default=480)
+    p.add_argument("--image-w", type=int, default=640)
+    p.add_argument("--ap50-bound", type=float, default=0.05,
+                   help="max |AP50 gap| for PASS (exit 0)")
     p.add_argument("--out", default="PARITY.md")
     args = p.parse_args()
 
@@ -102,7 +107,8 @@ def main():
         rng = np.random.default_rng(1000 + s)
         scene = make_scene(num_boxes=args.boxes, num_frames=args.frames,
                            image_hw=(args.image_h, args.image_w),
-                           spacing=args.spacing, seed=100 + s)
+                           spacing=args.spacing, floor_spacing=args.floor_spacing,
+                           seed=100 + s)
         noisy = scene.depths + rng.normal(
             scale=args.noise, size=scene.depths.shape).astype(np.float32)
         scene.depths[:] = np.where(scene.depths > 0, np.maximum(noisy, 1e-3), 0.0)
@@ -194,7 +200,10 @@ def main():
         f"Aggregate mask-set Jaccard: mean {np.mean(jms):.3f} "
         f"(min scene {np.min(jms):.3f}).",
         "",
-        "## Bound",
+        "## Bound and verdict",
+        "",
+        f"Pass criterion: |AP50 gap| <= {args.ap50_bound:.2f} "
+        "(VERDICT r3 task 2).",
         "",
         f"On this benchmark the dense path's class-agnostic AP is within "
         f"{abs(d_ap - e_ap):.4f} of the exact reference-semantics path "
@@ -203,11 +212,15 @@ def main():
         "selectable per run via `use_exact_ball_query` for real-data "
         "validation.",
         "",
+        f"**Verdict: {'PASS' if abs(d_ap50 - e_ap50) <= args.ap50_bound else 'FAIL'}** "
+        f"(|AP50 gap| = {abs(d_ap50 - e_ap50):.4f}).",
+        "",
     ]
     with open(args.out, "w") as f:
         f.write("\n".join(lines))
     print(f"[parity] wrote {args.out}", file=sys.stderr)
     print("\n".join(lines))
+    sys.exit(0 if abs(d_ap50 - e_ap50) <= args.ap50_bound else 1)
 
 
 if __name__ == "__main__":

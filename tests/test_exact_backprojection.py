@@ -178,3 +178,27 @@ class TestExactPipelineParity:
         last = np.asarray(assoc.last_id)
         shared = (first != last) & (last > 0)
         assert not np.any(mop[shared])
+
+
+@pytest.mark.skipif(
+    __import__("jax").default_backend() != "tpu",
+    reason="non-interpret Pallas needs a real TPU (Mosaic lowering)")
+def test_ball_query_pallas_non_interpret_on_tpu(rng):
+    """Mosaic-lowered kernel vs the jnp path on a live chip (VERDICT r3
+    task 6); every other test runs interpret=True on CPU."""
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.ops.neighbor import ball_query
+    from maskclustering_tpu.ops.pallas.ball_query import ball_query_pallas
+
+    q = rng.random((2, 200, 3)).astype(np.float32)
+    c = rng.random((2, 500, 3)).astype(np.float32)
+    ql = np.array([200, 150], np.int32)
+    cl = np.array([500, 333], np.int32)
+    got = np.asarray(ball_query_pallas(
+        jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
+        k=8, radius=0.1, interpret=False))
+    want = np.asarray(ball_query(
+        jnp.asarray(q), jnp.asarray(c), jnp.asarray(ql), jnp.asarray(cl),
+        k=8, radius=0.1))
+    np.testing.assert_array_equal(got, want)

@@ -154,3 +154,58 @@ class TestGrids:
         assert len(grids) == 1 and os.path.exists(grids[0])
         bboxes = os.listdir(tmp_path / "bbox")
         assert len(bboxes) == 2
+
+
+class TestDebugViewers:
+    """Headless analogs of the reference's tasmap debug viewers
+    (vis_depth.py:127-148, compare_masks.py, visualize_preprocessed.py:54-105)."""
+
+    @staticmethod
+    def _dataset(tmp_path):
+        from maskclustering_tpu.datasets import get_dataset
+        from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
+
+        scene = make_scene(num_boxes=2, num_frames=4, image_hw=(48, 64), seed=5)
+        root = str(tmp_path / "data")
+        write_scannet_layout(scene, root, "scene0400_00")
+        return get_dataset("scannet", "scene0400_00", data_root=root), scene
+
+    def test_depth_preview(self, tmp_path):
+        from maskclustering_tpu.visualize import depth_preview
+
+        ds, scene = self._dataset(tmp_path)
+        fid = ds.get_frame_list(1)[0]
+        png, ply = depth_preview(ds, fid, str(tmp_path / "dbg"))
+        assert os.path.exists(png) and os.path.exists(ply)
+        pts = read_ply_points(ply)
+        assert len(pts) == (scene.depths[0] > 0).sum()
+        # backprojected depth must land near the scene geometry extents
+        assert np.abs(pts).max() < 10.0
+
+    def test_compare_mask_dirs(self, tmp_path):
+        from PIL import Image
+
+        from maskclustering_tpu.visualize import compare_mask_dirs
+
+        a, b = tmp_path / "a", tmp_path / "b"
+        a.mkdir(); b.mkdir()
+        for d, val in ((a, 60), (b, 180)):
+            for name in ("0.png", "1.png"):
+                Image.fromarray(np.full((10, 16, 3), val, np.uint8)).save(d / name)
+        Image.fromarray(np.zeros((10, 16, 3), np.uint8)).save(a / "only_a.png")
+        out = compare_mask_dirs(str(a), str(b), str(tmp_path / "cmp"))
+        assert len(out) == 2  # only common names
+        img = np.asarray(Image.open(out[0]))
+        assert img.shape == (22, 16, 3)  # 10 + 2 separator + 10
+        assert (img[10:12] == 0).all()  # black rule
+        assert (img[:10] == 60).all() and (img[12:] == 180).all()
+
+    def test_fused_cloud_preview(self, tmp_path):
+        from maskclustering_tpu.visualize import fused_cloud_preview
+
+        ds, scene = self._dataset(tmp_path)
+        out = fused_cloud_preview(ds, str(tmp_path / "fused.ply"), stride=2,
+                                  max_points_per_frame=500)
+        pts, cols = read_ply_points(out, return_colors=True)
+        assert 0 < len(pts) <= 2 * 500
+        assert cols.shape == (len(pts), 3)
