@@ -86,6 +86,28 @@ def voxel_keys(points: jnp.ndarray, voxel_size: float, origin: jnp.ndarray) -> j
     return jnp.floor((points - origin) / voxel_size).astype(jnp.int32)
 
 
+def backproject_depth_np(depth: np.ndarray, intrinsics: np.ndarray,
+                         cam_to_world: np.ndarray, depth_trunc: float = np.inf):
+    """Host pinhole backprojection: (world points (M, 3) f64, valid (H, W) bool).
+
+    The single source of truth for host-side depth-to-world geometry —
+    shared by the exact-parity association path and the debug viewers so a
+    convention change (pixel centers, truncation) cannot drift between them.
+    """
+    depth = np.asarray(depth, dtype=np.float64)
+    intrinsics = np.asarray(intrinsics, dtype=np.float64)
+    cam_to_world = np.asarray(cam_to_world, dtype=np.float64)
+    h, w = depth.shape
+    fx, fy = intrinsics[0, 0], intrinsics[1, 1]
+    cx, cy = intrinsics[0, 2], intrinsics[1, 2]
+    v, u = np.mgrid[0:h, 0:w]
+    valid = (depth > 0) & (depth <= depth_trunc)
+    z = depth[valid]
+    pts = np.stack([(u[valid] - cx) / fx * z, (v[valid] - cy) / fy * z, z], axis=1)
+    pts = pts @ cam_to_world[:3, :3].T + cam_to_world[:3, 3]
+    return pts, valid
+
+
 def voxel_downsample_np(points: np.ndarray, voxel_size: float) -> np.ndarray:
     """Host-side voxel downsample: mean of points per occupied voxel.
 

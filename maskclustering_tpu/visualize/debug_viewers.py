@@ -32,6 +32,8 @@ from maskclustering_tpu.io.ply import write_ply_points
 def _backproject_frame(dataset, frame_id, max_points: Optional[int] = None,
                        rng: Optional[np.random.Generator] = None):
     """(points (M, 3), colors (M, 3) uint8) of one frame's valid depth."""
+    from maskclustering_tpu.ops.geometry import backproject_depth_np
+
     depth = np.asarray(dataset.get_depth(frame_id), dtype=np.float64)
     intr = np.asarray(dataset.get_intrinsics(frame_id), dtype=np.float64)
     c2w = np.asarray(dataset.get_extrinsic(frame_id), dtype=np.float64)
@@ -43,12 +45,7 @@ def _backproject_frame(dataset, frame_id, max_points: Optional[int] = None,
         rgb = resize_nearest(rgb, (w, h))
     if not np.all(np.isfinite(c2w)):
         return np.zeros((0, 3)), np.zeros((0, 3), np.uint8)
-    v, u = np.mgrid[0:h, 0:w]
-    ok = depth > 0
-    z = depth[ok]
-    fx, fy, cx, cy = intr[0, 0], intr[1, 1], intr[0, 2], intr[1, 2]
-    pts = np.stack([(u[ok] - cx) / fx * z, (v[ok] - cy) / fy * z, z], axis=1)
-    pts = pts @ c2w[:3, :3].T + c2w[:3, 3]
+    pts, ok = backproject_depth_np(depth, intr, c2w)
     cols = rgb[ok]
     if max_points is not None and len(pts) > max_points:
         rng = rng or np.random.default_rng(0)
@@ -85,12 +82,19 @@ def compare_mask_dirs(dir_a: str, dir_b: str, out_dir: str,
     """Stack same-named images from two directories with a black rule."""
     from PIL import Image
 
+    import logging
+
     os.makedirs(out_dir, exist_ok=True)
     common = sorted(set(os.listdir(dir_a)) & set(os.listdir(dir_b)))
     written = []
     for name in common:
-        a = Image.open(os.path.join(dir_a, name)).convert("RGB")
-        b = Image.open(os.path.join(dir_b, name)).convert("RGB")
+        try:
+            a = Image.open(os.path.join(dir_a, name)).convert("RGB")
+            b = Image.open(os.path.join(dir_b, name)).convert("RGB")
+        except Exception:  # stray non-image entries must not abort the compare
+            logging.getLogger("maskclustering_tpu").debug(
+                "compare_mask_dirs: skipping non-image entry %r", name)
+            continue
         out = Image.new("RGB", (max(a.width, b.width),
                                 a.height + separator_height + b.height),
                         (0, 0, 0))
