@@ -371,8 +371,8 @@ def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
     on every invocation (~48 s at ScanNet scale, measured) because the
     eager dispatch cache misses on the fresh closure; routing through one
     persistent jit makes the first scene pay compilation and every later
-    scene (and repeat run) reuse it — steady-state association is
-    milliseconds, not a minute.
+    scene (and repeat run) reuse it. (Steady-state execution cost is
+    gather/bandwidth-bound, not dispatch-bound — see PROFILE.md.)
     """
     return jax.jit(functools.partial(
         _associate_scene_impl, k_max=k_max, window=window,
