@@ -143,16 +143,26 @@ def compute_graph_stats(
     n_vis = jnp.dot(c, frame_onehot)  # f32 matmul of exact integer counts
 
     # ---- segmented max over each frame's masks: who contains me? ----
-    # frame_slot[j, k-1] = global index of mask (j, k), or m_pad (sentinel).
-    # Padding table entries have frame == F (out of bounds) -> dropped.
-    slot = jnp.full((f, k_max), m_pad, dtype=jnp.int32)
-    slot = slot.at[mask_frame, jnp.clip(mask_id - 1, 0, k_max - 1)].set(
-        jnp.arange(m_pad, dtype=jnp.int32), mode="drop")
-    c_ext = jnp.concatenate([c, jnp.full((m_pad, 1), -1.0)], axis=1)  # sentinel col
-    c_by_frame = jnp.take(c_ext, slot.reshape(-1), axis=1).reshape(m_pad, f, k_max)
-    cmax = jnp.max(c_by_frame, axis=2)  # (M_pad, F)
-    argk = jnp.argmax(c_by_frame, axis=2)  # (M_pad, F)
-    top_global = slot[jnp.arange(f)[None, :], argk]  # (M_pad, F) global mask index
+    # Table columns are sorted by (frame, id), so each frame's masks occupy
+    # a CONTIGUOUS column range [starts[j], starts[j+1]): the segmented max
+    # is F dynamic slices of width k_max — sequential reads at HBM speed —
+    # instead of an (M_pad * F * k_max)-element random gather (~1 s/scene
+    # at ScanNet shape, see PROFILE.md's gather cost). Ties resolve to the
+    # lowest mask id in both formulations (columns ascend by id).
+    starts = jnp.searchsorted(mask_frame, jnp.arange(f + 1, dtype=jnp.int32)
+                              ).astype(jnp.int32)  # padding has frame == F
+    c_ext = jnp.concatenate(
+        [c, jnp.full((m_pad, k_max), -1.0)], axis=1)  # slice overrun guard
+
+    def frame_max(j):
+        sl = jax.lax.dynamic_slice(c_ext, (0, starts[j]), (m_pad, k_max))
+        valid_col = jnp.arange(k_max) < (starts[j + 1] - starts[j])
+        sl = jnp.where(valid_col[None, :], sl, -1.0)
+        return jnp.max(sl, axis=1), starts[j] + jnp.argmax(sl, axis=1).astype(jnp.int32)
+
+    cmax, top_global = jax.lax.map(frame_max, jnp.arange(f))  # (F, M_pad) x2
+    cmax = cmax.T  # (M_pad, F)
+    top_global = top_global.T
 
     # ---- visibility / containment / undersegmentation logic ----
     safe_tot = jnp.maximum(n_tot, 1.0)[:, None]
