@@ -574,6 +574,36 @@ def run_pipeline(
     return report
 
 
+def init_backend_or_die(timeout_s: float = 120.0, platform: Optional[str] = None):
+    """Initialize the jax backend under a watchdog.
+
+    A wedged accelerator client hangs inside backend init with no exception
+    (another process holding the chip, a dead tunnel); the watchdog turns a
+    silent multi-minute stall into a one-line diagnosis and a nonzero exit
+    — the failure-detection posture the reference lacks entirely (SURVEY §5).
+    """
+    import threading
+
+    def _watchdog():
+        log.fatal("backend init did not finish within %.0fs "
+                  "(chip busy or runtime wedged)", timeout_s)
+        os._exit(3)
+
+    timer = threading.Timer(timeout_s, _watchdog)
+    timer.daemon = True
+    timer.start()
+    try:
+        import jax
+
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        devices = jax.devices()
+    finally:
+        timer.cancel()
+    log.info("backend up: %dx %s", len(devices), devices[0].device_kind)
+    return devices
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -599,6 +629,8 @@ def main(argv=None) -> int:
     parser.add_argument("--report", default=None, help="run report JSON path")
     parser.add_argument("--data_root", default=None,
                         help="override the config's data root")
+    parser.add_argument("--init_timeout", type=float, default=120.0,
+                        help="seconds before a hung backend init aborts the run")
     parser.add_argument("--debug", action="store_true")
     args = parser.parse_args(argv)
 
@@ -606,6 +638,8 @@ def main(argv=None) -> int:
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     overrides = {"data_root": args.data_root} if args.data_root else {}
     cfg = load_config(args.config, **overrides)
+    init_backend_or_die(args.init_timeout,
+                        platform="cpu" if cfg.backend == "cpu" else None)
     seq_names = get_seq_name_list(cfg.dataset, args.splits_dir, args.seq_name_list)
     log.info("there are %d scenes", len(seq_names))
 
