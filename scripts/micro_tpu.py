@@ -2,6 +2,11 @@
 
 Run on the live chip: python scripts/micro_tpu.py
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import jax

@@ -19,6 +19,11 @@ Usage: PYTHONPATH=. python scripts/parity_ab.py [--scenes 3] [--out PARITY.md]
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import os
 import sys

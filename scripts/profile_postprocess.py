@@ -2,6 +2,11 @@
 
 Run from the repo root:  PYTHONPATH=. JAX_PLATFORMS=cpu python scripts/profile_postprocess.py
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import sys
 import time
 

@@ -1,0 +1,75 @@
+"""Capture a jax.profiler trace of one bench-scale run_scene on a live chip.
+
+Produces a TensorBoard-compatible trace directory with per-op device
+timelines (the committed summary lives in PROFILE.md). Run on a machine
+with a healthy TPU:
+
+    python scripts/profile_scene_tpu.py --trace-dir /tmp/mct_trace
+
+then `tensorboard --logdir /tmp/mct_trace` (or xprof) to inspect.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--trace-dir", default="/tmp/mct_trace")
+    p.add_argument("--frames", type=int, default=250)
+    p.add_argument("--points", type=int, default=196608)
+    p.add_argument("--boxes", type=int, default=36)
+    p.add_argument("--image-h", type=int, default=480)
+    p.add_argument("--image-w", type=int, default=640)
+    p.add_argument("--distance-threshold", type=float, default=0.01)
+    p.add_argument("--platform", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    print(f"devices: {jax.devices()}", file=sys.stderr, flush=True)
+
+    import numpy as np
+
+    from maskclustering_tpu.config import PipelineConfig
+    from maskclustering_tpu.models.pipeline import run_scene
+    from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
+    from maskclustering_tpu.utils.synthetic import make_scene_device
+
+    setup_compilation_cache()
+    tensors, _, _ = make_scene_device(
+        num_boxes=args.boxes, num_frames=args.frames,
+        image_hw=(args.image_h, args.image_w), seed=0)
+    pts = tensors.scene_points
+    if pts.shape[0] < args.points:
+        pts = np.tile(pts, (-(-args.points // pts.shape[0]), 1))[: args.points]
+    tensors.scene_points = np.ascontiguousarray(pts[: args.points], np.float32)
+    cfg = PipelineConfig(config_name="profile", dataset="demo",
+                         distance_threshold=args.distance_threshold,
+                         point_chunk=8192)
+
+    t0 = time.time()
+    run_scene(tensors, cfg, k_max=63)  # warm-up: compile outside the trace
+    print(f"warm-up {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+
+    with jax.profiler.trace(args.trace_dir):
+        t0 = time.time()
+        result = run_scene(tensors, cfg, k_max=63)
+        dt = time.time() - t0
+    print(f"traced run: {dt:.2f}s, timings "
+          f"{ {k: round(v, 2) for k, v in result.timings.items()} }",
+          file=sys.stderr, flush=True)
+    print(f"trace written to {args.trace_dir}", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
