@@ -206,3 +206,11 @@ class TestTasmapVariantSteps:
         assert listed == [out_dir] and os.path.isdir(out_dir)
         removed = clean_scene_outputs(cfg, ["scene0004_00"], dry_run=False)
         assert removed == [out_dir] and not os.path.exists(out_dir)
+
+
+def test_init_backend_or_die_cpu():
+    """Watchdog-wrapped backend init returns devices on a healthy backend."""
+    from maskclustering_tpu.run import init_backend_or_die
+
+    devices = init_backend_or_die(60, platform="cpu")
+    assert len(devices) >= 1
