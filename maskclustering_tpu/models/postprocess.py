@@ -22,7 +22,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
-from maskclustering_tpu.ops.dbscan import dbscan_labels
+from maskclustering_tpu.ops.dbscan import dbscan_labels_parallel
 from maskclustering_tpu.ops.geometry import bboxes_overlap
 
 
@@ -159,13 +159,15 @@ def postprocess_scene(
     rep_offset = np.zeros(m_pad, dtype=np.int64)  # group_offset per rep
     rep_groups = np.zeros(m_pad, dtype=np.int64)  # group count per live rep
     rep_slices: List[Tuple[int, int, int, np.ndarray]] = []  # (rep, s, e, groups)
+    candidates = [rep for rep in reps
+                  if rp_starts[rep + 1] > rp_starts[rep] and node_visible[rep].any()]
+    labels_by_rep = dict(zip(candidates, dbscan_labels_parallel(
+        [scene_points[rp_pt[rp_starts[r]:rp_starts[r + 1]]] for r in candidates],
+        dbscan_eps, dbscan_min_points)))
     group_offset = 0
-    for rep in reps:
+    for rep in candidates:
         s, e = rp_starts[rep], rp_starts[rep + 1]
-        if e == s or not node_visible[rep].any():
-            continue
-        labels = dbscan_labels(scene_points[rp_pt[s:e]], eps=dbscan_eps,
-                               min_points=dbscan_min_points)
+        labels = labels_by_rep[rep]
         groups = labels + 1
         glabel[s:e] = group_offset + groups
         rep_offset[rep] = group_offset

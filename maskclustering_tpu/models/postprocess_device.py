@@ -47,7 +47,7 @@ from maskclustering_tpu.models.postprocess import (
     _PhaseTimer,
     postprocess_scene,
 )
-from maskclustering_tpu.ops.dbscan import dbscan_labels
+from maskclustering_tpu.ops.dbscan import dbscan_labels_parallel
 
 
 def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
@@ -280,21 +280,26 @@ def postprocess_scene_device(
 
     # ---- DBSCAN split per live rep (host, native C++/sklearn) ----
     # group numbering matches the host path: offsets accumulate over reps in
-    # ascending slot order, label 0 (noise) is kept as its own candidate
+    # ascending slot order, label 0 (noise) is kept as its own candidate.
+    # The native call releases the GIL, so reps split in a thread pool;
+    # ordered ex.map keeps the offset assembly deterministic.
+    candidates: List[Tuple[int, np.ndarray]] = []
+    for ridx in range(len(reps)):
+        if not nv_any[ridx]:
+            continue
+        node_pts = np.nonzero(claimed[ridx])[0].astype(np.int32)
+        if len(node_pts):
+            candidates.append((ridx, node_pts))
+    labels_list = dbscan_labels_parallel(
+        [scene_points[pts] for _, pts in candidates], dbscan_eps, dbscan_min_points)
+
     rep_slices: List[Tuple[int, int, np.ndarray, np.ndarray]] = []
     goff_by_ridx = np.zeros(len(reps), dtype=np.int64)
     ngrp_by_ridx = np.zeros(len(reps), dtype=np.int64)
     pt_chunks: List[np.ndarray] = []
     grp_chunks: List[np.ndarray] = []
     group_offset = 0
-    for ridx in range(len(reps)):
-        if not nv_any[ridx]:
-            continue
-        node_pts = np.nonzero(claimed[ridx])[0].astype(np.int32)
-        if len(node_pts) == 0:
-            continue
-        labels = dbscan_labels(scene_points[node_pts], eps=dbscan_eps,
-                               min_points=dbscan_min_points)
+    for (ridx, node_pts), labels in zip(candidates, labels_list):
         groups = (labels + 1).astype(np.int64)
         ngrp = int(groups.max()) + 1
         rep_slices.append((ridx, group_offset, node_pts, groups))

@@ -90,41 +90,158 @@ inline double dist2(const double* pts, int64_t i, int64_t j) {
 
 extern "C" {
 
-// DBSCAN with eps-radius neighborhoods on a uniform grid (cell = eps).
-// labels: -1 noise, clusters numbered 0.. in order of first core discovery
-// (Open3D cluster_dbscan contract; min_points includes the point itself).
+// DBSCAN on a uniform grid of cells with side eps/sqrt(3): the cell diagonal
+// is eps, so any two points sharing a cell are neighbors with NO distance
+// test — a cell holding >= min_points is all-core for free, and all core
+// points of one cell belong to one cluster. Clustering then reduces to a
+// union-find over cells (early-exit pair scans connect neighboring cells),
+// which stays near-linear in dense clouds where the per-point neighbor-list
+// formulation degenerates to O(n * density * eps^3).
+// labels: -1 noise, clusters numbered 0.. in order of their lowest core
+// point index; border points take the lowest neighboring cluster label —
+// both identical to the BFS formulation (and to sklearn/Open3D's scan
+// order, which seeds clusters at ascending unvisited core indices).
+// min_points includes the point itself (Open3D cluster_dbscan contract).
 int mc_dbscan(const double* pts, int64_t n, double eps, int min_points, int64_t* labels) {
     if (n <= 0) return 0;
-    UniformGrid grid(pts, n, eps);
     const double eps2 = eps * eps;
+    const double cell = eps / std::sqrt(3.0);
 
-    std::vector<std::vector<int64_t>> neigh(n);
-    std::vector<uint8_t> core(n, 0);
+    // cells: key -> dense cell id; CSR-ish point lists per cell
+    std::unordered_map<CellKey, int64_t, CellHash> cell_id;
+    cell_id.reserve(static_cast<size_t>(n));
+    std::vector<std::vector<int64_t>> cell_pts;
+    std::vector<int64_t> cid_of(n);
+    std::vector<CellKey> key_of_cell;
     for (int64_t i = 0; i < n; ++i) {
-        auto& ni = neigh[i];
-        grid.for_neighborhood(i, [&](int64_t j) {
-            if (dist2(pts, i, j) <= eps2) ni.push_back(j);  // includes self
-        });
-        core[i] = ni.size() >= static_cast<size_t>(min_points);
+        CellKey k{static_cast<int64_t>(std::floor(pts[3 * i] / cell)),
+                  static_cast<int64_t>(std::floor(pts[3 * i + 1] / cell)),
+                  static_cast<int64_t>(std::floor(pts[3 * i + 2] / cell))};
+        auto it = cell_id.find(k);
+        int64_t c;
+        if (it == cell_id.end()) {
+            c = static_cast<int64_t>(cell_pts.size());
+            cell_id.emplace(k, c);
+            cell_pts.emplace_back();
+            key_of_cell.push_back(k);
+        } else {
+            c = it->second;
+        }
+        cell_pts[c].push_back(i);
+        cid_of[i] = c;
     }
+    const int64_t n_cells = static_cast<int64_t>(cell_pts.size());
 
-    std::fill(labels, labels + n, -1);
-    int64_t next = 0;
-    std::queue<int64_t> q;
-    for (int64_t i = 0; i < n; ++i) {
-        if (!core[i] || labels[i] != -1) continue;
-        int64_t lab = next++;
-        labels[i] = lab;
-        q.push(i);
-        while (!q.empty()) {
-            int64_t u = q.front();
-            q.pop();
-            for (int64_t v : neigh[u]) {
-                if (labels[v] != -1) continue;
-                labels[v] = lab;
-                if (core[v]) q.push(v);
+    // neighbor cell offsets: two points within eps sit at most 2 cells apart
+    // on each axis (eps / (eps/sqrt(3)) = sqrt(3) < 2); every offset in
+    // [-2,2]^3 has min inter-cell distance <= eps, so none can be pruned.
+    auto cell_at = [&](const CellKey& k, int64_t dx, int64_t dy, int64_t dz) -> int64_t {
+        auto it = cell_id.find(CellKey{k.x + dx, k.y + dy, k.z + dz});
+        return it == cell_id.end() ? -1 : it->second;
+    };
+
+    // ---- core determination (early exit at min_points) ----
+    std::vector<uint8_t> core(n, 0);
+    std::vector<std::vector<int64_t>> core_in_cell(n_cells);
+    for (int64_t c = 0; c < n_cells; ++c) {
+        const auto& mine = cell_pts[c];
+        if (static_cast<int>(mine.size()) >= min_points) {
+            for (int64_t i : mine) core[i] = 1;  // in-cell pairs are all <= eps
+        } else {
+            const CellKey k = key_of_cell[c];
+            for (int64_t i : mine) {
+                int cnt = static_cast<int>(mine.size());  // incl. self, all in range
+                for (int64_t dx = -2; dx <= 2 && cnt < min_points; ++dx)
+                    for (int64_t dy = -2; dy <= 2 && cnt < min_points; ++dy)
+                        for (int64_t dz = -2; dz <= 2 && cnt < min_points; ++dz) {
+                            if (dx == 0 && dy == 0 && dz == 0) continue;
+                            int64_t nb = cell_at(k, dx, dy, dz);
+                            if (nb < 0) continue;
+                            for (int64_t j : cell_pts[nb]) {
+                                if (dist2(pts, i, j) <= eps2 && ++cnt >= min_points) break;
+                            }
+                        }
+                core[i] = cnt >= min_points;
             }
         }
+        for (int64_t i : mine)
+            if (core[i]) core_in_cell[c].push_back(i);
+    }
+
+    // ---- union-find over cells holding core points ----
+    std::vector<int64_t> parent(n_cells);
+    for (int64_t c = 0; c < n_cells; ++c) parent[c] = c;
+    std::function<int64_t(int64_t)> find = [&](int64_t x) {
+        while (parent[x] != x) {
+            parent[x] = parent[parent[x]];
+            x = parent[x];
+        }
+        return x;
+    };
+    for (int64_t c = 0; c < n_cells; ++c) {
+        if (core_in_cell[c].empty()) continue;
+        const CellKey k = key_of_cell[c];
+        for (int64_t dx = -2; dx <= 2; ++dx)
+            for (int64_t dy = -2; dy <= 2; ++dy)
+                for (int64_t dz = -2; dz <= 2; ++dz) {
+                    // half-space: visit each unordered cell pair once
+                    if (dx < 0 || (dx == 0 && (dy < 0 || (dy == 0 && dz <= 0)))) continue;
+                    int64_t nb = cell_at(k, dx, dy, dz);
+                    if (nb < 0 || core_in_cell[nb].empty()) continue;
+                    int64_t ra = find(c), rb = find(nb);
+                    if (ra == rb) continue;
+                    for (int64_t a : core_in_cell[c]) {
+                        bool linked = false;
+                        for (int64_t b : core_in_cell[nb]) {
+                            if (dist2(pts, a, b) <= eps2) {
+                                parent[std::max(ra, rb)] = std::min(ra, rb);
+                                linked = true;
+                                break;
+                            }
+                        }
+                        if (linked) break;
+                    }
+                }
+    }
+
+    // ---- labels: clusters numbered by ascending lowest core index ----
+    std::vector<int64_t> root_label(n_cells, -1);
+    int64_t next = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (!core[i]) {
+            labels[i] = -1;
+            continue;
+        }
+        int64_t r = find(cid_of[i]);
+        if (root_label[r] == -1) root_label[r] = next++;
+        labels[i] = root_label[r];
+    }
+
+    // ---- border points: lowest cluster label among in-range core points.
+    // All core points of one cell share a label, so one in-range hit per
+    // neighbor cell suffices; the own cell needs no distance test at all.
+    for (int64_t i = 0; i < n; ++i) {
+        if (core[i]) continue;
+        int64_t best = std::numeric_limits<int64_t>::max();
+        const int64_t c = cid_of[i];
+        if (!core_in_cell[c].empty()) best = root_label[find(c)];
+        const CellKey k = key_of_cell[c];
+        for (int64_t dx = -2; dx <= 2; ++dx)
+            for (int64_t dy = -2; dy <= 2; ++dy)
+                for (int64_t dz = -2; dz <= 2; ++dz) {
+                    if (dx == 0 && dy == 0 && dz == 0) continue;
+                    int64_t nb = cell_at(k, dx, dy, dz);
+                    if (nb < 0 || core_in_cell[nb].empty()) continue;
+                    int64_t lab = root_label[find(nb)];
+                    if (lab >= best) continue;
+                    for (int64_t b : core_in_cell[nb]) {
+                        if (dist2(pts, i, b) <= eps2) {
+                            best = lab;
+                            break;
+                        }
+                    }
+                }
+        if (best != std::numeric_limits<int64_t>::max()) labels[i] = best;
     }
     return 0;
 }

@@ -38,6 +38,25 @@ def dbscan_labels(points: np.ndarray, eps: float, min_points: int) -> np.ndarray
     return DBSCAN(eps=eps, min_samples=min_points).fit(points).labels_.astype(np.int64)
 
 
+def dbscan_labels_parallel(point_sets, eps: float, min_points: int):
+    """dbscan_labels over many point sets, threaded (native call drops the GIL).
+
+    Order-preserving; falls back to a plain loop for 0-1 sets (or when only
+    sklearn — which holds the GIL for most of its run — is available, where
+    threads would just add overhead).
+    """
+    point_sets = list(point_sets)
+    if len(point_sets) <= 1 or not _HAS_NATIVE:
+        return [dbscan_labels(p, eps=eps, min_points=min_points) for p in point_sets]
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    workers = min(len(point_sets), os.cpu_count() or 4)
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        return list(ex.map(
+            lambda p: dbscan_labels(p, eps=eps, min_points=min_points), point_sets))
+
+
 def dbscan_fixed_jax(points, valid, eps: float, min_points: int):
     """Static-shape DBSCAN inside jit: core-point expansion by label propagation.
 
