@@ -124,34 +124,54 @@ def _node_stats_kernel(
 
     Returns (claimed_packed, ratio_packed, nv_rep): (r_pad, N8/8) uint8 x2
     plus the (r_pad, F) bool node-visibility rows for the live reps.
+
+    Each frame contributes one (2R, k2) @ (k2, N) matmul: local-id one-hots
+    of the claim extremes (with a -1 row correction so two masks of the same
+    rep claiming one cell count ONE unique (rep, point, frame) triple, like
+    the host path's sort) hit per-frame weight rows W[r, k] =
+    [rep_tab==r] (* node-visibility for the OVIR numerator). MXU work
+    replaces the (R, N) one-hot/select chain the scan used to materialize
+    per frame; bf16 one-hot operands with f32 accumulation stay exact. The
+    ratio denominator drops out of the scan entirely: one (R, F) @ (F, N)
+    matmul of node-visibility against point-visibility.
     """
     f, n = first.shape
+    k2 = rep_tab.shape[1]
     nv_rep = jnp.take(node_visible, live_slots, axis=0) & live_valid[:, None]
 
-    def step(carry, inp):
-        claimed, num, den = carry
-        a, b, rt, nv_f = inp
-        rep_a = jnp.take(rt, a)  # (N,) dense rep index or -1
-        rep_b = jnp.take(rt, b)
-        oh_a = jax.nn.one_hot(rep_a, r_pad, axis=0, dtype=jnp.float32)  # (R, N)
-        oh_b = jax.nn.one_hot(rep_b, r_pad, axis=0, dtype=jnp.float32)
-        # a claim by either extreme id of the cell; max() dedupes two masks of
-        # the same rep claiming the same (frame, point) — one triple, counted
-        # once (matches the host path's unique-(rep,point,frame) sort)
-        both = jnp.maximum(oh_a, oh_b)
-        nvf = nv_f.astype(jnp.float32)[:, None]
-        claimed = claimed | (both > 0)
-        num = num + both * nvf
-        den = den + nvf * (a > 0).astype(jnp.float32)[None, :]
-        return (claimed, num, den), None
+    rep_oh = jax.nn.one_hot(rep_tab, r_pad, axis=1, dtype=jnp.bfloat16)  # (F, R, k2)
+    w_all = jnp.concatenate(
+        [rep_oh * nv_rep.T[:, :, None].astype(jnp.bfloat16), rep_oh], axis=1
+    )  # (F, 2R, k2): numerator rows (nv-weighted), then claimed rows
 
-    init = (
-        jnp.zeros((r_pad, n), bool),
-        jnp.zeros((r_pad, n), jnp.float32),
-        jnp.zeros((r_pad, n), jnp.float32),
-    )
-    (claimed, num, den), _ = jax.lax.scan(
-        step, init, (first, last, rep_tab, nv_rep.T))
+    def step(carry, inp):
+        acc = carry
+        a, b, rt, w = inp
+        # id 0 = no claim and rep_tab[:, 0] is always -1 (ids are 1-based), so
+        # W column 0 is zero — routing the a == b duplicate there drops it.
+        # Distinct ids of one rep claiming the same cell must also count once
+        # (one unique triple): detect rep_a == rep_b with a != b and subtract
+        # the duplicate via a one-hot on the a id.
+        b2 = jnp.where(b == a, 0, b)
+        rep_a = jnp.take(rt, a)  # (N,) dense rep index or -1
+        rep_b = jnp.take(rt, b2)
+        dup = (rep_a >= 0) & (rep_a == rep_b) & (a != b2)
+        oh_a = jax.nn.one_hot(a, k2, axis=0, dtype=jnp.bfloat16)
+        oh_b = jax.nn.one_hot(b2, k2, axis=0, dtype=jnp.bfloat16)
+        oh_dup = jax.nn.one_hot(jnp.where(dup, a, 0), k2, axis=0, dtype=jnp.bfloat16)
+        m = oh_a + oh_b - oh_dup
+        acc = acc + jnp.dot(w, m, preferred_element_type=jnp.float32)
+        return acc, None
+
+    acc, _ = jax.lax.scan(
+        step, jnp.zeros((2 * r_pad, n), jnp.float32),
+        (first, last, rep_tab, w_all))
+    num = acc[:r_pad]
+    claimed = acc[r_pad:] > 0
+
+    visible = (first > 0).astype(jnp.bfloat16)  # (F, N)
+    den = jnp.dot(nv_rep.astype(jnp.bfloat16), visible,
+                  preferred_element_type=jnp.float32)
 
     ratio_ok = num / (den + 1e-6) > point_filter_threshold
     return _pack_bits(claimed), _pack_bits(ratio_ok), nv_rep

@@ -14,7 +14,6 @@
 #include <cstring>
 #include <functional>
 #include <limits>
-#include <queue>
 #include <unordered_map>
 #include <vector>
 
@@ -44,19 +43,6 @@ class UniformGrid {
         return CellKey{static_cast<int64_t>(std::floor(pts_[3 * i] / cell_)),
                        static_cast<int64_t>(std::floor(pts_[3 * i + 1] / cell_)),
                        static_cast<int64_t>(std::floor(pts_[3 * i + 2] / cell_))};
-    }
-
-    // visit every point in the 27-cell neighborhood of point i
-    template <typename F>
-    void for_neighborhood(int64_t i, F&& f) const {
-        CellKey c = key_of(i);
-        for (int64_t dx = -1; dx <= 1; ++dx)
-            for (int64_t dy = -1; dy <= 1; ++dy)
-                for (int64_t dz = -1; dz <= 1; ++dz) {
-                    auto it = cells_.find(CellKey{c.x + dx, c.y + dy, c.z + dz});
-                    if (it == cells_.end()) continue;
-                    for (int64_t j : it->second) f(j);
-                }
     }
 
     // visit points within a ring of cells at Chebyshev distance r

@@ -61,3 +61,66 @@ def test_device_postprocess_empty_scene():
     res = run_scene(tensors, _config(device_postprocess=True), k_max=15)
     assert res.objects.point_ids_list == []
     assert res.objects.mask_list == []
+
+
+def test_node_stats_kernel_dedupes_same_rep_claims():
+    """num counts one (rep, point, frame) triple even when two DIFFERENT
+    masks of the same representative claim one (frame, point) cell — the
+    matmul formulation subtracts the duplicate via a one-hot correction,
+    and id 0 (= no claim) must contribute nothing.
+    """
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.models.postprocess_device import (
+        _node_stats_kernel, _unpack_bits)
+
+    f, n, k2, r_pad = 3, 16, 6, 8
+    first = np.zeros((f, n), np.int32)
+    last = np.zeros((f, n), np.int32)
+    # masks: frame 0 has ids 1, 2 (both rep 0) and 3 (rep 1); frame 1 has 1 (rep 0)
+    rep_tab = np.full((f, k2), -1, np.int32)
+    rep_tab[0, 1] = rep_tab[0, 2] = 0
+    rep_tab[0, 3] = 1
+    rep_tab[1, 1] = 0
+
+    first[0, 0], last[0, 0] = 1, 2  # same rep twice -> ONE triple for rep 0
+    first[0, 1], last[0, 1] = 1, 3  # reps 0 and 1 -> one triple each
+    first[0, 2], last[0, 2] = 2, 2  # a == b -> one triple for rep 0
+    first[1, 0], last[1, 0] = 1, 1  # second frame claim on point 0
+    first[2, 5], last[2, 5] = 4, 4  # id with no rep mapping -> nothing
+
+    m_pad = 4
+    node_visible = np.zeros((m_pad, f), bool)
+    node_visible[0, :2] = True  # rep slot 0 visible in frames 0, 1
+    node_visible[1, 0] = True  # rep slot 1 visible in frame 0
+    live_slots = np.zeros(r_pad, np.int32)
+    live_slots[:2] = [0, 1]
+    live_valid = np.zeros(r_pad, bool)
+    live_valid[:2] = True
+
+    claimed_p, ratio_p, nv_rep = _node_stats_kernel(
+        jnp.asarray(first), jnp.asarray(last), jnp.asarray(rep_tab),
+        jnp.asarray(node_visible), jnp.asarray(live_slots),
+        jnp.asarray(live_valid), r_pad=r_pad, point_filter_threshold=0.5)
+    claimed = _unpack_bits(np.asarray(claimed_p), n)
+
+    want_claimed = np.zeros((r_pad, n), bool)
+    want_claimed[0, [0, 1, 2]] = True  # rep 0 claims points 0 (x2 frames), 1, 2
+    want_claimed[1, 1] = True  # rep 1 claims point 1
+    np.testing.assert_array_equal(claimed, want_claimed)
+
+    # ratio numerator must count point 0 / rep 0 as 1 triple in frame 0 plus
+    # 1 in frame 1 = 2; denominator = 2 visible frames -> ratio 1.0 > 0.5
+    ratio_ok = _unpack_bits(np.asarray(ratio_p), n)
+    assert ratio_ok[0, 0] and ratio_ok[0, 1] and ratio_ok[0, 2]
+    assert ratio_ok[1, 1]
+    assert not ratio_ok[0, 5] and not ratio_ok[1, 5]
+
+    # discriminating threshold: a failed dedupe would give point 0 / rep 0
+    # num = 3 over den = 2 (ratio 1.5 > 1.25); the correct unique-triple
+    # count gives exactly 1.0, which must NOT pass
+    _, ratio_hi_p, _ = _node_stats_kernel(
+        jnp.asarray(first), jnp.asarray(last), jnp.asarray(rep_tab),
+        jnp.asarray(node_visible), jnp.asarray(live_slots),
+        jnp.asarray(live_valid), r_pad=r_pad, point_filter_threshold=1.25)
+    assert not _unpack_bits(np.asarray(ratio_hi_p), n)[0, 0]
