@@ -210,45 +210,46 @@ def associate_frame(
     vi = jnp.round(py / safe_z * fy + cy).astype(jnp.int32)
 
     # ---- gather the pixel window; record claiming mask id per candidate ----
-    # One take per window ROW instead of one per (pixel, channel): depth and
-    # seg interleave into a (H*W, 2*(2w+1)) table whose row at (v, u) holds
-    # the horizontal strip [u-w .. u+w] of both channels, so a single gather
-    # fetches the whole strip. Gathers dominate association on TPU
-    # (~1.5 ms per 192k-index take, scripts/micro_tpu.py); this cuts them
-    # from 3*(2w+1)^2 to (2w+1) per frame. Horizontal out-of-bounds pixels
-    # read the zero padding (depth 0 -> never claims), replacing the
-    # per-offset bounds mask.
+    # ONE take per frame: depth and seg interleave into a (H*W, 2*(2w+1)^2)
+    # tile table whose row at (v, u) holds the FULL [v-w..v+w] x [u-w..u+w]
+    # window of both channels, so a single gather fetches every candidate.
+    # Gather cost on TPU is per-index, not per-byte (~1.5 ms per 192k-index
+    # take regardless of row width, scripts/micro_tpu.py), so folding the
+    # (2w+1) row-strip takes into one cuts the dominant association cost by
+    # that factor. Out-of-bounds pixels on either axis read the zero padding
+    # (depth 0 -> never claims), replacing the per-offset bounds masks.
     ww = 2 * window + 1
     dz = jnp.where(depth_ok, depth, 0.0)
     padded = jnp.pad(
         jnp.stack([dz, seg.astype(jnp.float32)], axis=-1),
-        ((0, 0), (window, window), (0, 0)))  # (H, W+2w, 2)
-    strips = jnp.concatenate(
-        [padded[:, k : k + w] for k in range(ww)], axis=-1)  # (H, W, 2*ww)
-    strip_tab = strips.reshape(h * w, 2 * ww)
+        ((window, window), (window, window), (0, 0)))  # (H+2w, W+2w, 2)
+    tiles = jnp.concatenate(
+        [padded[kv : kv + h, ku : ku + w]
+         for kv in range(ww) for ku in range(ww)], axis=-1)  # (H, W, 2*ww^2)
+    tile_tab = tiles.reshape(h * w, 2 * ww * ww)
 
     r2 = distance_threshold * distance_threshold
-    # clip the center column; strips at a clipped center still contain every
-    # in-bounds pixel of the ORIGINAL [ui-w .. ui+w] window (the clip shifts
-    # by <= window), and the |u - ui| <= window test keeps exactly those —
-    # border behavior is identical to the per-offset formulation
+    # clip the center pixel; tiles at a clipped center still contain every
+    # in-bounds pixel of the ORIGINAL [vi-w..vi+w] x [ui-w..ui+w] window
+    # (the clip shifts by <= window on each axis), and the |.| <= window
+    # tests keep exactly those — border behavior is identical to the
+    # per-offset formulation
     uc = jnp.clip(ui, 0, w - 1)
+    vc = jnp.clip(vi, 0, h - 1)
+    g = jnp.take(tile_tab, vc * w + uc, axis=0)  # (N, 2*ww^2)
     cand_cols = []
-    for dv in range(-window, window + 1):
-        vv = vi + dv
-        row_ok = in_front & (vv >= 0) & (vv < h)
-        vc = jnp.clip(vv, 0, h - 1)
-        g = jnp.take(strip_tab, vc * w + uc, axis=0)  # (N, 2*ww)
-        for j, du in enumerate(range(-window, window + 1)):
-            d = g[:, 2 * j]
-            s = g[:, 2 * j + 1].astype(jnp.int32)
-            win_ok = jnp.abs(uc + du - ui) <= window
-            # 3D position of this pixel's backprojection, in camera frame
-            qx = (uc + du - cx) * d / fx
-            qy = (vc - cy) * d / fy
-            dist2 = (qx - px) ** 2 + (qy - py) ** 2 + (d - pz) ** 2
-            claim = row_ok & win_ok & (d > 0) & (s > 0) & (dist2 <= r2)
-            cand_cols.append(jnp.where(claim, s, 0))
+    for j, (dv, du) in enumerate(
+            (dv, du) for dv in range(-window, window + 1)
+            for du in range(-window, window + 1)):
+        d = g[:, 2 * j]
+        s = g[:, 2 * j + 1].astype(jnp.int32)
+        win_ok = (jnp.abs(uc + du - ui) <= window) & (jnp.abs(vc + dv - vi) <= window)
+        # 3D position of this pixel's backprojection, in camera frame
+        qx = (uc + du - cx) * d / fx
+        qy = (vc + dv - cy) * d / fy
+        dist2 = (qx - px) ** 2 + (qy - py) ** 2 + (d - pz) ** 2
+        claim = in_front & win_ok & (d > 0) & (s > 0) & (dist2 <= r2)
+        cand_cols.append(jnp.where(claim, s, 0))
     cand = jnp.stack(cand_cols, axis=1)  # (N, (2w+1)^2) claiming mask ids, 0 = none
 
     # ---- per-mask statistics ----

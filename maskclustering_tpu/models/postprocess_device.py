@@ -14,11 +14,11 @@ tensors the device already holds, so this module keeps it there:
   (live representative r, point p): ``claimed`` (p is a node point of r),
   ``num`` (frames where p is claimed by a node mask with node-visibility,
   the OVIR detection-ratio numerator, reference post_process.py:56-76) and
-  ``den`` (node-visible frames where p is visible at all). Claim ids map to
-  dense representative indices through a tiny per-frame lookup table; the
-  per-frame (R, N) updates are one-hot products — vector ops, no scatters,
-  no gathers from large tables (both are slow on TPU; measured in
-  scripts/micro_tpu.py).
+  ``den`` (node-visible frames where p is visible at all). Each frame is
+  one (2R, k2) @ (k2, N) MXU matmul of local-id one-hots against per-frame
+  rep-weight rows (no scatters, no gathers from large tables — both slow
+  on TPU, measured in scripts/micro_tpu.py); ``den`` is a single
+  (R, F) @ (F, N) matmul outside the scan.
 - results return as bit-packed uint8 planes (8x smaller transfer).
 - host runs DBSCAN per representative on the compact node point lists
   (reference post_process.py:104-123 uses Open3D's C++ DBSCAN on host too)
@@ -139,14 +139,15 @@ def _node_stats_kernel(
     k2 = rep_tab.shape[1]
     nv_rep = jnp.take(node_visible, live_slots, axis=0) & live_valid[:, None]
 
-    rep_oh = jax.nn.one_hot(rep_tab, r_pad, axis=1, dtype=jnp.bfloat16)  # (F, R, k2)
-    w_all = jnp.concatenate(
-        [rep_oh * nv_rep.T[:, :, None].astype(jnp.bfloat16), rep_oh], axis=1
-    )  # (F, 2R, k2): numerator rows (nv-weighted), then claimed rows
-
     def step(carry, inp):
         acc = carry
-        a, b, rt, w = inp
+        a, b, rt, nv_f = inp
+        # per-frame weight rows, built in-step from the scanned (k2,) rep row
+        # and (R,) nv column — negligible VPU work vs holding an (F, 2R, k2)
+        # tensor in HBM for the whole scan
+        rep_oh = jax.nn.one_hot(rt, r_pad, axis=0, dtype=jnp.bfloat16)  # (R, k2)
+        w = jnp.concatenate(
+            [rep_oh * nv_f.astype(jnp.bfloat16)[:, None], rep_oh], axis=0)
         # id 0 = no claim and rep_tab[:, 0] is always -1 (ids are 1-based), so
         # W column 0 is zero — routing the a == b duplicate there drops it.
         # Distinct ids of one rep claiming the same cell must also count once
@@ -165,7 +166,7 @@ def _node_stats_kernel(
 
     acc, _ = jax.lax.scan(
         step, jnp.zeros((2 * r_pad, n), jnp.float32),
-        (first, last, rep_tab, w_all))
+        (first, last, rep_tab, nv_rep.T))
     num = acc[:r_pad]
     claimed = acc[r_pad:] > 0
 
