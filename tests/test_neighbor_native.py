@@ -134,3 +134,34 @@ def test_dbscan_fixed_jax_matches_host():
     n_jax = len(np.unique(lab[:len(pts)][lab[:len(pts)] >= 0]))
     n_ref = len(np.unique(ref[ref >= 0]))
     assert n_jax == n_ref == 3
+
+
+def test_native_dbscan_dense_cloud_near_linear():
+    """Complexity guard: 50k densely-packed points must cluster in seconds.
+
+    The per-point neighbor-list formulation degenerated to O(n * density *
+    eps^3) on dense clouds (~10 s at this shape); the grid/union-find
+    version runs it in ~35 ms. The generous bound stays robust on a loaded
+    CI host while still failing any quadratic regression by an order of
+    magnitude.
+    """
+    import time
+
+    from maskclustering_tpu.native import native_available, native_dbscan
+
+    if not native_available():
+        pytest.skip("native lib not built")
+    # call native_dbscan directly: dbscan_labels dispatches on an
+    # import-time-frozen flag, which would silently time the sklearn
+    # fallback when the .so was built mid-session by an earlier test
+    rng = np.random.default_rng(7)
+    n = 50_000
+    side = int(np.ceil(n ** (1 / 3)))
+    grid = np.stack(np.meshgrid(*[np.arange(side)] * 3, indexing="ij"),
+                    -1).reshape(-1, 3)[:n] * 0.008
+    pts = grid + rng.normal(0, 0.002, grid.shape)
+    t0 = time.perf_counter()
+    labels = native_dbscan(pts, 0.1, 4)
+    dt = time.perf_counter() - t0
+    assert labels.max() == 0 and (labels >= 0).all()  # one dense cluster
+    assert dt < 5.0, f"dense DBSCAN took {dt:.1f}s — complexity regression"
