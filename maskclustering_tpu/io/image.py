@@ -22,14 +22,20 @@ from PIL import Image
 
 
 def read_depth_png(path: str, depth_scale: float = 1000.0) -> np.ndarray:
-    """Read a 16-bit depth PNG and convert to metres (float32)."""
+    """Read a 16-bit depth PNG and convert to metres (float32).
+
+    The conversion is computed as ``raw.astype(f32) * f32(1/scale)`` — the
+    exact operation the device-feed codec (io/feed.py) replays after a
+    uint16 upload, so the compact-feed path is bit-identical to loading
+    f32 on host (IEEE-754 f32 multiplication is deterministic).
+    """
     if _HAS_CV2:
         raw = cv2.imread(path, cv2.IMREAD_UNCHANGED)
         if raw is None:
             raise FileNotFoundError(path)
     else:
         raw = np.asarray(Image.open(path))
-    return (raw.astype(np.float64) / depth_scale).astype(np.float32)
+    return raw.astype(np.float32) * np.float32(1.0 / depth_scale)
 
 
 def read_rgb(path: str) -> np.ndarray:

@@ -63,12 +63,12 @@ def pad_scene_tensors(tensors: SceneTensors, f_pad: int, n_pad: int) -> SceneTen
     Padded frames are invalid (frame_valid=False -> no claims); padded
     points sit at a far sentinel coordinate no frustum reaches within
     depth_trunc (same invariants as the mesh batch path, parallel/batch.py).
-    Image-shaped arrays pad via jnp so device-resident inputs stay on
-    device; the point cloud stays host numpy (post-process reads it there).
+    Frame arrays pad in their current residence: device arrays via jnp (the
+    bench renders frames directly in HBM), host numpy via np — host frames
+    MUST stay host so the compact-feed codec (io/feed.py) still sees them
+    before the upload in associate_scene_tensors.
     """
     import dataclasses
-
-    import jax.numpy as jnp
 
     f, n = tensors.num_frames, tensors.num_points
     if f == f_pad and n == n_pad:
@@ -78,15 +78,20 @@ def pad_scene_tensors(tensors: SceneTensors, f_pad: int, n_pad: int) -> SceneTen
     pts = np.full((n_pad, 3), 1.0e4, dtype=np.float32)
     pts[:n] = tensors.scene_points
     df = f_pad - f
+
+    def pad_frames(arr, constant_values=0.0):
+        widths = ((0, df),) + ((0, 0),) * (np.ndim(arr) - 1)
+        if isinstance(arr, jnp.ndarray) and not isinstance(arr, np.ndarray):
+            return jnp.pad(arr, widths, constant_values=constant_values)
+        return np.pad(np.asarray(arr), widths, constant_values=constant_values)
+
     return dataclasses.replace(
         tensors,
         scene_points=pts,
-        depths=jnp.pad(jnp.asarray(tensors.depths), ((0, df), (0, 0), (0, 0))),
-        segmentations=jnp.pad(jnp.asarray(tensors.segmentations), ((0, df), (0, 0), (0, 0))),
-        intrinsics=jnp.pad(jnp.asarray(tensors.intrinsics), ((0, df), (0, 0), (0, 0)),
-                           constant_values=1.0),
-        cam_to_world=jnp.pad(jnp.asarray(tensors.cam_to_world), ((0, df), (0, 0), (0, 0)),
-                             constant_values=0.0),
+        depths=pad_frames(tensors.depths),
+        segmentations=pad_frames(tensors.segmentations),
+        intrinsics=pad_frames(tensors.intrinsics, constant_values=1.0),
+        cam_to_world=pad_frames(tensors.cam_to_world, constant_values=0.0),
         frame_valid=np.concatenate([np.asarray(tensors.frame_valid),
                                     np.zeros(df, dtype=bool)]),
         frame_ids=list(tensors.frame_ids) + [None] * df,

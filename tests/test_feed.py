@@ -1,0 +1,115 @@
+"""Compact host->device feed codec: lossless-or-fallback guarantees.
+
+The codec may only engage when the uint16 round trip is bit-exact
+(io/feed.py); these tests pin the engage/fallback decisions and prove the
+association results are identical through either path.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from maskclustering_tpu.io.feed import (
+    decode_depth,
+    decode_seg,
+    encode_depth,
+    encode_seg,
+    to_device_frames,
+)
+
+
+def _mm_depth(rng, shape, scale=1000.0):
+    """Depth exactly as read_depth_png produces it from a uint16 PNG."""
+    raw = rng.integers(0, 8000, size=shape).astype(np.uint16)
+    return raw.astype(np.float32) * np.float32(1.0 / scale)
+
+
+def test_depth_mm_roundtrip_bit_exact():
+    rng = np.random.default_rng(0)
+    d = _mm_depth(rng, (3, 24, 32))
+    enc, scale = encode_depth(d)
+    assert enc.dtype == np.uint16 and scale == 1000.0
+    dec = np.asarray(decode_depth(jnp.asarray(enc), scale))
+    np.testing.assert_array_equal(dec.view(np.uint32), d.view(np.uint32))  # bitwise
+
+
+def test_depth_quarter_mm_uses_4000_scale():
+    rng = np.random.default_rng(1)
+    # odd quanta ensure the 1000-scale attempt cannot round-trip
+    raw = (rng.integers(0, 8000, size=(2, 16, 16)) * 4 + 1).astype(np.uint16)
+    d = raw.astype(np.float32) * np.float32(1.0 / 4000.0)
+    enc, scale = encode_depth(d)
+    assert scale == 4000.0
+    dec = np.asarray(decode_depth(jnp.asarray(enc), scale))
+    np.testing.assert_array_equal(dec, d)
+
+
+def test_depth_noisy_falls_back_to_f32():
+    rng = np.random.default_rng(2)
+    d = rng.random((2, 8, 8)).astype(np.float32) * 3.0  # not mm-quantized
+    enc, scale = encode_depth(d)
+    assert scale == 0.0 and enc.dtype == np.float32
+    np.testing.assert_array_equal(np.asarray(decode_depth(jnp.asarray(enc), scale)), d)
+
+
+def test_depth_out_of_range_and_nonfinite_fall_back():
+    big = np.full((1, 2, 2), 70.0, np.float32)  # 70 m -> 70000 mm > u16
+    assert encode_depth(big)[1] == 0.0
+    bad = np.array([[[np.nan, 1.0]]], np.float32)
+    assert encode_depth(bad)[1] == 0.0
+
+
+def test_seg_encoding():
+    assert encode_seg(np.array([[0, 5, 65535]], np.int32)).dtype == np.uint16
+    assert encode_seg(np.array([[0, 70000]], np.int32)).dtype == np.int32
+    assert encode_seg(np.array([[-1, 3]], np.int32)).dtype == np.int32
+    s = np.array([[1, 2], [3, 4]], np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(decode_seg(jnp.asarray(encode_seg(s)))), s)
+
+
+def test_association_identical_through_codec():
+    """Full association on mm-quantized depth: codec path == f32 path."""
+    from maskclustering_tpu.models.backprojection import associate_scene
+
+    rng = np.random.default_rng(3)
+    f, h, w, n = 3, 24, 32, 500
+    depths = _mm_depth(rng, (f, h, w))
+    segs = rng.integers(0, 4, size=(f, h, w)).astype(np.int32)
+    intr = np.tile(np.array([[30.0, 0, 16], [0, 30.0, 12], [0, 0, 1]],
+                            np.float32), (f, 1, 1))
+    c2w = np.tile(np.eye(4, dtype=np.float32), (f, 1, 1))
+    fv = np.ones(f, bool)
+    pts = rng.random((n, 3)).astype(np.float32) * 2 - 1
+
+    kw = dict(k_max=7, distance_threshold=0.05)
+    a = associate_scene(jnp.asarray(pts), jnp.asarray(depths), jnp.asarray(segs),
+                        jnp.asarray(intr), jnp.asarray(c2w), jnp.asarray(fv), **kw)
+    d_dev, s_dev = to_device_frames(depths, segs)
+    b = associate_scene(jnp.asarray(pts), d_dev, s_dev,
+                        jnp.asarray(intr), jnp.asarray(c2w), jnp.asarray(fv), **kw)
+    for name in ("mask_of_point", "first_id", "last_id", "mask_valid"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, name)),
+                                      np.asarray(getattr(b, name)), err_msg=name)
+
+
+def test_codec_engages_through_padding_layer():
+    """pad_scene_tensors must keep host frames host-side: an upstream jnp
+    pad would upload f32 before the codec ever sees the arrays, silently
+    disabling the compact feed on every bucketed (= every real) scene.
+    """
+    import dataclasses
+
+    from maskclustering_tpu.models.pipeline import pad_scene_tensors
+    from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+    scene = make_scene(num_boxes=2, num_frames=5, image_hw=(24, 32), seed=9)
+    t = to_scene_tensors(scene)
+    dq = (np.rint(np.asarray(t.depths) * 1000).clip(0, 65535).astype(np.uint16)
+          .astype(np.float32) * np.float32(0.001))
+    t = dataclasses.replace(t, depths=dq,
+                            segmentations=np.asarray(t.segmentations, np.int32))
+    padded = pad_scene_tensors(t, f_pad=8, n_pad=t.num_points + 64)
+    assert isinstance(padded.depths, np.ndarray)  # stayed host-side
+    enc, scale = encode_depth(padded.depths)
+    assert scale == 1000.0 and enc.dtype == np.uint16
+    assert encode_seg(padded.segmentations).dtype == np.uint16
