@@ -28,25 +28,48 @@ import numpy as np
 # PNG scale 1000, .sens exports), then 0.25 mm (ScanNet++ iPhone scale 4000)
 _DEPTH_SCALES = (1000.0, 4000.0)
 
+# The fused mesh step (parallel/sharded.py) carries the feed encoding in the
+# dtype alone, so uint16 there means exactly ONE quantization; its encoder
+# (parallel/batch.py) passes scales=(FUSED_FEED_DEPTH_SCALE,) so no other
+# step can engage. Relaxing the fused path to more scales means threading
+# the scale into build_fused_step, not widening this tuple.
+FUSED_FEED_DEPTH_SCALE = 1000.0
 
-def encode_depth(depths: np.ndarray) -> Tuple[np.ndarray, float]:
+
+def _roundtrips(arr: np.ndarray, scale: float) -> Tuple[bool, np.ndarray]:
+    """(ok, quanta): uint16 quanta reproduce ``arr`` bit-exactly at ``scale``.
+
+    Non-finite values fail the range comparisons (NaN compares False), so
+    no separate finiteness pass is needed.
+    """
+    q = np.rint(arr * np.float32(scale))
+    with np.errstate(invalid="ignore"):
+        if not ((q >= 0) & (q <= 65535)).all():
+            return False, q
+    q16 = q.astype(np.uint16)
+    return bool((q16.astype(np.float32) * np.float32(1.0 / scale) == arr).all()), q16
+
+
+def encode_depth(depths: np.ndarray,
+                 scales: Tuple[float, ...] = _DEPTH_SCALES) -> Tuple[np.ndarray, float]:
     """(encoded, scale): uint16 quanta when bit-exact, else (f32, 0.0).
 
     ``encoded.astype(f32) * f32(1/scale)`` reproduces the input exactly
-    when scale > 0; scale == 0.0 means the f32 array passes through.
+    when scale > 0; scale == 0.0 means the f32 array passes through. A
+    strided ~4k-element probe rejects never-quantized depth before any
+    full-array pass, so the guaranteed-fallback case costs ~nothing.
     """
     depths = np.asarray(depths)
     if depths.dtype != np.float32:  # contract is f32 metres; anything else
         return np.asarray(depths, np.float32), 0.0  # passes through as f32
-    if not np.isfinite(depths).all():  # scale-independent: bail before the loop
-        return depths, 0.0
-    for scale in _DEPTH_SCALES:
-        q = np.rint(depths * np.float32(scale))
-        if not ((q >= 0) & (q <= 65535)).all():
+    flat = depths.ravel()
+    probe = flat[:: max(flat.size // 4096, 1)]
+    for scale in scales:
+        if not _roundtrips(probe, scale)[0]:
             continue
-        q16 = q.astype(np.uint16)
-        if (q16.astype(np.float32) * np.float32(1.0 / scale) == depths).all():
-            return q16, scale
+        ok, q16 = _roundtrips(flat, scale)
+        if ok:
+            return q16.reshape(depths.shape), scale
     return depths, 0.0
 
 

@@ -75,7 +75,17 @@ def pad_scene_batch(tensors_list: Sequence[SceneTensors], f_pad: int, n_pad: int
         intr[i, :f] = t.intrinsics
         c2w[i, :f] = t.cam_to_world
         fv[i, :f] = t.frame_valid
-    return pts, depths, segs, intr, c2w, fv
+
+    # compact feed (io/feed.py): ship uint16 over the host->device link when
+    # bit-exact; the fused step infers the scale from the dtype alone, so
+    # only FUSED_FEED_DEPTH_SCALE is attempted (other quantizations stay f32)
+    from maskclustering_tpu.io.feed import (
+        FUSED_FEED_DEPTH_SCALE, encode_depth, encode_seg)
+
+    enc, scale = encode_depth(depths, scales=(FUSED_FEED_DEPTH_SCALE,))
+    if scale:
+        depths = enc
+    return pts, depths, encode_seg(segs), intr, c2w, fv
 
 
 def fused_scene_objects(

@@ -31,6 +31,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from maskclustering_tpu.io.feed import (
+    FUSED_FEED_DEPTH_SCALE,
+    decode_depth,
+    decode_seg,
+)
 from maskclustering_tpu.models.backprojection import associate_frame, estimate_spacing
 from maskclustering_tpu.models.clustering import iterative_clustering
 from maskclustering_tpu.models.graph import compute_graph_stats, observer_schedule_device
@@ -72,6 +77,13 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
     """
 
     def per_scene(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid):
+        # compact-feed decode (io/feed.py): uint16 depth carries
+        # FUSED_FEED_DEPTH_SCALE quanta by convention (pad_scene_batch only
+        # engages that one scale); f32 passes through untouched. dtype is
+        # static, so jit specializes one program per feed encoding.
+        if depths.dtype == jnp.uint16:
+            depths = decode_depth(depths, FUSED_FEED_DEPTH_SCALE)
+        segs = decode_seg(segs)
         f = depths.shape[0]
         m_pad = f * k_max
 

@@ -113,3 +113,48 @@ def test_codec_engages_through_padding_layer():
     enc, scale = encode_depth(padded.depths)
     assert scale == 1000.0 and enc.dtype == np.uint16
     assert encode_seg(padded.segmentations).dtype == np.uint16
+
+
+def test_fused_step_decodes_uint16_feed():
+    """build_fused_step output must be identical for the uint16-mm feed and
+    the equivalent f32 feed (the decode is the loader's exact f32 multiply).
+    """
+    from maskclustering_tpu.parallel import build_fused_step, fused_step_example_args
+    from maskclustering_tpu.config import PipelineConfig
+
+    cfg = PipelineConfig(config_name="t", dataset="demo", distance_threshold=0.06,
+                         few_points_threshold=10, point_chunk=1024,
+                         max_cluster_iterations=20)
+    step = build_fused_step(None, cfg, k_max=7)
+    args = list(fused_step_example_args(num_scenes=1, num_frames=6))
+    # mm-quantize so both encodings describe the same f32 values
+    dq16 = np.rint(args[1] * 1000).clip(0, 65535).astype(np.uint16)
+    args[1] = dq16.astype(np.float32) * np.float32(0.001)
+    a = step(*map(jnp.asarray, args))
+    args_u16 = list(args)
+    args_u16[1] = dq16
+    args_u16[2] = args[2].astype(np.uint16)
+    b = step(*map(jnp.asarray, args_u16))
+    for name in ("assignment", "mask_active", "first_id", "last_id", "num_objects"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, name)),
+                                      np.asarray(getattr(b, name)), err_msg=name)
+
+
+def test_pad_scene_batch_engages_codec():
+    import dataclasses
+
+    from maskclustering_tpu.parallel.batch import pad_scene_batch
+    from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+    scene = make_scene(num_boxes=2, num_frames=4, image_hw=(24, 32), seed=11)
+    t = to_scene_tensors(scene)
+    dq = (np.rint(np.asarray(t.depths) * 1000).clip(0, 65535).astype(np.uint16)
+          .astype(np.float32) * np.float32(0.001))
+    t = dataclasses.replace(t, depths=dq)
+    _, depths, segs, _, _, _ = pad_scene_batch([t], f_pad=8, n_pad=t.num_points, num_scenes=1)
+    assert depths.dtype == np.uint16
+    assert segs.dtype == np.uint16
+    # noisy depth falls back to f32
+    t2 = to_scene_tensors(make_scene(num_boxes=2, num_frames=4, image_hw=(24, 32), seed=12))
+    _, depths2, _, _, _, _ = pad_scene_batch([t2], f_pad=8, n_pad=t2.num_points, num_scenes=1)
+    assert depths2.dtype == np.float32
