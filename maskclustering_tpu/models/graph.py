@@ -24,7 +24,11 @@ rides the systolic array. From c:
   become boolean tensor algebra.
 
 The observer-count percentile schedule (construction.py:80-96) is computed
-device-side with one sort (no host roundtrip of the O(M^2) matrix).
+from an exact integer histogram of the O(M^2) observer matrix (counts are
+bounded by the frame count, so ~F compare-and-count passes replace a full
+M^2 sort); order statistics read off the cumulative histogram are identical
+to indexing the sorted array, and only the (F+1,)-sized histogram ever
+leaves the device.
 """
 
 from __future__ import annotations
@@ -80,8 +84,9 @@ class GraphStats(NamedTuple):
     contained: jnp.ndarray  # (M_pad, M_pad) bool — reference contained_masks (post-undo)
     undersegment: jnp.ndarray  # (M_pad,) bool
     n_tot: jnp.ndarray  # (M_pad,) f32 valid-point count per mask
-    sorted_observers: jnp.ndarray  # (M_pad^2,) f32 ascending observer counts (exact ints)
-    observers_positive: jnp.ndarray  # () int32: count of positive entries
+    observer_hist: jnp.ndarray  # (F+1,) int32: histogram of observer counts
+    # (counts are ints in [0, F]; bin v = #(mask, mask) pairs with v common
+    # visible frames — the full M_pad^2 matrix including zero rows)
 
 
 def _cooccurrence(mask_of_point: jnp.ndarray, boundary: jnp.ndarray,
@@ -193,20 +198,32 @@ def compute_graph_stats(
     contained = contained & ~undersegment[None, :]
 
     # ---- observer-count distribution for the percentile schedule ----
-    # The sort runs on device; the fractional percentile interpolation runs
-    # on host in float64 (observer_schedule) so thresholds match np.percentile
-    # exactly — an f32 lerp can land epsilon above an integer count and flip
-    # an `observers >= threshold` decision.
+    # Observer counts are exact small integers <= F, so an exact histogram
+    # replaces sorting the M_pad^2 matrix: ~F/8 fused compare-and-count
+    # passes over the matrix instead of an O(M^2 log M^2) sort, and order
+    # statistics from the cumulative histogram equal sorted-array indexing.
+    # The fractional percentile interpolation runs on host in float64
+    # (observer_schedule) so thresholds match np.percentile exactly — an
+    # f32 lerp can land epsilon above an integer count and flip an
+    # `observers >= threshold` decision.
     vis_f = visible.astype(jnp.bfloat16)
     observers = jnp.dot(vis_f, vis_f.T, preferred_element_type=jnp.float32)
-    flat = jnp.sort(observers.reshape(-1))
-    cnt_pos = jnp.sum(flat > 0).astype(jnp.int32)
+    obs_flat = observers.reshape(-1)
+    nbins = f + 1
+    pad_bins = -(-nbins // 8) * 8
+    bin_vals = jnp.arange(pad_bins, dtype=jnp.float32).reshape(-1, 8)
+
+    def hist_chunk(_, vals):  # (8,) bin values; compare+count fuses in XLA
+        return None, jnp.sum(obs_flat[None, :] == vals[:, None], axis=1)
+
+    _, hist8 = jax.lax.scan(hist_chunk, None, bin_vals)
+    observer_hist = hist8.reshape(-1)[:nbins].astype(jnp.int32)
 
     return GraphStats(visible=visible, contained=contained, undersegment=undersegment,
-                      n_tot=n_tot, sorted_observers=flat, observers_positive=cnt_pos)
+                      n_tot=n_tot, observer_hist=observer_hist)
 
 
-def observer_schedule_device(sorted_observers: jnp.ndarray, observers_positive: jnp.ndarray,
+def observer_schedule_device(observer_hist: jnp.ndarray,
                              max_len: int = 20) -> jnp.ndarray:
     """Jittable (f32) observer-percentile schedule for the fused device path.
 
@@ -216,15 +233,21 @@ def observer_schedule_device(sorted_observers: jnp.ndarray, observers_positive: 
     reference's early-termination point (percentile < 50 and value <= 1)
     become +inf, which makes those clustering iterations inert. Host parity
     runs use `observer_schedule` (float64 interpolation).
+
+    ``observer_hist``: integer counts per observer value 0..len-1; the
+    order statistic at rank k is the first value whose cumulative count
+    exceeds k — identical to indexing the sorted flat matrix.
     """
-    total = sorted_observers.shape[0]
-    cnt = observers_positive.astype(jnp.int32)
+    hist = observer_hist.astype(jnp.int32)
+    cum = jnp.cumsum(hist)  # int32: safe to M_pad^2 < 2^31 (M_pad <= ~46k)
+    total = cum[-1]
+    cnt = total - hist[0]  # positive observer pairs
     qs_i = jnp.arange(95, -5, -5, dtype=jnp.int32)[:max_len]
     qs = qs_i.astype(jnp.float32)
     # rank position = (total - cnt) + (cnt - 1) * q / 100, split into an
-    # exact integer part and a fractional remainder so f32 rounding cannot
-    # shift the rank at M_pad^2 > 2^24 scale (cnt*q would overflow i32, so
-    # split cnt-1 = 100*d + r: (cnt-1)*q/100 = d*q + r*q/100).
+    # exact integer part and a fractional remainder so int32 cannot
+    # overflow at M_pad^2 scale (cnt*q would; split cnt-1 = 100*d + r:
+    # (cnt-1)*q/100 = d*q + r*q/100).
     cm1 = jnp.maximum(cnt - 1, 0)
     d, r = cm1 // 100, cm1 % 100
     rq = r * qs_i  # <= 99*95, exact
@@ -232,18 +255,18 @@ def observer_schedule_device(sorted_observers: jnp.ndarray, observers_positive: 
     frac = (rq % 100).astype(jnp.float32) / 100.0
     lo = jnp.clip(lo, 0, total - 1)
     hi = jnp.minimum(lo + 1, total - 1)
-    v_lo = jnp.take(sorted_observers, lo)
-    v_hi = jnp.take(sorted_observers, hi)
+    v_lo = jnp.searchsorted(cum, lo + 1, side="left").astype(jnp.float32)
+    v_hi = jnp.searchsorted(cum, hi + 1, side="left").astype(jnp.float32)
     interp = v_lo * (1.0 - frac) + jnp.where(hi > lo, v_hi, v_lo) * frac
     le1 = interp <= 1.0
     clipped = jnp.where(le1, 1.0, interp)
-    dead = (le1 & (qs < 50)) | (observers_positive == 0)
+    dead = (le1 & (qs < 50)) | (cnt == 0)
     stopped = jnp.cumsum(dead.astype(jnp.int32)) > 0
     return jnp.where(stopped, jnp.inf, clipped)
 
 
-def observer_schedule(sorted_observers, observers_positive, max_len: int = 20) -> np.ndarray:
-    """Observer-count percentile schedule from the device-sorted distribution.
+def observer_schedule(observer_hist, max_len: int = 20) -> np.ndarray:
+    """Observer-count percentile schedule from the observer histogram.
 
     Reference semantics (construction.py:80-96): np.percentile (linear
     interpolation, float64) of the positive observer counts at 95..0 step
@@ -251,19 +274,21 @@ def observer_schedule(sorted_observers, observers_positive, max_len: int = 20) -
     the schedule once below 50. Padded to `max_len` with +inf (an inert
     clustering iteration merges nothing).
 
-    Only O(max_len) elements are pulled from the device array.
+    Only the (F+1,)-sized histogram crosses the device->host boundary; the
+    order statistics it yields are exactly the sorted flat matrix's values.
     """
-    total = int(sorted_observers.shape[0])
-    cnt_pos = int(observers_positive)
+    hist = np.asarray(observer_hist, dtype=np.int64)
+    cum = np.cumsum(hist)
+    total = int(cum[-1])
+    cnt_pos = total - int(hist[0])
     out = []
     if cnt_pos > 0:
         qs = list(range(95, -5, -5))
         pos = (total - cnt_pos) + (cnt_pos - 1) * (np.asarray(qs) / 100.0)  # float64
         lo = np.minimum(np.floor(pos).astype(np.int64), total - 1)
         hi = np.minimum(lo + 1, total - 1)
-        # one gather, one device->host transfer for all 2*len(qs) elements
-        vals = np.asarray(sorted_observers[np.concatenate([lo, hi])]).astype(np.float64)
-        v_lo, v_hi = vals[: len(qs)], vals[len(qs):]
+        v_lo = np.searchsorted(cum, lo + 1, side="left").astype(np.float64)
+        v_hi = np.searchsorted(cum, hi + 1, side="left").astype(np.float64)
         frac = pos - lo
         interp = v_lo * (1.0 - frac) + np.where(hi > lo, v_hi, v_lo) * frac
         for q, val in zip(qs, interp):

@@ -170,7 +170,7 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
         undersegment_filter_threshold=cfg.undersegment_filter_threshold,
         big_mask_point_count=cfg.big_mask_point_count,
     )
-    schedule = observer_schedule(stats.sorted_observers, stats.observers_positive,
+    schedule = observer_schedule(stats.observer_hist,
                                  max_len=cfg.max_cluster_iterations)
     timings["graph"] = time.perf_counter() - t0
 

@@ -127,8 +127,7 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
 
         # ---- schedule + clustering, all on device ----
         schedule = observer_schedule_device(
-            stats.sorted_observers, stats.observers_positive,
-            max_len=cfg.max_cluster_iterations)
+            stats.observer_hist, max_len=cfg.max_cluster_iterations)
         active = active0 & ~stats.undersegment
         result = iterative_clustering(
             visible, contained, active, schedule,

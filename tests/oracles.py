@@ -71,8 +71,12 @@ def oracle_graph_stats(
 def oracle_observer_thresholds(visible: np.ndarray) -> List[float]:
     """Reference construction.py:80-96."""
     v = visible.astype(np.float64)
-    obs = v @ v.T
-    flat = obs.flatten()
+    return oracle_observer_thresholds_from_counts((v @ v.T).flatten())
+
+
+def oracle_observer_thresholds_from_counts(counts: np.ndarray) -> List[float]:
+    """Reference construction.py:80-96 over an explicit count multiset."""
+    flat = np.asarray(counts, np.float64)
     flat = flat[flat > 0]
     out = []
     for percentile in range(95, -5, -5):

@@ -83,7 +83,7 @@ def test_graph_stats_match_oracle(assoc_and_scene):
 
     # observer percentile schedule matches np.percentile (f64) exactly
     o_thresholds = oracle_observer_thresholds(o_visible)
-    sched = observer_schedule(stats.sorted_observers, stats.observers_positive)
+    sched = observer_schedule(stats.observer_hist)
     np.testing.assert_allclose(sched[: len(o_thresholds)],
                                np.asarray(o_thresholds, dtype=np.float32), rtol=0)
     assert np.isinf(sched[len(o_thresholds):]).all()
@@ -139,20 +139,28 @@ def test_graph_stats_random_claims():
 
 
 def test_observer_schedule_device_matches_host():
-    """Device (f32 + exact integer ranks) vs host (f64) schedule parity."""
+    """Device (f32 + exact integer ranks) vs host (f64) schedule parity,
+    and both against np.percentile over the expanded distribution."""
     import jax.numpy as jnp
 
     from maskclustering_tpu.models.graph import observer_schedule, observer_schedule_device
+    from tests.oracles import oracle_observer_thresholds_from_counts
 
     rng = np.random.default_rng(11)
     for trial in range(6):
         m2 = int(rng.integers(50, 4000))
         n_zero = int(rng.integers(0, m2 // 2))
-        obs = np.sort(np.concatenate([
-            np.zeros(n_zero), rng.integers(1, 40, size=m2 - n_zero).astype(np.float64)]))
-        host = observer_schedule(obs.astype(np.float32), m2 - n_zero)
-        dev = np.asarray(observer_schedule_device(
-            jnp.asarray(obs, jnp.float32), jnp.int32(m2 - n_zero)))
+        counts = np.concatenate([
+            np.zeros(n_zero, np.int64),
+            rng.integers(1, 40, size=m2 - n_zero)])
+        hist = np.bincount(counts, minlength=41)
+        host = observer_schedule(hist)
+        dev = np.asarray(observer_schedule_device(jnp.asarray(hist, jnp.int32)))
         finite = np.isfinite(host)
         assert (np.isfinite(dev) == finite).all(), (trial, host, dev)
         np.testing.assert_allclose(dev[finite], host[finite], rtol=1e-6)
+        # host vs literal np.percentile over the positive counts
+        want = oracle_observer_thresholds_from_counts(counts)
+        np.testing.assert_allclose(host[: len(want)], np.asarray(want, np.float32),
+                                   rtol=0)
+        assert np.isinf(host[len(want):]).all()
