@@ -95,6 +95,22 @@ def iterative_clustering(
         new_assign = labels[assign]
         return new_assign, None
 
-    assignment, _ = jax.lax.scan(step, arange, schedule)
+    # while_loop, not scan: the +inf suffix of the schedule disconnects
+    # every pair (observers >= inf is false), so those iterations are
+    # no-ops — stopping at the first inf skips their full-size affinity
+    # matmuls. The schedule is inf-padded only as a suffix (both schedule
+    # builders terminate once dead), so this exits exactly at the pad.
+    num_t = schedule.shape[0]
+
+    def live(state):
+        t, _ = state
+        return (t < num_t) & ~jnp.isinf(schedule[jnp.minimum(t, num_t - 1)])
+
+    def advance(state):
+        t, assign = state
+        new_assign, _ = step(assign, schedule[t])
+        return t + 1, new_assign
+
+    _, assignment = jax.lax.while_loop(live, advance, (jnp.int32(0), arange))
     v, _, rep_active = aggregate(assignment)
     return ClusterResult(assignment=assignment, node_visible=v, node_active=rep_active)
