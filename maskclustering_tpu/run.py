@@ -185,20 +185,36 @@ def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
     return missing
 
 
+def _load_for_cluster(cfg: PipelineConfig, seq_name: str, resume: bool,
+                      prediction_root: Optional[str]):
+    """(dataset, tensors): the host-IO half of one scene; tensors None = skip."""
+    prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
+    ds = get_dataset(cfg.dataset, seq_name, data_root=cfg.data_root)
+    npz_path = os.path.join(prediction_root, cfg.config_name + "_class_agnostic",
+                            f"{seq_name}.npz")
+    if resume and os.path.exists(npz_path):
+        return ds, None
+    return ds, ds.load_scene_tensors(cfg.step)
+
+
 def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
-                  prediction_root: Optional[str] = None) -> SceneStatus:
-    """Step 2 for one scene: tensors -> run_scene -> npz/object_dict export."""
+                  prediction_root: Optional[str] = None,
+                  _preloaded=None) -> SceneStatus:
+    """Step 2 for one scene: tensors -> run_scene -> npz/object_dict export.
+
+    ``_preloaded``: zero-arg callable returning ``(dataset, tensors)`` — the
+    prefetching loop passes a Future's ``.result`` so load errors of a
+    prefetched scene are still captured as that scene's failure here.
+    """
     from maskclustering_tpu.models.pipeline import run_scene
 
     prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
     t0 = time.perf_counter()
     try:
-        ds = get_dataset(cfg.dataset, seq_name, data_root=cfg.data_root)
-        npz_path = os.path.join(prediction_root, cfg.config_name + "_class_agnostic",
-                                f"{seq_name}.npz")
-        if resume and os.path.exists(npz_path):
+        ds, tensors = (_preloaded() if _preloaded is not None
+                       else _load_for_cluster(cfg, seq_name, resume, prediction_root))
+        if tensors is None:
             return SceneStatus(seq_name, "skipped")
-        tensors = ds.load_scene_tensors(cfg.step)
         result = run_scene(tensors, cfg, seq_name=seq_name, export=True,
                            object_dict_dir=ds.object_dict_dir,
                            prediction_root=prediction_root)
@@ -208,6 +224,39 @@ def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
         log.exception("scene %s failed", seq_name)
         return SceneStatus(seq_name, "failed", time.perf_counter() - t0,
                            error=traceback.format_exc(limit=20))
+
+
+def _cluster_scenes_sequential(cfg: PipelineConfig, seq_names: Sequence[str], *,
+                               resume: bool = True) -> List[SceneStatus]:
+    """The in-process scene loop with one-scene-lookahead disk prefetch.
+
+    Loading a scene (hundreds of depth/seg PNG pairs + the PLY cloud) is
+    seconds of pure host IO; a single background thread loads scene i+1
+    while scene i runs on the device, hiding it entirely (the reference
+    gets the same overlap for free from its per-GPU process pool,
+    reference run.py:33-50). Lookahead is capped at one scene to bound the
+    extra resident tensors.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    if not seq_names:
+        return []
+    out = []
+    ex = ThreadPoolExecutor(max_workers=1)
+    try:
+        fut = ex.submit(_load_for_cluster, cfg, seq_names[0], resume, None)
+        for i, seq in enumerate(seq_names):
+            cur = fut
+            fut = (ex.submit(_load_for_cluster, cfg, seq_names[i + 1], resume, None)
+                   if i + 1 < len(seq_names) else None)
+            out.append(cluster_scene(cfg, seq, resume=resume, _preloaded=cur.result))
+        ex.shutdown(wait=True)
+    except BaseException:
+        # e.g. KeyboardInterrupt mid-scene: don't stall exit for the
+        # multi-second in-flight prefetch load of the next scene
+        ex.shutdown(wait=False, cancel_futures=True)
+        raise
+    return out
 
 
 def _cluster_worker(payload):
@@ -301,7 +350,7 @@ def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
     if cfg.mesh_shape:
         return cluster_scenes_mesh(cfg, seq_names, resume=resume)
     if workers <= 1:
-        return [cluster_scene(cfg, s, resume=resume) for s in seq_names]
+        return _cluster_scenes_sequential(cfg, seq_names, resume=resume)
     import multiprocessing as mp
 
     shards = [list(seq_names[i::workers]) for i in range(workers)]
