@@ -226,37 +226,63 @@ def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
                            error=traceback.format_exc(limit=20))
 
 
-def _cluster_scenes_sequential(cfg: PipelineConfig, seq_names: Sequence[str], *,
-                               resume: bool = True) -> List[SceneStatus]:
-    """The in-process scene loop with one-scene-lookahead disk prefetch.
+def _spawn_load(cfg: PipelineConfig, seq_name: str, resume: bool,
+                prediction_root: Optional[str]):
+    """Start one scene load on a daemon thread; returns a resolve() callable.
+
+    A daemon thread — unlike a ThreadPoolExecutor worker, which the
+    interpreter joins at exit — can never stall process shutdown on an
+    abandoned multi-second load (Ctrl-C mid-scene). The result or the
+    raised error travels through a single-slot queue; resolve() re-raises
+    load errors in the caller so they attribute to the right scene.
+    """
+    import queue
+    import threading
+
+    slot: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def work():
+        try:
+            slot.put((True, _load_for_cluster(cfg, seq_name, resume, prediction_root)))
+        except BaseException as e:  # noqa: BLE001 — travels to resolve()
+            slot.put((False, e))
+
+    threading.Thread(target=work, daemon=True, name=f"prefetch-{seq_name}").start()
+
+    def resolve():
+        ok, val = slot.get()
+        if not ok:
+            raise val
+        return val
+
+    return resolve
+
+
+def _prefetched_loads(cfg: PipelineConfig, seq_names: Sequence[str], resume: bool,
+                      prediction_root: Optional[str] = None):
+    """Yield (seq_name, resolve) with one-scene-lookahead disk prefetch.
 
     Loading a scene (hundreds of depth/seg PNG pairs + the PLY cloud) is
-    seconds of pure host IO; a single background thread loads scene i+1
-    while scene i runs on the device, hiding it entirely (the reference
-    gets the same overlap for free from its per-GPU process pool,
-    reference run.py:33-50). Lookahead is capped at one scene to bound the
-    extra resident tensors.
+    seconds of pure host IO; the lookahead thread loads scene i+1 while
+    scene i runs on the device, hiding it entirely (the reference gets the
+    same overlap for free from its per-GPU process pool, reference
+    run.py:33-50). Lookahead is capped at one scene to bound the extra
+    resident tensors.
     """
-    from concurrent.futures import ThreadPoolExecutor
+    nxt = (_spawn_load(cfg, seq_names[0], resume, prediction_root)
+           if seq_names else None)
+    for i, seq in enumerate(seq_names):
+        cur = nxt
+        nxt = (_spawn_load(cfg, seq_names[i + 1], resume, prediction_root)
+               if i + 1 < len(seq_names) else None)
+        yield seq, cur
 
-    if not seq_names:
-        return []
-    out = []
-    ex = ThreadPoolExecutor(max_workers=1)
-    try:
-        fut = ex.submit(_load_for_cluster, cfg, seq_names[0], resume, None)
-        for i, seq in enumerate(seq_names):
-            cur = fut
-            fut = (ex.submit(_load_for_cluster, cfg, seq_names[i + 1], resume, None)
-                   if i + 1 < len(seq_names) else None)
-            out.append(cluster_scene(cfg, seq, resume=resume, _preloaded=cur.result))
-        ex.shutdown(wait=True)
-    except BaseException:
-        # e.g. KeyboardInterrupt mid-scene: don't stall exit for the
-        # multi-second in-flight prefetch load of the next scene
-        ex.shutdown(wait=False, cancel_futures=True)
-        raise
-    return out
+
+def _cluster_scenes_sequential(cfg: PipelineConfig, seq_names: Sequence[str], *,
+                               resume: bool = True) -> List[SceneStatus]:
+    """The in-process scene loop with one-scene-lookahead disk prefetch."""
+    return [cluster_scene(cfg, seq, resume=resume, _preloaded=resolve)
+            for seq, resolve in _prefetched_loads(cfg, seq_names, resume)]
 
 
 def _cluster_worker(payload):
@@ -317,20 +343,20 @@ def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
                 statuses[seq] = SceneStatus(seq, "failed", per_scene,
                                             error=traceback.format_exc(limit=20))
 
-    for seq in seq_names:
+    # one-scene-lookahead prefetch: the next scene's disk load overlaps the
+    # current batch's device compute in flush() (_prefetched_loads)
+    for seq, resolve in _prefetched_loads(cfg, seq_names, resume, prediction_root):
         try:
-            ds = get_dataset(cfg.dataset, seq, data_root=cfg.data_root)
-            npz_path = os.path.join(prediction_root,
-                                    cfg.config_name + "_class_agnostic", f"{seq}.npz")
-            if resume and os.path.exists(npz_path):
-                statuses[seq] = SceneStatus(seq, "skipped")
-                continue
-            pending.append((seq, ds, ds.load_scene_tensors(cfg.step)))
+            ds, tensors = resolve()
         except Exception:
             log.exception("scene %s failed to load", seq)
             statuses[seq] = SceneStatus(seq, "failed",
                                         error=traceback.format_exc(limit=20))
             continue
+        if tensors is None:
+            statuses[seq] = SceneStatus(seq, "skipped")
+            continue
+        pending.append((seq, ds, tensors))
         if len(pending) == s_axis:
             flush()
     flush()
