@@ -35,7 +35,7 @@ def _metric_name(args) -> str:
             f"{args.points // 1024}k pts x {args.boxes} objects)")
 
 
-def _emit(args, times, error=None):
+def _emit(args, times, error=None, stage_timings=None):
     import numpy as np
 
     if times:
@@ -46,6 +46,12 @@ def _emit(args, times, error=None):
             "unit": "s/scene",
             "vs_baseline": round(BASELINE_S_PER_SCENE / s_per_scene, 2),
         }
+        if stage_timings:
+            # median per stage across completed repeats: puts the breakdown
+            # on record in the driver's BENCH json without extra artifacts
+            keys = sorted({k for t in stage_timings for k in t})
+            line["stages"] = {k: round(float(np.median(
+                [t.get(k, 0.0) for t in stage_timings])), 3) for k in keys}
     else:
         line = {"metric": _metric_name(args), "value": None, "unit": "s/scene",
                 "vs_baseline": None}
@@ -183,6 +189,7 @@ def main():
                          few_points_threshold=25, point_chunk=8192)
 
     times = []
+    stage_timings = []
     try:
         # warm-up (compile)
         t0 = time.time()
@@ -194,6 +201,7 @@ def main():
             t0 = time.time()
             result = run_scene(tensors, cfg, k_max=args.k_max)
             times.append(time.time() - t0)
+            stage_timings.append(dict(result.timings))
             print(f"[bench] run {i}: {times[-1]:.2f}s "
                   f"({len(result.objects.point_ids_list)} objects, "
                   f"timings {['%s=%.2f' % kv for kv in result.timings.items()]})",
@@ -201,10 +209,10 @@ def main():
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
         print(f"[bench] ERROR after {len(times)} completed runs: {e}",
               file=sys.stderr, flush=True)
-        _emit(args, times, error=e)
+        _emit(args, times, error=e, stage_timings=stage_timings)
         sys.exit(1)
 
-    _emit(args, times)
+    _emit(args, times, stage_timings=stage_timings)
 
 
 if __name__ == "__main__":

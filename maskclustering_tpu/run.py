@@ -203,8 +203,9 @@ def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
     """Step 2 for one scene: tensors -> run_scene -> npz/object_dict export.
 
     ``_preloaded``: zero-arg callable returning ``(dataset, tensors)`` — the
-    prefetching loop passes a Future's ``.result`` so load errors of a
-    prefetched scene are still captured as that scene's failure here.
+    prefetching loop passes ``_spawn_load``'s ``resolve`` closure so load
+    errors of a prefetched scene re-raise here and are captured as that
+    scene's failure.
     """
     from maskclustering_tpu.models.pipeline import run_scene
 
