@@ -164,3 +164,34 @@ def test_observer_schedule_device_matches_host():
         np.testing.assert_allclose(host[: len(want)], np.asarray(want, np.float32),
                                    rtol=0)
         assert np.isinf(host[len(want):]).all()
+
+
+def test_observer_schedule_edge_cases():
+    """cnt_pos == 0 -> all-inf; all-ones counts terminate at q=45 with 1.0
+    entries down to q=50 (reference construction.py:86-94 break rule)."""
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.models.graph import observer_schedule, observer_schedule_device
+
+    # no positive observers at all: every iteration must be inert
+    empty = np.zeros(11, np.int64)
+    empty[0] = 500
+    host = observer_schedule(empty)
+    dev = np.asarray(observer_schedule_device(jnp.asarray(empty, jnp.int32)))
+    assert np.isinf(host).all() and np.isinf(dev).all()
+
+    # every positive count is exactly 1: percentiles 95..50 clamp to 1.0,
+    # then the q=45 entry (<= 1 and percentile < 50) terminates the schedule
+    ones = np.zeros(3, np.int64)
+    ones[0], ones[1] = 40, 60
+    host = observer_schedule(ones)
+    dev = np.asarray(observer_schedule_device(jnp.asarray(ones, jnp.int32)))
+    want_len = len(range(95, 45, -5))  # 95..50 inclusive
+    assert (host[:want_len] == 1.0).all() and np.isinf(host[want_len:]).all()
+    np.testing.assert_array_equal(np.isinf(dev), np.isinf(host))
+    np.testing.assert_allclose(dev[:want_len], host[:want_len])
+
+    # histogram shorter than any padding assumptions: single bin value
+    single = np.array([0, 0, 0, 7], np.int64)  # seven pairs all at count 3
+    host = observer_schedule(single)
+    assert (host[: len(range(95, -5, -5))] == 3.0).all()
