@@ -224,3 +224,45 @@ def test_evaluator_memory_streams_scans(tmp_path):
     # one scan's transient tensors are ~25 MB; 50 scans leaked would be
     # > 1 GB. Allow generous slack for allocator/jit overhead.
     assert rss_after - rss_before < 0.6, (rss_before, rss_after)
+
+
+def test_evaluation_cli_main(tmp_path, monkeypatch):
+    """``python -m maskclustering_tpu.evaluation`` smoke: args -> result txt,
+    missing-GT error path returns nonzero without writing anything."""
+    from maskclustering_tpu.evaluation.__main__ import main
+
+    gt = np.zeros(N, dtype=np.int64)
+    gt[:300] = 3001
+    gt[300:] = 3002
+    gt_dir = tmp_path / "gt"
+    pred_dir = tmp_path / "pred"
+    gt_dir.mkdir()
+    pred_dir.mkdir()
+    np.savetxt(gt_dir / "scene0000_00.txt", gt, fmt="%d")
+    masks = np.zeros((N, 2), dtype=bool)
+    masks[:300, 0] = True
+    masks[300:, 1] = True
+    np.savez(pred_dir / "scene0000_00.npz",
+             pred_masks=masks, pred_score=np.ones(2),
+             pred_classes=np.zeros(2, dtype=np.int32))
+
+    out = tmp_path / "res.txt"
+    rc = main(["--pred_path", str(pred_dir), "--gt_path", str(gt_dir),
+               "--dataset", "scannet", "--no_class", "--output_file", str(out)])
+    assert rc == 0
+    # --no_class appends the suffix when absent from the name
+    suffixed = tmp_path / "res_class_agnostic.txt"
+    assert suffixed.exists()
+    assert suffixed.read_text().startswith("class,class id,ap,ap50,ap25")
+
+    # a prediction without GT is a loud failure, not a silent skip — and it
+    # must write nothing (chdir keeps any regression's default-path output
+    # inside tmp_path where the assertion can see it)
+    monkeypatch.chdir(tmp_path)
+    np.savez(pred_dir / "scene9999_00.npz",
+             pred_masks=masks, pred_score=np.ones(2),
+             pred_classes=np.zeros(2, dtype=np.int32))
+    rc = main(["--pred_path", str(pred_dir), "--gt_path", str(gt_dir),
+               "--dataset", "scannet", "--no_class"])
+    assert rc == 1
+    assert not (tmp_path / "data").exists()
