@@ -50,7 +50,7 @@ def test_fused_step_meshes(mesh_shape):
     assert (n_obj <= 8).all(), n_obj
 
 
-@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4)])
+@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (8, 1)])
 def test_mesh_batch_matches_single_chip_artifacts(mesh_shape):
     """The fused mesh path must produce the exact objects (point sets, mask
     lists, coverages) of the single-chip pipeline on the same scenes —
