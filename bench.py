@@ -116,9 +116,11 @@ def _init_backend(args):
     print(f"[bench] backend up: {len(devices)}x {devices[0].device_kind}",
           file=sys.stderr, flush=True)
     # stdout sentinel for the supervisor: proves init completed even if the
-    # worker later dies by signal with no JSON line (supervisor drops every
-    # stdout line but the last, so this never leaks into the final output)
-    print(_INIT_OK_SENTINEL, flush=True)
+    # worker later dies by signal with no JSON line. Gated on the env var the
+    # supervisor sets, so a direct --worker invocation keeps the documented
+    # one-JSON-line stdout contract.
+    if os.environ.get("MCT_BENCH_SUPERVISED"):
+        print(_INIT_OK_SENTINEL, flush=True)
     return devices
 
 
@@ -177,6 +179,9 @@ def _build_parser():
                    help="max fresh-subprocess attempts when backend init fails")
     p.add_argument("--retry-budget", type=float, default=1500.0,
                    help="total wall-clock budget (s) across init retries")
+    p.add_argument("--worker-timeout", type=float, default=3600.0,
+                   help="post-init run allowance (s) before the supervisor "
+                        "kills a worker outright (GIL-proof hang backstop)")
     return p
 
 
@@ -205,12 +210,29 @@ def _supervise(args):
         print(f"[bench] attempt {attempt}/{args.init_attempts} "
               f"(elapsed {elapsed:.0f}s of {args.retry_budget:.0f}s budget)",
               file=sys.stderr, flush=True)
-        proc = subprocess.run(child_argv, stdout=subprocess.PIPE)
-        rc = proc.returncode
-        out = proc.stdout.decode("utf-8", "replace").strip().splitlines()
+        env = dict(os.environ, MCT_BENCH_SUPERVISED="1")
+        # Hard per-attempt cap: the worker's own init watchdog is a Python
+        # thread and cannot fire if native backend init wedges while holding
+        # the GIL — only the parent can kill that. init + generous run slack.
+        cap = args.init_timeout + args.worker_timeout
+        try:
+            proc = subprocess.run(child_argv, stdout=subprocess.PIPE,
+                                  env=env, timeout=cap)
+            rc = proc.returncode
+            raw = proc.stdout
+        except subprocess.TimeoutExpired as e:
+            rc = 3  # same class as the in-worker init watchdog
+            raw = e.stdout or b""
+            print(f"[bench] worker exceeded the {cap:.0f}s hard cap; killed",
+                  file=sys.stderr, flush=True)
+        out = raw.decode("utf-8", "replace").strip().splitlines()
         init_ok = _INIT_OK_SENTINEL in out
         out = [ln for ln in out if ln != _INIT_OK_SENTINEL]
         last_line = out[-1] if out else None
+        if rc == 3 and init_ok:
+            # hung AFTER init (mid-run): the worker owns that failure;
+            # retrying the whole bench would mask a real regression
+            rc = 1
         # Retryable = init-phase deaths only: the explicit init rcs, plus a
         # signal death (negative rc, e.g. libtpu SIGABRT on a wedged chip)
         # BEFORE the init-ok sentinel — a post-init signal death (e.g. OOM
@@ -224,7 +246,12 @@ def _supervise(args):
                   f"({attempt} attempts, {time.time()-t_start:.0f}s)",
                   file=sys.stderr, flush=True)
             break
-        backoff = min(20.0 * attempt, 120.0, remaining)
+        backoff = min(20.0 * attempt, 120.0)
+        if remaining <= backoff:
+            # the promised retry could never launch: don't sleep into the wall
+            print(f"[bench] giving up: {remaining:.0f}s of budget left "
+                  f"< {backoff:.0f}s backoff", file=sys.stderr, flush=True)
+            break
         print(f"[bench] backend init failed (rc={rc}); "
               f"retrying in {backoff:.0f}s with a fresh process",
               file=sys.stderr, flush=True)
