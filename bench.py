@@ -82,39 +82,24 @@ def _emit(args, times, error=None, stage_timings=None):
 def _init_backend(args):
     """Initialize the JAX backend, failing fast and loudly.
 
-    A wedged TPU client hangs inside backend init with no exception (seen
-    when another process holds the chip), so a watchdog turns a silent
-    multi-minute stall into a one-line diagnosis + the mandatory JSON line.
+    Shared watchdog logic lives in maskclustering_tpu.utils.backend_init;
+    this wrapper adds the bench's JSON-line contract on every failure path.
     """
-    def _watchdog():
-        print(f"[bench] FATAL: backend init did not finish within "
-              f"{args.init_timeout}s (chip busy or TPU runtime wedged)",
-              file=sys.stderr, flush=True)
-        _emit(args, [], error=f"backend init timed out after {args.init_timeout}s")
-        os._exit(3)
+    from maskclustering_tpu.utils.backend_init import init_backend
 
-    timer = threading.Timer(args.init_timeout, _watchdog)
-    timer.daemon = True
-    timer.start()
     try:
-        import jax
-
-        if args.platform:
-            # jax.config (not the env var): the TPU plugin is preloaded in
-            # this image, so JAX_PLATFORMS from the environment is too late
-            jax.config.update("jax_platforms", args.platform)
-        devices = jax.devices()
+        devices = init_backend(
+            args.platform, timeout_s=args.init_timeout, tag="bench",
+            on_timeout=lambda: _emit(
+                args, [], error=f"backend init timed out after "
+                                f"{args.init_timeout}s"))
     except Exception as e:  # noqa: BLE001 — one-line diagnosis beats a 30-frame traceback
-        timer.cancel()
         print(f"[bench] FATAL: jax backend init failed: {type(e).__name__}: "
               f"{str(e).splitlines()[0] if str(e) else e}", file=sys.stderr, flush=True)
         _emit(args, [], error=f"backend init failed: {e}")
         # ImportError can never heal across retries; rc 4 tells the
         # supervisor to fail fast instead of burning the retry budget.
         sys.exit(4 if isinstance(e, ImportError) else 2)
-    timer.cancel()
-    print(f"[bench] backend up: {len(devices)}x {devices[0].device_kind}",
-          file=sys.stderr, flush=True)
     # stdout sentinel for the supervisor: proves init completed even if the
     # worker later dies by signal with no JSON line. Gated on the env var the
     # supervisor sets, so a direct --worker invocation keeps the documented
@@ -211,28 +196,54 @@ def _supervise(args):
               f"(elapsed {elapsed:.0f}s of {args.retry_budget:.0f}s budget)",
               file=sys.stderr, flush=True)
         env = dict(os.environ, MCT_BENCH_SUPERVISED="1")
-        # Hard per-attempt cap: the worker's own init watchdog is a Python
-        # thread and cannot fire if native backend init wedges while holding
-        # the GIL — only the parent can kill that. init + generous run slack.
-        cap = args.init_timeout + args.worker_timeout
-        try:
-            proc = subprocess.run(child_argv, stdout=subprocess.PIPE,
-                                  env=env, timeout=cap)
-            rc = proc.returncode
-            raw = proc.stdout
-        except subprocess.TimeoutExpired as e:
-            rc = 3  # same class as the in-worker init watchdog
-            raw = e.stdout or b""
-            print(f"[bench] worker exceeded the {cap:.0f}s hard cap; killed",
-                  file=sys.stderr, flush=True)
-        out = raw.decode("utf-8", "replace").strip().splitlines()
-        init_ok = _INIT_OK_SENTINEL in out
-        out = [ln for ln in out if ln != _INIT_OK_SENTINEL]
+        # Phase-aware hard caps, GIL-proof: the worker's own init watchdog is
+        # a Python thread and cannot fire if native backend init wedges while
+        # holding the GIL — only the parent can kill that. Worker stdout is
+        # streamed so the INIT_OK sentinel flips the deadline from the short
+        # init cap (init_timeout + grace; keeps a wedged init retryable
+        # within the budget) to the long run allowance (worker_timeout).
+        proc = subprocess.Popen(child_argv, stdout=subprocess.PIPE, env=env)
+        out: list = []
+        init_ok_evt = threading.Event()
+
+        def _drain(stream=proc.stdout):
+            for raw_line in stream:
+                ln = raw_line.decode("utf-8", "replace").rstrip("\n")
+                if ln.strip() == _INIT_OK_SENTINEL:
+                    init_ok_evt.set()
+                elif ln.strip():
+                    out.append(ln.strip())
+
+        drain = threading.Thread(target=_drain, daemon=True)
+        drain.start()
+        deadline = time.time() + args.init_timeout + 30.0
+        while (time.time() < deadline and proc.poll() is None
+               and not init_ok_evt.is_set()):
+            init_ok_evt.wait(1.0)
+        init_ok = init_ok_evt.is_set()
+        killed = False
+        if not init_ok and proc.poll() is None:
+            print("[bench] worker stuck in backend init past the "
+                  f"{args.init_timeout:.0f}s cap with the watchdog unable "
+                  "to fire (GIL held); killed", file=sys.stderr, flush=True)
+            proc.kill()
+            killed = True
+        if init_ok:
+            try:
+                proc.wait(args.worker_timeout)
+            except subprocess.TimeoutExpired:
+                print(f"[bench] worker exceeded the {args.worker_timeout:.0f}s "
+                      "post-init run allowance; killed",
+                      file=sys.stderr, flush=True)
+                proc.kill()
+                killed = True
+        rc = proc.wait()
+        drain.join(10.0)
+        if killed:
+            # a GIL-wedged init is the retryable class (rc 3, like the
+            # in-worker watchdog); a post-init hang belongs to the worker
+            rc = 3 if not init_ok else 1
         last_line = out[-1] if out else None
-        if rc == 3 and init_ok:
-            # hung AFTER init (mid-run): the worker owns that failure;
-            # retrying the whole bench would mask a real regression
-            rc = 1
         # Retryable = init-phase deaths only: the explicit init rcs, plus a
         # signal death (negative rc, e.g. libtpu SIGABRT on a wedged chip)
         # BEFORE the init-ok sentinel — a post-init signal death (e.g. OOM

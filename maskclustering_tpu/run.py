@@ -72,6 +72,9 @@ class RunReport:
     step_seconds: Dict[str, float] = dataclasses.field(default_factory=dict)
     scenes: List[SceneStatus] = dataclasses.field(default_factory=list)
     step_errors: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # machine-checked environment fact: local CLIP checkpoint dir, or None
+    # (the reference downloads ViT-H-14 at run time; no egress here)
+    clip_checkpoint: Optional[str] = None
 
     @property
     def failed(self) -> List[SceneStatus]:
@@ -89,6 +92,7 @@ class RunReport:
                 "step_seconds": self.step_seconds,
                 "scenes": [dataclasses.asdict(s) for s in self.scenes],
                 "step_errors": self.step_errors,
+                "clip_checkpoint": self.clip_checkpoint,
             }, f, indent=2)
 
 
@@ -571,7 +575,15 @@ def run_pipeline(
     from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
 
     setup_compilation_cache(cfg.compilation_cache_dir)
-    report = RunReport(config_name=cfg.config_name)
+    from maskclustering_tpu.semantics.encoder import find_local_clip_checkpoint
+
+    report = RunReport(config_name=cfg.config_name,
+                       clip_checkpoint=find_local_clip_checkpoint())
+    if report.clip_checkpoint:
+        log.info("local CLIP checkpoint found: %s", report.clip_checkpoint)
+    else:
+        log.info("no local CLIP checkpoint on disk (hash/precomputed "
+                 "encoders only; see README semantics deployment)")
     encoder = None
     trace_ctx = None
     if profile_dir:

@@ -17,6 +17,7 @@ All encoders return L2-normalized float32 features.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Protocol, Sequence
 
 import numpy as np
@@ -40,6 +41,49 @@ class TextEncoder(Protocol):
 
 def l2_normalize(x: np.ndarray, axis: int = -1, eps: float = 1e-12) -> np.ndarray:
     return x / np.maximum(np.linalg.norm(x, axis=axis, keepdims=True), eps)
+
+
+def find_local_clip_checkpoint(extra_dirs: Sequence[str] = ()) -> Optional[str]:
+    """First CLIP checkpoint directory found on local disk, or None.
+
+    The reference downloads ViT-H-14 laion2b_s32b_b79k at run time
+    (get_open-voc_features.py:101-107); this environment has no egress, so a
+    checkpoint can only be used if it already exists. Searched: the
+    HuggingFace hub cache (model dirs whose name mentions clip), any
+    ``MCT_CLIP_PATH`` env override, and ``extra_dirs``. A hit is any
+    directory holding a config plus a weights file — both the HF-transformers
+    layout (config.json + flax/pytorch/safetensors weights, loadable by
+    HFCLIPEncoder directly) and the open_clip cache layout the reference's
+    exact checkpoint lands in (open_clip_config.json +
+    open_clip_pytorch_model.bin; needs a transformers conversion before
+    HFCLIPEncoder can use it, but its presence IS the fact). The
+    orchestrator records the outcome in run_report.json either way, turning
+    "no real CLIP weights available" into a machine-checked environment fact.
+    """
+    import glob
+
+    candidates = []
+    env = os.environ.get("MCT_CLIP_PATH")
+    if env:
+        candidates.append(env)
+    candidates.extend(extra_dirs)
+    hub = os.environ.get(
+        "HF_HUB_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "huggingface", "hub"))
+    for model_dir in sorted(glob.glob(os.path.join(hub, "models--*"))):
+        if "clip" in os.path.basename(model_dir).lower():
+            candidates.extend(sorted(glob.glob(
+                os.path.join(model_dir, "snapshots", "*"))))
+    config_names = ("config.json", "open_clip_config.json")
+    weight_names = ("flax_model.msgpack", "pytorch_model.bin",
+                    "model.safetensors", "open_clip_pytorch_model.bin",
+                    "open_clip_model.safetensors")
+    for cand in candidates:
+        if not any(os.path.isfile(os.path.join(cand, c)) for c in config_names):
+            continue
+        if any(os.path.isfile(os.path.join(cand, w)) for w in weight_names):
+            return cand
+    return None
 
 
 class HashEncoder:

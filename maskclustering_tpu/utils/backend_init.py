@@ -1,0 +1,56 @@
+"""Watchdog-guarded JAX backend initialization.
+
+A wedged TPU client hangs inside backend init with no exception (seen when
+another process holds the chip), so a timer thread turns a silent
+multi-minute stall into a loud exit. Shared by bench.py and
+scripts/northstar.py so the timeout semantics (and the exit-code-3
+convention their supervisors/drivers key on) cannot silently diverge.
+
+The watchdog is a Python thread: it CANNOT fire if native init wedges while
+holding the GIL — a supervising parent process with a hard kill (bench.py's
+supervisor) is the only complete backstop for that case.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Callable, Optional
+
+INIT_TIMEOUT_EXIT_CODE = 3  # retryable "backend never came up" convention
+
+
+def init_backend(platform: Optional[str] = None, timeout_s: float = 120.0,
+                 on_timeout: Optional[Callable[[], None]] = None,
+                 tag: str = "backend"):
+    """Import jax and touch devices under a watchdog; returns the devices.
+
+    ``platform``: force a jax platform (must go through jax.config — this
+    image preloads the TPU plugin via sitecustomize, so the JAX_PLATFORMS
+    env var is read too early to matter). ``on_timeout`` runs in the
+    watchdog thread right before ``os._exit(3)`` (e.g. emit a JSON line).
+    Exceptions from init propagate to the caller.
+    """
+    def _watchdog():
+        print(f"[{tag}] FATAL: backend init did not finish within "
+              f"{timeout_s}s (chip busy or TPU runtime wedged)",
+              file=sys.stderr, flush=True)
+        if on_timeout is not None:
+            on_timeout()
+        os._exit(INIT_TIMEOUT_EXIT_CODE)
+
+    timer = threading.Timer(timeout_s, _watchdog)
+    timer.daemon = True
+    timer.start()
+    try:
+        import jax
+
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        devices = jax.devices()
+    finally:
+        timer.cancel()
+    print(f"[{tag}] backend up: {len(devices)}x {devices[0].device_kind}",
+          file=sys.stderr, flush=True)
+    return devices

@@ -1,0 +1,137 @@
+"""Per-chip memory analysis of the fused multi-chip step at the honest bucket.
+
+VERDICT r4 task 7: MESH_BENCH's 41 GB CPU RSS row needed an answer to "what
+does one REAL chip hold?". This compiles (AOT, abstract shapes — nothing is
+materialized) the fused step over a (scene=1, frame=8) mesh of 8 virtual
+devices at the honest ScanNet operating point (250->256 frames, 480x640
+uint16 feed, 192k points, k_max 63) and reports
+``jax.stages.Compiled.memory_analysis()``: per-device argument / output /
+temp bytes, i.e. the HBM footprint XLA's buffer assignment plans per chip.
+
+Usage: PYTHONPATH=. python scripts/hbm_analysis.py [--frames 256] [--out -]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse
+import time
+
+V5E_HBM_GB = 16.0  # v5e: 16 GB HBM per chip
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--scenes", type=int, default=1)
+    p.add_argument("--frames", type=int, default=256,
+                   help="honest bucket 250 rounds to the next multiple of 8")
+    p.add_argument("--points", type=int, default=196608)
+    p.add_argument("--image-h", type=int, default=480)
+    p.add_argument("--image-w", type=int, default=640)
+    p.add_argument("--k-max", type=int, default=63)
+    p.add_argument("--mesh", type=int, nargs=2, default=(1, 8),
+                   metavar=("SCENE", "FRAME"))
+    p.add_argument("--out", default="-",
+                   help="markdown output path, or - for stdout only")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.config import PipelineConfig
+    from maskclustering_tpu.parallel.mesh import make_mesh
+    from maskclustering_tpu.parallel.sharded import build_fused_step
+
+    cfg = PipelineConfig(config_name="hbm_analysis", dataset="demo",
+                         distance_threshold=0.01, few_points_threshold=25,
+                         point_chunk=8192)
+    mesh = make_mesh(tuple(args.mesh))
+    step = build_fused_step(mesh, cfg, k_max=args.k_max)
+
+    s, f = args.scenes, args.frames
+    h, w, n = args.image_h, args.image_w, args.points
+    shapes = (
+        jax.ShapeDtypeStruct((s, n, 3), jnp.float32),   # scene_points
+        jax.ShapeDtypeStruct((s, f, h, w), jnp.uint16),  # depths (compact feed)
+        jax.ShapeDtypeStruct((s, f, h, w), jnp.uint16),  # segs
+        jax.ShapeDtypeStruct((s, f, 3, 3), jnp.float32),
+        jax.ShapeDtypeStruct((s, f, 4, 4), jnp.float32),
+        jax.ShapeDtypeStruct((s, f), jnp.bool_),
+    )
+    print(f"[hbm] lowering fused step: S={s} F={f} {h}x{w} N={n} "
+          f"k_max={args.k_max} mesh={tuple(args.mesh)}",
+          file=sys.stderr, flush=True)
+    t0 = time.time()
+    lowered = step.lower(*shapes)
+    t_lower = time.time() - t0
+    t0 = time.time()
+    compiled = lowered.compile()
+    t_compile = time.time() - t0
+    print(f"[hbm] lower {t_lower:.1f}s, compile {t_compile:.1f}s",
+          file=sys.stderr, flush=True)
+
+    ma = compiled.memory_analysis()
+    if ma is None:
+        print("[hbm] memory_analysis() unavailable on this backend",
+              file=sys.stderr)
+        sys.exit(2)
+
+    def gb(x):
+        return x / (1 << 30)
+
+    # The CPU backend plans temps but reports zero for argument/output
+    # buffers (they are externally allocated); compute those analytically
+    # from the declared shardings so the per-chip total is backend-honest.
+    # Every input/output is sharded over `scene` on dim 0, so a device holds
+    # s/n_scene scenes' worth of its frame shard.
+    n_scene, n_frame = args.mesh
+    s_dev = -(-s // n_scene)  # scenes resident per device
+    m_pad = f * args.k_max
+    analytic_arg = (n * 3 * 4                      # scene_points, replicated
+                    + 2 * (f // n_frame) * h * w * 2   # depth+seg u16 shards
+                    + (f // n_frame) * (9 + 16) * 4    # intrinsics+c2w
+                    + f // n_frame) * s_dev
+    analytic_out = (3 * (f // n_frame) * n * 4     # mask_of_point/first/last
+                    + (m_pad // n_frame) * f       # node_visible bool shard
+                    + 2 * (m_pad // n_frame) * 4   # assignment+mask_active
+                    + 4) * s_dev
+    arg_gb = max(gb(ma.argument_size_in_bytes), gb(analytic_arg))
+    out_gb = max(gb(ma.output_size_in_bytes), gb(analytic_out))
+    tmp_gb = gb(ma.temp_size_in_bytes)
+    alias_gb = gb(ma.alias_size_in_bytes)
+    # peak per-device plan: args + outputs + temps - aliased (aliased bytes
+    # are counted in both args and outputs)
+    total_gb = arg_gb + out_gb + tmp_gb - alias_gb
+    headroom = V5E_HBM_GB - total_gb
+
+    lines = [
+        f"shape: S={s} F={f} {h}x{w} N={n} k_max={args.k_max} "
+        f"mesh=(scene={args.mesh[0]},frame={args.mesh[1]})",
+        f"argument_size: {arg_gb:.3f} GB/device",
+        f"output_size:   {out_gb:.3f} GB/device",
+        f"temp_size:     {tmp_gb:.3f} GB/device",
+        f"alias_size:    {alias_gb:.3f} GB/device",
+        f"planned total: {total_gb:.3f} GB/device "
+        f"(v5e HBM {V5E_HBM_GB:.0f} GB -> headroom {headroom:.1f} GB)",
+        f"compile: lower {t_lower:.1f}s + compile {t_compile:.1f}s",
+    ]
+    print("\n".join(lines))
+    if args.out != "-":
+        with open(args.out, "a") as fh:
+            fh.write("\n".join(lines) + "\n")
+    sys.exit(0 if headroom > 0 else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,204 @@
+"""Measure (not extrapolate) the ScanNet-val north star -> NORTHSTAR.md.
+
+VERDICT r4 task 2: the <10 min / 311 scenes / v5e-8 target had only ever
+been projected from a single-bucket bench. This pushes a multi-scene,
+multi-bucket synthetic sweep with a realistic ScanNet-val-like spread of
+frame counts / cloud sizes / object counts through ``run_scene`` on the
+live chip in ONE process with the persistent compile cache, and records:
+
+- distinct (k_max, F_pad, N_pad) shape buckets hit (compile-unit count);
+- per-bucket warm-up (first scene in bucket) vs steady-state s/scene;
+- scenes/hour, total and steady-state;
+- the v5e-8 311-scene projection with the scene-DP factor, pass/fail.
+
+The reference's cost at this stage: 6.5 GPU-h / 311 scenes (README.md:205)
+~= 75 s/scene on an RTX 3090; its per-GPU process model is the same
+scene-DP shape this projection uses (reference run.py:33-50).
+
+Usage: PYTHONPATH=. python scripts/northstar.py [--quick] [--out NORTHSTAR.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+BASELINE_S_PER_SCENE = 75.0
+NORTH_STAR_SCENES = 311
+NORTH_STAR_CHIPS = 8
+NORTH_STAR_MINUTES = 10.0
+
+# Realistic ScanNet-val-like spread (stride-10 frame counts cluster around
+# 100-350; clouds 80k-400k points; CropFormer ~20-40 masks/frame). True
+# sizes deliberately differ WITHIN a bucket to prove bucket reuse.
+SCENE_SPECS = [
+    # (frames, points, boxes) -> bucket (f_pad, n_pad) via geometric rounding
+    (118, 98304, 16), (125, 90000, 16), (128, 98304, 20),
+    (170, 150000, 24), (180, 163840, 24), (190, 160000, 28),
+    (245, 190000, 36), (250, 196608, 36), (255, 196608, 32),
+    (310, 280000, 36), (320, 294912, 36), (350, 290000, 36),
+]
+QUICK_SPECS = [(8, 4096, 3), (9, 4096, 3), (14, 6000, 4), (15, 6144, 4)]
+
+
+def _init_backend(platform, timeout_s=120.0):
+    from maskclustering_tpu.utils.backend_init import init_backend
+
+    init_backend(platform, timeout_s=timeout_s, tag="northstar")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="tiny shapes on CPU (script smoke test)")
+    p.add_argument("--platform", default=None)
+    p.add_argument("--image-h", type=int, default=480)
+    p.add_argument("--image-w", type=int, default=640)
+    p.add_argument("--out", default="NORTHSTAR.md")
+    args = p.parse_args()
+
+    specs = QUICK_SPECS if args.quick else SCENE_SPECS
+    if args.quick and args.platform is None:
+        args.platform = "cpu"
+    if args.quick:
+        args.image_h, args.image_w = 60, 80
+
+    _init_backend(args.platform)
+
+    from maskclustering_tpu.config import PipelineConfig
+    from maskclustering_tpu.models.pipeline import bucket_size, run_scene
+    from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
+    from maskclustering_tpu.utils.synthetic import make_scene_device
+
+    cache = setup_compilation_cache()
+    print(f"[northstar] persistent compile cache: {cache}",
+          file=sys.stderr, flush=True)
+
+    cfg = PipelineConfig(config_name="northstar", dataset="demo",
+                         distance_threshold=0.01, few_points_threshold=25,
+                         point_chunk=8192)
+
+    t_sweep0 = time.time()
+    rows = []  # (scene_idx, frames, points, boxes, bucket, gen_s, run_s, objects)
+    bucket_first: dict = {}
+    for i, (frames, points, boxes) in enumerate(specs):
+        t0 = time.time()
+        tensors, _, _ = make_scene_device(
+            num_boxes=boxes, num_frames=frames,
+            image_hw=(args.image_h, args.image_w),
+            spacing=0.025 if not args.quick else 0.08, seed=i)
+        pts = tensors.scene_points
+        if pts.shape[0] < points:
+            pts = np.tile(pts, (-(-points // pts.shape[0]), 1))[:points]
+        else:
+            pts = pts[np.random.default_rng(i).choice(
+                pts.shape[0], points, replace=False)]
+        tensors.scene_points = np.ascontiguousarray(pts, dtype=np.float32)
+        gen_s = time.time() - t0
+
+        bucket = (bucket_size(frames, cfg.frame_pad_multiple),
+                  bucket_size(points, cfg.point_chunk))
+        t0 = time.time()
+        result = run_scene(tensors, cfg, k_max=None if args.quick else 63)
+        run_s = time.time() - t0
+        first = bucket not in bucket_first
+        if first:
+            bucket_first[bucket] = run_s
+        n_obj = len(result.objects.point_ids_list)
+        rows.append((i, frames, points, boxes, bucket, gen_s, run_s, n_obj))
+        print(f"[northstar] scene {i}: F={frames} N={points} obj={boxes} "
+              f"bucket={bucket}{' WARM' if first else ''} gen={gen_s:.1f}s "
+              f"run={run_s:.2f}s objects={n_obj}",
+              file=sys.stderr, flush=True)
+    sweep_s = time.time() - t_sweep0
+
+    buckets = sorted({r[4] for r in rows})
+    steady = [r[6] for r in rows if r[6] != bucket_first[r[4]]]
+    steady_median = float(np.median(steady)) if steady else float("nan")
+    warm_total = float(sum(bucket_first.values()))
+    compute_s = float(sum(r[6] for r in rows))
+    scenes_per_hour_total = len(rows) / (sweep_s / 3600.0)
+    scenes_per_hour_compute = len(rows) / (compute_s / 3600.0)
+
+    # v5e-8 projection, scene-DP (the reference's own parallel shape):
+    # each chip warm-compiles its buckets once (persistent cache makes this
+    # a first-run-only cost) then streams 311/8 scenes at steady state.
+    proj_s = warm_total + (NORTH_STAR_SCENES / NORTH_STAR_CHIPS) * steady_median
+    proj_warm_cached = (NORTH_STAR_SCENES / NORTH_STAR_CHIPS) * steady_median
+    ok = proj_s / 60.0 < NORTH_STAR_MINUTES
+    ok_cached = proj_warm_cached / 60.0 < NORTH_STAR_MINUTES
+
+    lines = [
+        "# NORTHSTAR — measured multi-scene, multi-bucket sweep",
+        "",
+        f"{len(rows)} synthetic scenes with a ScanNet-val-like spread, one",
+        "process, persistent compile cache, on "
+        + ("CPU (--quick smoke)" if args.quick else "the live TPU chip")
+        + f" ({args.image_h}x{args.image_w} frames, radius 0.01).",
+        "Generated by `scripts/northstar.py`; reference cost at this stage:",
+        "75 s/scene (6.5 GPU-h / 311 scenes, reference README.md:205).",
+        "",
+        "## Per-scene measurements",
+        "",
+        "| scene | frames | points | objects | bucket (F_pad, N_pad) | warm? | run (s) |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for i, frames, points, boxes, bucket, gen_s, run_s, n_obj in rows:
+        warm = "compile" if run_s == bucket_first[bucket] else ""
+        lines.append(f"| {i} | {frames} | {points} | {n_obj}/{boxes} | "
+                     f"{bucket} | {warm} | {run_s:.2f} |")
+    lines += [
+        "",
+        "## Aggregates",
+        "",
+        f"- distinct shape buckets hit: **{len(buckets)}** ({buckets})",
+        f"- per-bucket warm-up (first scene in bucket): "
+        + ", ".join(f"{b}: {bucket_first[b]:.1f}s" for b in buckets),
+        f"- warm-up total: **{warm_total:.1f} s** (persistent cache makes "
+        "this a first-run-only cost per host)",
+        f"- steady-state s/scene (median of {len(steady)} non-warm scenes): "
+        f"**{steady_median:.2f} s** (vs reference 75 s/scene -> "
+        f"**{BASELINE_S_PER_SCENE / steady_median:.1f}x**)",
+        f"- sweep wall time: {sweep_s / 60.0:.1f} min "
+        f"({scenes_per_hour_total:.0f} scenes/hour incl. synthetic scene "
+        f"generation; {scenes_per_hour_compute:.0f} scenes/hour counting "
+        "pipeline compute only — real runs overlap IO via the prefetcher)",
+        "",
+        "## 311-scene v5e-8 projection (scene data parallelism)",
+        "",
+        f"- cold cache: {warm_total:.0f} s warm-up + 311/8 x "
+        f"{steady_median:.2f} s = **{proj_s / 60.0:.1f} min** -> "
+        f"{'PASS' if ok else 'FAIL'} vs < {NORTH_STAR_MINUTES:.0f} min",
+        f"- warm persistent cache (steady only): **{proj_warm_cached / 60.0:.1f} "
+        f"min** -> {'PASS' if ok_cached else 'FAIL'}",
+        "",
+        "Scene-DP is the reference's own scaling shape (one scene stream per",
+        "accelerator, reference run.py:33-50); no cross-chip communication is",
+        "on the critical path, so the /8 factor is exact up to bucket-warmup",
+        "skew (each chip compiles only the buckets its scenes hit, and the",
+        "persistent cache de-duplicates across chips sharing a host).",
+        "",
+    ]
+    out_text = "\n".join(lines)
+    with open(args.out, "w") as f:
+        f.write(out_text)
+    print(out_text)
+    print(json.dumps({
+        "buckets": len(buckets), "warm_total_s": round(warm_total, 1),
+        "steady_median_s": round(steady_median, 3),
+        "proj_cold_min": round(proj_s / 60.0, 2),
+        "proj_warm_min": round(proj_warm_cached / 60.0, 2),
+        "pass": bool(ok),
+    }))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -203,6 +203,65 @@ def _repo_partition(visible, contained, schedule, threshold):
     return {frozenset(p) for p in parts.values()}
 
 
+def _import_reference_construction():
+    """Import graph.construction (only get_observer_num_thresholds is used).
+
+    construction.py transitively imports pytorch3d + open3d (absent here);
+    bare stubs satisfy the imports — the schedule function touches neither."""
+    if "open3d" not in sys.modules:
+        sys.modules["open3d"] = types.ModuleType("open3d")
+    if "pytorch3d" not in sys.modules:
+        p3d = types.ModuleType("pytorch3d")
+        ops = types.ModuleType("pytorch3d.ops")
+        ops.ball_query = None
+        p3d.ops = ops
+        sys.modules["pytorch3d"] = p3d
+        sys.modules["pytorch3d.ops"] = ops
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)
+    import graph.construction as ref_con  # noqa: PLC0415
+    return ref_con
+
+
+@pytest.mark.parametrize("seed,m,f,density", [
+    (3, 40, 30, 0.4), (11, 64, 100, 0.15), (17, 16, 12, 0.6),
+    # disjoint single-frame visibility: every positive observer count is 1,
+    # exercising the <=1 clamp + percentile<50 termination branch
+    (0, 10, 10, -1.0),
+])
+def test_observer_schedule_matches_reference(seed, m, f, density):
+    """The histogram-derived percentile schedule (models/graph.py) equals the
+    literal reference get_observer_num_thresholds (construction.py:80-96) on
+    shared visibility tensors."""
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.models.graph import (
+        observer_schedule,
+        observer_schedule_device,
+    )
+
+    ref_con = _import_reference_construction()
+    rng = np.random.default_rng(seed)
+    if density < 0:
+        visible = np.eye(m, f, dtype=bool)  # mask i visible only in frame i
+    else:
+        visible = rng.random((m, f)) < density
+        visible[np.arange(m), rng.integers(0, f, m)] = True
+
+    ref = ref_con.get_observer_num_thresholds(
+        torch.tensor(visible, dtype=torch.float32))
+
+    obs = visible.astype(np.int64) @ visible.T.astype(np.int64)
+    hist = np.bincount(obs.ravel(), minlength=f + 1)
+    repo = observer_schedule(hist, max_len=20)
+    repo_trim = [v for v in repo.tolist() if np.isfinite(v)]
+    np.testing.assert_allclose(repo_trim, ref, rtol=0, atol=1e-9)
+
+    dev = np.asarray(observer_schedule_device(jnp.asarray(hist), max_len=20))
+    dev_trim = [v for v in dev.tolist() if np.isfinite(v)]
+    np.testing.assert_allclose(dev_trim, ref, rtol=0, atol=1e-4)
+
+
 @pytest.mark.parametrize("seed,m,f", [(7, 24, 40), (13, 48, 64), (29, 32, 25)])
 def test_clustering_matches_reference_oracle(seed, m, f):
     """Identical partitions from the reference's networkx/torch loop and the

@@ -177,3 +177,57 @@ def test_extract_label_features_artifact(tmp_path):
     d = np.load(path, allow_pickle=True).item()
     assert set(d) == {"chair", "sofa"}
     np.testing.assert_allclose(np.linalg.norm(d["chair"]), 1.0, atol=1e-5)
+
+
+def test_find_local_clip_checkpoint(tmp_path, monkeypatch):
+    """Finder semantics: env override wins, hub cache is scanned for clip
+    model dirs, a config.json without weights is not a checkpoint."""
+    from maskclustering_tpu.semantics.encoder import find_local_clip_checkpoint
+
+    monkeypatch.delenv("MCT_CLIP_PATH", raising=False)
+    hub = tmp_path / "hub"
+    snap = hub / "models--openai--clip-vit-base" / "snapshots" / "abc"
+    snap.mkdir(parents=True)
+    monkeypatch.setenv("HF_HUB_CACHE", str(hub))
+
+    # config without weights: not a usable checkpoint
+    (snap / "config.json").write_text("{}")
+    assert find_local_clip_checkpoint() is None
+
+    (snap / "pytorch_model.bin").write_bytes(b"x")
+    assert find_local_clip_checkpoint() == str(snap)
+
+    # a non-clip model dir is never picked up
+    other = hub / "models--bert-base" / "snapshots" / "zzz"
+    other.mkdir(parents=True)
+    (other / "config.json").write_text("{}")
+    (other / "model.safetensors").write_bytes(b"x")
+    assert find_local_clip_checkpoint() == str(snap)
+
+    # the open_clip cache layout of the reference's exact checkpoint
+    # (ViT-H-14 laion2b_s32b_b79k) is also a hit
+    oc = (hub / "models--laion--CLIP-ViT-H-14-laion2B-s32B-b79K"
+          / "snapshots" / "def")
+    oc.mkdir(parents=True)
+    (oc / "open_clip_config.json").write_text("{}")
+    (oc / "open_clip_pytorch_model.bin").write_bytes(b"x")
+    assert find_local_clip_checkpoint() in (str(snap), str(oc))
+
+    # explicit env path takes precedence
+    override = tmp_path / "local_clip"
+    override.mkdir()
+    (override / "config.json").write_text("{}")
+    (override / "flax_model.msgpack").write_bytes(b"x")
+    monkeypatch.setenv("MCT_CLIP_PATH", str(override))
+    assert find_local_clip_checkpoint() == str(override)
+
+
+def test_run_report_records_clip_fact(tmp_path, monkeypatch):
+    """run_report.json carries the clip_checkpoint environment fact."""
+    import json
+
+    from maskclustering_tpu.run import RunReport
+
+    r = RunReport(config_name="x", clip_checkpoint=None)
+    r.save(str(tmp_path / "rep.json"))
+    assert json.load(open(tmp_path / "rep.json"))["clip_checkpoint"] is None
