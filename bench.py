@@ -220,7 +220,11 @@ def _supervise(args):
         while (time.time() < deadline and proc.poll() is None
                and not init_ok_evt.is_set()):
             init_ok_evt.wait(1.0)
-        init_ok = init_ok_evt.is_set()
+        # final grace before any kill decision: the sentinel may sit in the
+        # pipe ahead of the drain thread (a dead worker needs no grace — its
+        # classification re-reads the event after the drain join below)
+        init_ok = (init_ok_evt.wait(2.0) if proc.poll() is None
+                   else init_ok_evt.is_set())
         killed = False
         if not init_ok and proc.poll() is None:
             print("[bench] worker stuck in backend init past the "
@@ -239,6 +243,7 @@ def _supervise(args):
                 killed = True
         rc = proc.wait()
         drain.join(10.0)
+        init_ok = init_ok_evt.is_set()  # re-read: drain may have caught up
         if killed:
             # a GIL-wedged init is the retryable class (rc 3, like the
             # in-worker watchdog); a post-init hang belongs to the worker

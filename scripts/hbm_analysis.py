@@ -60,6 +60,10 @@ def main():
     step = build_fused_step(mesh, cfg, k_max=args.k_max)
 
     s, f = args.scenes, args.frames
+    if f % args.mesh[1]:
+        f = -(-f // args.mesh[1]) * args.mesh[1]
+        print(f"[hbm] frames {args.frames} -> {f} (next multiple of the "
+              f"frame mesh dim {args.mesh[1]})", file=sys.stderr, flush=True)
     h, w, n = args.image_h, args.image_w, args.points
     shapes = (
         jax.ShapeDtypeStruct((s, n, 3), jnp.float32),   # scene_points

@@ -105,14 +105,14 @@ def main():
 
         bucket = (bucket_size(frames, cfg.frame_pad_multiple),
                   bucket_size(points, cfg.point_chunk))
+        first = bucket not in bucket_first
         t0 = time.time()
         result = run_scene(tensors, cfg, k_max=None if args.quick else 63)
         run_s = time.time() - t0
-        first = bucket not in bucket_first
         if first:
             bucket_first[bucket] = run_s
         n_obj = len(result.objects.point_ids_list)
-        rows.append((i, frames, points, boxes, bucket, gen_s, run_s, n_obj))
+        rows.append((i, frames, points, boxes, bucket, gen_s, run_s, n_obj, first))
         print(f"[northstar] scene {i}: F={frames} N={points} obj={boxes} "
               f"bucket={bucket}{' WARM' if first else ''} gen={gen_s:.1f}s "
               f"run={run_s:.2f}s objects={n_obj}",
@@ -120,7 +120,7 @@ def main():
     sweep_s = time.time() - t_sweep0
 
     buckets = sorted({r[4] for r in rows})
-    steady = [r[6] for r in rows if r[6] != bucket_first[r[4]]]
+    steady = [r[6] for r in rows if not r[8]]
     steady_median = float(np.median(steady)) if steady else float("nan")
     warm_total = float(sum(bucket_first.values()))
     compute_s = float(sum(r[6] for r in rows))
@@ -150,8 +150,8 @@ def main():
         "| scene | frames | points | objects | bucket (F_pad, N_pad) | warm? | run (s) |",
         "|---|---|---|---|---|---|---|",
     ]
-    for i, frames, points, boxes, bucket, gen_s, run_s, n_obj in rows:
-        warm = "compile" if run_s == bucket_first[bucket] else ""
+    for i, frames, points, boxes, bucket, gen_s, run_s, n_obj, first in rows:
+        warm = "compile" if first else ""
         lines.append(f"| {i} | {frames} | {points} | {n_obj}/{boxes} | "
                      f"{bucket} | {warm} | {run_s:.2f} |")
     lines += [

@@ -203,6 +203,67 @@ def _repo_partition(visible, contained, schedule, threshold):
     return {frozenset(p) for p in parts.values()}
 
 
+def _open3d_stub():
+    """open3d stub rich enough for the reference dataset loader: the only
+    o3d surface it touches is camera.PinholeCameraIntrinsic.set_intrinsics
+    (dataset/scannet.py:38-40); everything else is numpy/cv2."""
+    mod = sys.modules.get("open3d")
+    if mod is None:
+        mod = types.ModuleType("open3d")
+        sys.modules["open3d"] = mod
+    if not hasattr(mod, "camera"):
+        class _Pinhole:
+            def set_intrinsics(self, w, h, fx, fy, cx, cy):
+                self.width, self.height = w, h
+                self.fx, self.fy, self.cx, self.cy = fx, fy, cx, cy
+
+        cam = types.ModuleType("open3d.camera")
+        cam.PinholeCameraIntrinsic = _Pinhole
+        mod.camera = cam
+    return mod
+
+
+def test_scannet_loader_matches_reference(tmp_path, monkeypatch):
+    """Our ScanNetDataset and the LITERAL reference loader (dataset/
+    scannet.py, cv2-based) read the same on-disk scene identically: frame
+    list, poses, intrinsics, segmentation ids, and depth to 1 ulp (the
+    documented f32-multiply vs f64-divide decode difference, io/image.py)."""
+    pytest.importorskip("cv2")
+    _open3d_stub()
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)
+    import dataset.scannet as ref_mod  # noqa: PLC0415
+
+    from maskclustering_tpu.datasets.scannet import ScanNetDataset
+    from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
+
+    scene = make_scene(num_boxes=3, num_frames=6, image_hw=(48, 64), seed=5)
+    write_scannet_layout(scene, str(tmp_path / "data"), "scene0777_00")
+    monkeypatch.chdir(tmp_path)  # the reference hardcodes ./data/...
+
+    ref = ref_mod.ScanNetDataset("scene0777_00")
+    ref.image_size = (64, 48)  # reference hardcodes 640x480; ours derives it
+    ours = ScanNetDataset("scene0777_00", data_root=str(tmp_path / "data"))
+
+    assert ref.get_frame_list(2) == ours.get_frame_list(2)
+    for fid in ours.get_frame_list(2):
+        np.testing.assert_array_equal(ref.get_extrinsic(fid),
+                                      ours.get_extrinsic(fid))
+        np.testing.assert_array_equal(
+            ref.get_segmentation(fid, align_with_depth=True),
+            ours.get_segmentation(fid, align_with_depth=True))
+        d_ref = ref.get_depth(fid)
+        d_ours = ours.get_depth(fid)
+        assert d_ref.dtype == d_ours.dtype == np.float32
+        np.testing.assert_allclose(d_ours, d_ref, rtol=3e-7, atol=0)
+
+    pin = ref.get_intrinsics(0)
+    ours_k = ours.get_intrinsics(0)
+    np.testing.assert_allclose(
+        [pin.fx, pin.fy, pin.cx, pin.cy],
+        [ours_k[0, 0], ours_k[1, 1], ours_k[0, 2], ours_k[1, 2]])
+
+
 def _import_reference_construction():
     """Import graph.construction (only get_observer_num_thresholds is used).
 
