@@ -168,7 +168,8 @@ def _count_distinct_per_mask(ids: jnp.ndarray, vox_hash: jnp.ndarray, valid: jnp
 @functools.partial(
     jax.jit,
     static_argnames=("k_max", "window", "distance_threshold", "depth_trunc",
-                     "few_points_threshold", "coverage_threshold"),
+                     "few_points_threshold", "coverage_threshold",
+                     "full_tile_table"),
 )
 def associate_frame(
     scene_points: jnp.ndarray,  # (N, 3) float32
@@ -185,8 +186,16 @@ def associate_frame(
     depth_trunc: float = 20.0,
     few_points_threshold: int = 25,
     coverage_threshold: float = 0.3,
+    full_tile_table: Optional[bool] = None,
 ) -> FrameAssociation:
-    """Associate every scene point with the masks of one frame."""
+    """Associate every scene point with the masks of one frame.
+
+    ``full_tile_table``: the single-take window table is quadratic in the
+    window (2*(2w+1)^2 channels) and materializes F-fold under the fused
+    path's frame vmap, so it is the default only at window <= 1; larger
+    windows use one take per window ROW (linear in window). Exposed for
+    the equivalence test; semantics are identical either way.
+    """
     n = scene_points.shape[0]
     h, w = depth.shape
     fx, fy = intrinsics[0, 0], intrinsics[1, 1]
@@ -210,23 +219,16 @@ def associate_frame(
     vi = jnp.round(py / safe_z * fy + cy).astype(jnp.int32)
 
     # ---- gather the pixel window; record claiming mask id per candidate ----
-    # ONE take per frame: depth and seg interleave into a (H*W, 2*(2w+1)^2)
-    # tile table whose row at (v, u) holds the FULL [v-w..v+w] x [u-w..u+w]
-    # window of both channels, so a single gather fetches every candidate.
-    # Gather cost on TPU is per-index, not per-byte (~1.5 ms per 192k-index
-    # take regardless of row width, scripts/micro_tpu.py), so folding the
-    # (2w+1) row-strip takes into one cuts the dominant association cost by
-    # that factor. Out-of-bounds pixels on either axis read the zero padding
-    # (depth 0 -> never claims), replacing the per-offset bounds masks.
+    # depth and seg interleave into a padded tile table whose row at (v, u)
+    # holds a window of both channels; one `take` per table fetches every
+    # candidate (layout per branch below). Out-of-bounds pixels on either
+    # axis read the zero padding (depth 0 -> never claims), replacing the
+    # per-offset bounds masks.
     ww = 2 * window + 1
     dz = jnp.where(depth_ok, depth, 0.0)
     padded = jnp.pad(
         jnp.stack([dz, seg.astype(jnp.float32)], axis=-1),
         ((window, window), (window, window), (0, 0)))  # (H+2w, W+2w, 2)
-    tiles = jnp.concatenate(
-        [padded[kv : kv + h, ku : ku + w]
-         for kv in range(ww) for ku in range(ww)], axis=-1)  # (H, W, 2*ww^2)
-    tile_tab = tiles.reshape(h * w, 2 * ww * ww)
 
     r2 = distance_threshold * distance_threshold
     # clip the center pixel; tiles at a clipped center still contain every
@@ -236,20 +238,47 @@ def associate_frame(
     # per-offset formulation
     uc = jnp.clip(ui, 0, w - 1)
     vc = jnp.clip(vi, 0, h - 1)
-    g = jnp.take(tile_tab, vc * w + uc, axis=0)  # (N, 2*ww^2)
-    cand_cols = []
-    for j, (dv, du) in enumerate(
-            (dv, du) for dv in range(-window, window + 1)
-            for du in range(-window, window + 1)):
-        d = g[:, 2 * j]
-        s = g[:, 2 * j + 1].astype(jnp.int32)
+    flat_idx = vc * w + uc
+
+    def claim_col(d, s, dv, du):
         win_ok = (jnp.abs(uc + du - ui) <= window) & (jnp.abs(vc + dv - vi) <= window)
         # 3D position of this pixel's backprojection, in camera frame
         qx = (uc + du - cx) * d / fx
         qy = (vc + dv - cy) * d / fy
         dist2 = (qx - px) ** 2 + (qy - py) ** 2 + (d - pz) ** 2
         claim = in_front & win_ok & (d > 0) & (s > 0) & (dist2 <= r2)
-        cand_cols.append(jnp.where(claim, s, 0))
+        return jnp.where(claim, s, 0)
+
+    cand_cols = []
+    use_full = (window <= 1) if full_tile_table is None else full_tile_table
+    if use_full:
+        # ONE take per frame: a (H*W, 2*ww^2) table whose row at (v, u)
+        # holds the FULL window of both channels. Gather cost on TPU is
+        # per-index, not per-byte (~1.5 ms per 192k-index take,
+        # scripts/micro_tpu.py), so one wide take beats ww narrow ones.
+        tiles = jnp.concatenate(
+            [padded[kv : kv + h, ku : ku + w]
+             for kv in range(ww) for ku in range(ww)], axis=-1)
+        tile_tab = tiles.reshape(h * w, 2 * ww * ww)
+        g = jnp.take(tile_tab, flat_idx, axis=0)  # (N, 2*ww^2)
+        for j, (dv, du) in enumerate(
+                (dv, du) for dv in range(-window, window + 1)
+                for du in range(-window, window + 1)):
+            cand_cols.append(claim_col(
+                g[:, 2 * j], g[:, 2 * j + 1].astype(jnp.int32), dv, du))
+    else:
+        # window > 1: one take per window ROW over a (H*W, 2*ww) strip
+        # table — linear in window instead of quadratic, bounding the
+        # F-fold HBM footprint under the fused path's frame vmap
+        # (ADVICE r4) at the cost of ww takes.
+        for iv, dv in enumerate(range(-window, window + 1)):
+            strip = jnp.concatenate(
+                [padded[iv : iv + h, ku : ku + w] for ku in range(ww)],
+                axis=-1).reshape(h * w, 2 * ww)
+            gs = jnp.take(strip, flat_idx, axis=0)  # (N, 2*ww)
+            for ju, du in enumerate(range(-window, window + 1)):
+                cand_cols.append(claim_col(
+                    gs[:, 2 * ju], gs[:, 2 * ju + 1].astype(jnp.int32), dv, du))
     cand = jnp.stack(cand_cols, axis=1)  # (N, (2w+1)^2) claiming mask ids, 0 = none
 
     # ---- per-mask statistics ----
