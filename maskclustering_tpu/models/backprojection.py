@@ -374,6 +374,11 @@ def _associate_scene_impl(
             k_max=k_max, window=window, distance_threshold=distance_threshold,
             depth_trunc=depth_trunc, few_points_threshold=few_points_threshold,
             coverage_threshold=coverage_threshold,
+            # lax.map holds ONE frame's intermediates, so the quadratic
+            # full-window table has no F-fold footprint here: keep the
+            # single-take fast path at every window (the strip default
+            # targets the fused path's frame vmap, parallel/sharded.py)
+            full_tile_table=True,
         )
         return fa.mask_of_point, fa.first_id, fa.last_id, fa.mask_valid
 

@@ -45,8 +45,14 @@ def object_features(object_dict: Dict, mask_features: Dict[str, np.ndarray],
 
 def classify_objects(obj_feats: np.ndarray, text_feats: np.ndarray,
                      logit_scale: float = LOGIT_SCALE) -> np.ndarray:
-    """(O,) vocabulary indices via softmax(sim * scale) argmax, one matmul."""
-    sim = jnp.asarray(obj_feats) @ jnp.asarray(text_feats).T
+    """(O,) vocabulary indices via softmax(sim * scale) argmax, one matmul.
+
+    precision="highest": the TPU default (bf16 operands) carries ~1e-2
+    relative error on unit-norm dots — enough to flip the argmax between
+    close labels; the (O, D) x (D, L) matmul is tiny, full f32 is free.
+    """
+    sim = jnp.matmul(jnp.asarray(obj_feats), jnp.asarray(text_feats).T,
+                     precision="highest")
     prob = jax.nn.softmax(sim * logit_scale, axis=-1)
     return np.asarray(jnp.argmax(prob, axis=-1))
 

@@ -151,6 +151,74 @@ def test_evaluator_matches_reference_bit_level(tmp_path, no_class):
                                    equal_nan=True)
 
 
+# ------------------------------------------------------------------- query
+
+def test_query_stage_matches_reference(tmp_path, monkeypatch):
+    """maskclustering_tpu.semantics.assign_labels vs the LITERAL reference
+    semantics/open-voc_query.py main(): same object_dict + mask features +
+    label features -> identical class ids and prediction masks."""
+    import runpy
+    from types import SimpleNamespace
+
+    from maskclustering_tpu.semantics import assign_labels, l2_normalize
+    from maskclustering_tpu.semantics.vocab import get_vocab
+
+    _open3d_stub()
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)
+    # executes the module (imports utils.config -> dataset/* under the
+    # open3d stub); returns its globals so main() can run with an injected
+    # dataset below
+    g = runpy.run_path(os.path.join(REFERENCE, "semantics", "open-voc_query.py"))
+
+    labels, valid_ids = get_vocab("scannet")
+    label2id = {l: int(i) for l, i in zip(labels, valid_ids)}
+    rng = np.random.default_rng(21)
+    dim, n_pts = 64, 4000
+    text = l2_normalize(rng.standard_normal((len(labels), dim)).astype(np.float32))
+    label_features = {l: text[i] for i, l in enumerate(labels)}
+
+    object_dict = {}
+    clip_features = {}
+    for o in range(7):
+        repre = [(f"fr{o}", m) for m in range(1 + o % 2)]
+        for frame, mid in repre:
+            clip_features[f"{frame}_{mid}"] = l2_normalize(
+                rng.standard_normal(dim).astype(np.float32))
+        object_dict[o] = {
+            "point_ids": set(rng.choice(n_pts, size=200 + 10 * o, replace=False)
+                             .tolist()),
+            "repre_mask_list": repre,
+        }
+    object_dict[7] = {"point_ids": {3}, "repre_mask_list": []}  # featureless
+
+    obj_dir = tmp_path / "obj" / "cfg"
+    obj_dir.mkdir(parents=True)
+    np.save(obj_dir / "object_dict.npy", object_dict, allow_pickle=True)
+    np.save(obj_dir / "open-vocabulary_features.npy", clip_features,
+            allow_pickle=True)
+
+    ds = SimpleNamespace(
+        object_dict_dir=str(tmp_path / "obj"),
+        get_scene_points=lambda: np.zeros((n_pts, 3), dtype=np.float32),
+        get_label_features=lambda: label_features,
+        get_label_id=lambda: (label2id, {v: k for k, v in label2id.items()}),
+    )
+    monkeypatch.chdir(tmp_path)  # the reference writes ./data/prediction/...
+    main_fn = g["main"]
+    # runpy.run_path returns a COPY of the module globals; patch the dict
+    # the function actually closes over
+    main_fn.__globals__["get_dataset"] = lambda args: ds
+    main_fn(SimpleNamespace(config="cfg", seq_name="s0"))
+    ref = np.load(tmp_path / "data" / "prediction" / "cfg" / "s0.npz")
+
+    ours = assign_labels(object_dict, clip_features, label_features,
+                         label2id, n_pts)
+    np.testing.assert_array_equal(ours["pred_classes"], ref["pred_classes"])
+    np.testing.assert_array_equal(ours["pred_masks"], ref["pred_masks"])
+    np.testing.assert_array_equal(ours["pred_score"], ref["pred_score"])
+
+
 # ---------------------------------------------------------------- clustering
 
 def _import_reference_graph():
