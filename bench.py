@@ -61,6 +61,12 @@ def _emit(args, times, error=None, stage_timings=None):
             "value": round(s_per_scene, 3),
             "unit": "s/scene",
             "vs_baseline": round(BASELINE_S_PER_SCENE / s_per_scene, 2),
+            # per-run times + spread: the run-to-run stability criterion
+            # (three consecutive runs within +-15%) lands in the driver's
+            # BENCH json without extra artifacts
+            "runs": [round(float(t), 3) for t in times],
+            "spread_pct": round(
+                100.0 * (max(times) - min(times)) / s_per_scene, 1),
         }
         if stage_timings:
             # median per stage across completed repeats: puts the breakdown
