@@ -1,0 +1,65 @@
+"""Shared watchdog-guarded backend init (utils/backend_init.py).
+
+The wedged-chip timeout path needs a subprocess (the watchdog os._exit(3)s
+the whole process); the success and failure paths run in-process.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from maskclustering_tpu.utils.backend_init import (
+    INIT_TIMEOUT_EXIT_CODE,
+    init_backend,
+)
+
+
+def test_init_backend_success_returns_devices():
+    devices = init_backend("cpu", timeout_s=120.0, tag="t")
+    assert len(devices) >= 1
+    assert devices[0].platform == "cpu"
+
+
+def test_init_backend_bad_platform_raises():
+    """Subprocess: once a backend is up in-process (the success test, or
+    conftest), jax serves cached devices and a bad platform no longer
+    raises — the child must hit init fresh."""
+    code = rf"""
+import sys
+sys.path.insert(0, {REPO_ROOT!r})
+from maskclustering_tpu.utils.backend_init import init_backend
+try:
+    init_backend("nosuch", timeout_s=30.0, tag="t")
+except Exception as e:
+    assert "nosuch" in str(e), e
+    print("RAISED-OK")
+"""
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, timeout=120)
+    assert b"RAISED-OK" in proc.stdout, proc.stderr[-500:]
+
+
+def test_init_backend_timeout_exits_3_and_runs_hook():
+    """A stalled init must os._exit(3) from the watchdog thread and run the
+    on_timeout hook first. Simulated by an init that sleeps past the
+    timeout (monkeypatched jax.devices in a child process)."""
+    code = rf"""
+import sys, time, types
+sys.path.insert(0, {REPO_ROOT!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.devices()  # real init done; now stall the guarded call
+from maskclustering_tpu.utils import backend_init
+orig = jax.devices
+jax.devices = lambda *a: time.sleep(30)
+backend_init.init_backend(None, timeout_s=1.0, tag="t",
+                          on_timeout=lambda: print("HOOK-RAN", flush=True))
+print("UNREACHABLE")
+"""
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, timeout=120)
+    assert proc.returncode == INIT_TIMEOUT_EXIT_CODE
+    assert b"HOOK-RAN" in proc.stdout
+    assert b"UNREACHABLE" not in proc.stdout
