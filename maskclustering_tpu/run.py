@@ -663,33 +663,16 @@ def run_pipeline(
 
 
 def init_backend_or_die(timeout_s: float = 120.0, platform: Optional[str] = None):
-    """Initialize the jax backend under a watchdog.
+    """Initialize the jax backend under a watchdog (shared helper).
 
     A wedged accelerator client hangs inside backend init with no exception
     (another process holding the chip, a dead tunnel); the watchdog turns a
     silent multi-minute stall into a one-line diagnosis and a nonzero exit
     — the failure-detection posture the reference lacks entirely (SURVEY §5).
     """
-    import threading
+    from maskclustering_tpu.utils.backend_init import init_backend
 
-    def _watchdog():
-        log.fatal("backend init did not finish within %.0fs "
-                  "(chip busy or runtime wedged)", timeout_s)
-        os._exit(3)
-
-    timer = threading.Timer(timeout_s, _watchdog)
-    timer.daemon = True
-    timer.start()
-    try:
-        import jax
-
-        if platform:
-            jax.config.update("jax_platforms", platform)
-        devices = jax.devices()
-    finally:
-        timer.cancel()
-    log.info("backend up: %dx %s", len(devices), devices[0].device_kind)
-    return devices
+    return init_backend(platform, timeout_s=timeout_s, tag="run", logger=log)
 
 
 def main(argv=None) -> int:

@@ -23,19 +23,32 @@ INIT_TIMEOUT_EXIT_CODE = 3  # retryable "backend never came up" convention
 
 def init_backend(platform: Optional[str] = None, timeout_s: float = 120.0,
                  on_timeout: Optional[Callable[[], None]] = None,
-                 tag: str = "backend"):
+                 tag: str = "backend", logger=None):
     """Import jax and touch devices under a watchdog; returns the devices.
 
     ``platform``: force a jax platform (must go through jax.config — this
     image preloads the TPU plugin via sitecustomize, so the JAX_PLATFORMS
     env var is read too early to matter). ``on_timeout`` runs in the
     watchdog thread right before ``os._exit(3)`` (e.g. emit a JSON line).
+    ``logger``: a logging.Logger to route messages through (callers with a
+    configured logging setup, e.g. run.py); default is raw stderr prints.
     Exceptions from init propagate to the caller.
     """
+    def _info(msg):
+        if logger is not None:
+            logger.info(msg)
+        else:
+            print(f"[{tag}] {msg}", file=sys.stderr, flush=True)
+
+    def _fatal(msg):
+        if logger is not None:
+            logger.fatal(msg)
+        else:
+            print(f"[{tag}] FATAL: {msg}", file=sys.stderr, flush=True)
+
     def _watchdog():
-        print(f"[{tag}] FATAL: backend init did not finish within "
-              f"{timeout_s}s (chip busy or TPU runtime wedged)",
-              file=sys.stderr, flush=True)
+        _fatal(f"backend init did not finish within {timeout_s}s "
+               "(backend busy or runtime wedged)")
         if on_timeout is not None:
             on_timeout()
         os._exit(INIT_TIMEOUT_EXIT_CODE)
@@ -51,6 +64,5 @@ def init_backend(platform: Optional[str] = None, timeout_s: float = 120.0,
         devices = jax.devices()
     finally:
         timer.cancel()
-    print(f"[{tag}] backend up: {len(devices)}x {devices[0].device_kind}",
-          file=sys.stderr, flush=True)
+    _info(f"backend up: {len(devices)}x {devices[0].device_kind}")
     return devices
