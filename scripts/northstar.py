@@ -107,7 +107,14 @@ def main():
                   bucket_size(points, cfg.point_chunk))
         first = bucket not in bucket_first
         t0 = time.time()
-        result = run_scene(tensors, cfg, k_max=None if args.quick else 63)
+        try:
+            result = run_scene(tensors, cfg, k_max=None if args.quick else 63)
+        except Exception as e:  # noqa: BLE001 — a mid-sweep chip stall must
+            # not lose the scenes already measured; report what completed
+            print(f"[northstar] scene {i} FAILED ({type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:200] if str(e) else e}); "
+                  "writing partial results", file=sys.stderr, flush=True)
+            break
         run_s = time.time() - t0
         if first:
             bucket_first[bucket] = run_s
@@ -118,6 +125,9 @@ def main():
               f"run={run_s:.2f}s objects={n_obj}",
               file=sys.stderr, flush=True)
     sweep_s = time.time() - t_sweep0
+    if not rows:
+        print(json.dumps({"error": "no scene completed", "pass": False}))
+        sys.exit(2)
 
     buckets = sorted({r[4] for r in rows})
     steady = [r[6] for r in rows if not r[8]]
