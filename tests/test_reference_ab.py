@@ -151,6 +151,162 @@ def test_evaluator_matches_reference_bit_level(tmp_path, no_class):
                                    equal_nan=True)
 
 
+# --------------------------------------------------------------- postprocess
+
+def _import_reference_postprocess():
+    """utils/post_process.py imports numpy/torch/utils.geometry only; the
+    open3d-touching dbscan_process is never called by these tests."""
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)
+    import utils.post_process as ref_pp  # noqa: PLC0415
+    return ref_pp
+
+
+def test_overlap_merge_matches_reference():
+    """_merge_overlapping vs the literal merge_overlapping_objects
+    (post_process.py:7-37): same survivors in the same order, including the
+    scan-order asymmetry (i dies on the first test, j on the elif)."""
+    ref_pp = _import_reference_postprocess()
+    rng = np.random.default_rng(5)
+    objs, bboxes, masks = [], [], []
+    base = rng.choice(5000, size=600, replace=False)
+    # deaths cover BOTH branches of the reference's asymmetric test:
+    # 0 dies via the i-branch (|0∩1|/|0| = 360/400 > 0.8 with j=1);
+    # 2 dies via the i-branch against 3; 3 then dies against 4;
+    # 6 (strict subset of surviving 1, |6|/|1| = 200/360 <= 0.8) dies via
+    # the ELIF j-branch (|1∩6|/|6| = 1.0 > 0.8);
+    # 5 shares ids with 1 but its bbox is displaced: prefilter skips it
+    objs.append(base[:400])
+    objs.append(base[:360])
+    objs.append(base[400:520])
+    objs.append(np.concatenate([base[400:520], base[520:560]]))
+    objs.append(base[200:600])
+    objs.append(base[:100])
+    objs.append(base[:200])
+    pts3d = rng.random((5000, 3)) * 4.0
+    for i, o in enumerate(objs):
+        lo, hi = pts3d[o].min(axis=0), pts3d[o].max(axis=0)
+        if i == 5:
+            lo, hi = lo + 100.0, hi + 100.0  # disjoint bbox despite shared ids
+        bboxes.append((lo, hi))
+        masks.append([("f", i, 0.5)])
+
+    from maskclustering_tpu.models.postprocess import _merge_overlapping
+
+    ref_ids, ref_masks = ref_pp.merge_overlapping_objects(
+        [o.copy() for o in objs], [tuple(b) for b in bboxes],
+        [list(m) for m in masks], 0.8)
+    our_ids, our_masks = _merge_overlapping(
+        [o.copy() for o in objs], list(bboxes), [list(m) for m in masks], 0.8)
+    assert len(ref_ids) == len(our_ids)
+    for r, o in zip(ref_ids, our_ids):
+        np.testing.assert_array_equal(np.sort(r), np.sort(o))
+    assert ref_masks == our_masks
+
+
+def test_representative_masks_match_reference():
+    ref_pp = _import_reference_postprocess()
+    from maskclustering_tpu.models.postprocess import representative_masks
+
+    rng = np.random.default_rng(9)
+    infos = [("f%d" % i, i, round(float(c), 6))
+             for i, c in enumerate(rng.random(9))]
+    infos.append(("tie", 99, infos[3][2]))  # duplicate coverage: stable order
+    ours = representative_masks(list(infos))
+    ref = ref_pp.find_represent_mask(list(infos))
+    assert ours == ref
+
+
+def test_node_filter_pipeline_matches_reference_filter_point():
+    """End-to-end node post-filtering A/B: the literal filter_point
+    (post_process.py:40-101) on a crafted node vs postprocess_scene run on
+    the equivalent claim tensors. Exercises the OVIR-3D detection ratio,
+    best-overlap mask->object assignment with coverage, the < 2-mask object
+    drop, and the spatial split — same objects, same mask lists."""
+    from types import SimpleNamespace
+
+    from maskclustering_tpu.models.postprocess import postprocess_scene
+
+    ref_pp = _import_reference_postprocess()
+    rng = np.random.default_rng(31)
+    n, f = 480, 10
+    # three far-apart blobs -> unambiguous spatial split at eps 0.5
+    pts3d = np.empty((n, 3), dtype=np.float32)
+    blob_a = np.arange(0, 220)
+    blob_b = np.arange(220, 400)
+    blob_c = np.arange(400, 480)
+    pts3d[blob_a] = rng.random((len(blob_a), 3))
+    pts3d[blob_b] = rng.random((len(blob_b), 3)) + 10.0
+    pts3d[blob_c] = rng.random((len(blob_c), 3)) + 20.0
+
+    # node masks: 3 on blob A (frames 0-2), 2 on blob B (frames 3-4), one
+    # straddler on frame 5 majority-A, and a SINGLE mask on blob C — whose
+    # object must be dropped by the < 2-mask rule on both sides
+    mask_defs = [
+        (0, 1, rng.choice(blob_a, 150, replace=False)),
+        (1, 1, rng.choice(blob_a, 160, replace=False)),
+        (2, 2, rng.choice(blob_a, 140, replace=False)),
+        (3, 1, rng.choice(blob_b, 120, replace=False)),
+        (4, 1, rng.choice(blob_b, 130, replace=False)),
+        (5, 1, np.concatenate([rng.choice(blob_a, 90, replace=False),
+                               rng.choice(blob_b, 40, replace=False)])),
+        (6, 1, rng.choice(blob_c, 60, replace=False)),
+    ]
+    node_frames = np.zeros(f, dtype=bool)
+    node_frames[[d[0] for d in mask_defs]] = True
+    # visibility: every claimed point visible in its frame, plus noise
+    # visibility in non-node frames (dilutes the denominator for some points)
+    point_frame = rng.random((n, f)) < 0.3
+    for fid, _, pids in mask_defs:
+        point_frame[pids, fid] = True
+
+    # ---- reference side ----
+    torch_node = SimpleNamespace(
+        visible_frame=torch.tensor(node_frames),
+        mask_list=[(fid, mid) for fid, mid, _ in mask_defs])
+    mask_point_clouds = {f"{fid}_{mid}": set(map(int, pids))
+                        for fid, mid, pids in mask_defs}
+    node_point_ids = sorted({int(p) for _, _, pids in mask_defs for p in pids})
+    grp_a = np.asarray([p for p in node_point_ids if p < 220])
+    grp_b = np.asarray([p for p in node_point_ids if 220 <= p < 400])
+    grp_c = np.asarray([p for p in node_point_ids if p >= 400])
+    pcld_list = [SimpleNamespace(points=pts3d[g]) for g in (grp_a, grp_b, grp_c)]
+    ref_ids, ref_bboxes, ref_masks = ref_pp.filter_point(
+        point_frame, torch_node, pcld_list, [grp_a, grp_b, grp_c],
+        mask_point_clouds, list(range(f)),
+        SimpleNamespace(point_filter_threshold=0.5))
+    # the single-mask blob-C object must be dropped by the < 2-mask rule
+    assert len(ref_ids) == 2
+    assert all(ids.max() < 400 for ids in ref_ids)
+
+    # ---- repo side: same node as claim tensors through postprocess_scene ----
+    k_max = 3
+    first = np.zeros((f, n), dtype=np.int32)
+    last = np.zeros((f, n), dtype=np.int32)
+    for fid, mid, pids in mask_defs:
+        first[fid, pids] = mid
+        last[fid, pids] = mid
+    m_pad = len(mask_defs)
+    mask_frame = np.asarray([d[0] for d in mask_defs], dtype=np.int32)
+    mask_id = np.asarray([d[1] for d in mask_defs], dtype=np.int32)
+    node_visible = np.zeros((m_pad, f), dtype=bool)
+    node_visible[0] = node_frames  # all masks assigned to rep slot 0
+    objects = postprocess_scene(
+        pts3d, first, last, point_frame.T.copy(), mask_frame, mask_id,
+        np.ones(m_pad, dtype=bool), np.zeros(m_pad, dtype=np.int32),
+        node_visible, list(range(f)), k_max=k_max,
+        point_filter_threshold=0.5, dbscan_eps=0.5, dbscan_min_points=1,
+        overlap_merge_ratio=0.8)
+
+    ref_set = {(frozenset(map(int, ids)),
+                frozenset((fid, mid, round(cov, 9)) for fid, mid, cov in ml))
+               for ids, ml in zip(ref_ids, ref_masks)}
+    our_set = {(frozenset(map(int, ids)),
+                frozenset((fid, mid, round(cov, 9)) for fid, mid, cov in ml))
+               for ids, ml in zip(objects.point_ids_list, objects.mask_list)}
+    assert ref_set == our_set
+
+
 # ------------------------------------------------------------------- query
 
 def test_query_stage_matches_reference(tmp_path, monkeypatch):
