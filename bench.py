@@ -173,6 +173,8 @@ def _build_parser():
     p.add_argument("--worker-timeout", type=float, default=3600.0,
                    help="post-init run allowance (s) before the supervisor "
                         "kills a worker outright (GIL-proof hang backstop)")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the timed repeats")
     return p
 
 
@@ -344,15 +346,27 @@ def main():
         print(f"[bench] warm-up (incl. compile): {time.time()-t0:.1f}s",
               file=sys.stderr, flush=True)
 
-        for i in range(args.repeats):
-            t0 = time.time()
-            result = run_scene(tensors, cfg, k_max=args.k_max)
-            times.append(time.time() - t0)
-            stage_timings.append(dict(result.timings))
-            print(f"[bench] run {i}: {times[-1]:.2f}s "
-                  f"({len(result.objects.point_ids_list)} objects, "
-                  f"timings {['%s=%.2f' % kv for kv in result.timings.items()]})",
+        if args.profile_dir:
+            # start/stop (not `with`): the failing repeat is exactly the one
+            # whose trace matters, so the finally must flush it either way
+            import jax.profiler
+
+            jax.profiler.start_trace(args.profile_dir)
+            print(f"[bench] profiler trace -> {args.profile_dir}",
                   file=sys.stderr, flush=True)
+        try:
+            for i in range(args.repeats):
+                t0 = time.time()
+                result = run_scene(tensors, cfg, k_max=args.k_max)
+                times.append(time.time() - t0)
+                stage_timings.append(dict(result.timings))
+                print(f"[bench] run {i}: {times[-1]:.2f}s "
+                      f"({len(result.objects.point_ids_list)} objects, "
+                      f"timings {['%s=%.2f' % kv for kv in result.timings.items()]})",
+                      file=sys.stderr, flush=True)
+        finally:
+            if args.profile_dir:
+                jax.profiler.stop_trace()
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
         print(f"[bench] ERROR after {len(times)} completed runs: {e}",
               file=sys.stderr, flush=True)
