@@ -347,8 +347,9 @@ def main():
               file=sys.stderr, flush=True)
 
         if args.profile_dir:
-            # start/stop (not `with`): the failing repeat is exactly the one
-            # whose trace matters, so the finally must flush it either way
+            # manual start/stop rather than the (equivalent) jax.profiler
+            # .trace contextmanager so a trace-flush failure below can be
+            # swallowed instead of masking the run's real exception
             import jax.profiler
 
             jax.profiler.start_trace(args.profile_dir)
@@ -366,7 +367,12 @@ def main():
                       file=sys.stderr, flush=True)
         finally:
             if args.profile_dir:
-                jax.profiler.stop_trace()
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as te:  # noqa: BLE001 — a flush failure
+                    # (disk full, dead rig) must not mask the loop's error
+                    print(f"[bench] WARNING: profiler trace flush failed: "
+                          f"{te}", file=sys.stderr, flush=True)
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
         print(f"[bench] ERROR after {len(times)} completed runs: {e}",
               file=sys.stderr, flush=True)

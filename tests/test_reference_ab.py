@@ -547,6 +547,31 @@ def test_observer_schedule_matches_reference(seed, m, f, density):
     np.testing.assert_allclose(dev_trim, ref, rtol=0, atol=1e-4)
 
 
+def test_clustering_matches_reference_at_bench_scale():
+    """512 masks x 256 frames with 16 planted clusters: bf16-operand
+    affinity counts and the f32 consensus rate must merge identically to
+    the reference's float32 torch loop at real scale."""
+    rng = np.random.default_rng(97)
+    m, f, blocks = 512, 256, 16
+    per = m // blocks
+    visible = np.zeros((m, f), dtype=bool)
+    contained = np.eye(m, dtype=bool)
+    for b in range(blocks):
+        sl = slice(b * per, (b + 1) * per)
+        frames = rng.choice(f, size=40, replace=False)
+        # members co-visible on most block frames, plus private noise frames
+        for i in range(b * per, (b + 1) * per):
+            visible[i, frames[rng.random(40) < 0.8]] = True
+            visible[i, rng.integers(0, f, 3)] = True
+        contained[sl, sl] = rng.random((per, per)) < 0.9
+    schedule = [12.0, 8.0, 5.0, 3.0, 2.0, 1.0]
+
+    ref_parts = _reference_partition(visible, contained, schedule, 0.9)
+    repo_parts = _repo_partition(visible, contained, schedule, 0.9)
+    assert repo_parts == ref_parts
+    assert len(ref_parts) < m  # real merging happened at scale
+
+
 @pytest.mark.parametrize("seed,m,f", [(7, 24, 40), (13, 48, 64), (29, 32, 25)])
 def test_clustering_matches_reference_oracle(seed, m, f):
     """Identical partitions from the reference's networkx/torch loop and the
