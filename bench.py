@@ -270,7 +270,8 @@ def _supervise(args):
                   f"({attempt} attempts, {time.time()-t_start:.0f}s)",
                   file=sys.stderr, flush=True)
             break
-        backoff = min(20.0 * attempt, 120.0)
+        scale = float(os.environ.get("MCT_BENCH_BACKOFF_SCALE", "1.0"))
+        backoff = min(20.0 * attempt, 120.0) * scale
         if remaining <= backoff:
             # the promised retry could never launch: don't sleep into the wall
             print(f"[bench] giving up: {remaining:.0f}s of budget left "

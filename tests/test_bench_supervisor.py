@@ -1,0 +1,62 @@
+"""End-to-end contract tests for the bench.py supervisor/worker pair.
+
+BENCH_r04 was lost to a single backend-init timeout; these pin the
+hardening: exactly one JSON line on stdout in every outcome, attempt
+accounting, retry-then-give-up on init failures, and exit codes that shell
+callers (deploy/setup_tpu_vm.sh under set -e) can trust.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO_ROOT, "bench.py")
+
+TINY = ["--frames", "6", "--points", "2048", "--boxes", "3",
+        "--image-h", "48", "--image-w", "64", "--repeats", "2",
+        "--spacing", "0.08"]
+
+
+def _run(argv, timeout=420):
+    env = dict(os.environ, MCT_BENCH_BACKOFF_SCALE="0.05")  # fast retries
+    return subprocess.run([sys.executable, BENCH] + argv, env=env,
+                          capture_output=True, timeout=timeout, cwd=REPO_ROOT)
+
+
+def test_supervisor_success_emits_one_json_line():
+    proc = _run(["--platform", "cpu"] + TINY)
+    out_lines = proc.stdout.decode().strip().splitlines()
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert len(out_lines) == 1, out_lines  # the whole stdout contract
+    d = json.loads(out_lines[0])
+    assert d["value"] is not None
+    assert d["attempts"] == 1
+    assert len(d["runs"]) == 2
+    assert "spread_pct" in d and "stages" in d
+    assert "INIT_OK" not in proc.stdout.decode()
+
+
+def test_supervisor_retries_init_failure_then_gives_up():
+    proc = _run(["--platform", "nosuch", "--init-attempts", "2",
+                 "--retry-budget", "60"], timeout=180)
+    out_lines = proc.stdout.decode().strip().splitlines()
+    assert proc.returncode == 2  # worker's init-failure class preserved
+    assert len(out_lines) == 1
+    d = json.loads(out_lines[0])
+    assert d["value"] is None
+    assert d["attempts"] == 2
+    assert "backend init failed" in d["error"]
+    # the supervisor narrated both attempts on stderr
+    assert proc.stderr.decode().count("attempt ") == 2
+
+
+def test_direct_worker_keeps_one_line_contract():
+    proc = _run(["--worker", "--platform", "cpu"] + TINY)
+    out_lines = proc.stdout.decode().strip().splitlines()
+    assert proc.returncode == 0
+    assert len(out_lines) == 1
+    d = json.loads(out_lines[0])
+    assert d["value"] is not None
+    assert "attempts" not in d  # supervisor-only annotation
