@@ -46,6 +46,15 @@ _INIT_FAILED_RCS = (2, 3)
 _INIT_OK_SENTINEL = "[bench-worker] INIT_OK"
 
 
+def _backoff_scale() -> float:
+    """Test knob; a malformed value must not break the one-JSON-line
+    contract mid-supervision, so fall back to 1.0 and never go negative."""
+    try:
+        return max(float(os.environ.get("MCT_BENCH_BACKOFF_SCALE", "1.0")), 0.0)
+    except ValueError:
+        return 1.0
+
+
 def _metric_name(args) -> str:
     return (f"mask-clustering s/scene (synthetic scene: {args.frames}fr x "
             f"{args.points // 1024}k pts x {args.boxes} objects)")
@@ -270,8 +279,7 @@ def _supervise(args):
                   f"({attempt} attempts, {time.time()-t_start:.0f}s)",
                   file=sys.stderr, flush=True)
             break
-        scale = float(os.environ.get("MCT_BENCH_BACKOFF_SCALE", "1.0"))
-        backoff = min(20.0 * attempt, 120.0) * scale
+        backoff = min(20.0 * attempt, 120.0) * _backoff_scale()
         if remaining <= backoff:
             # the promised retry could never launch: don't sleep into the wall
             print(f"[bench] giving up: {remaining:.0f}s of budget left "

@@ -21,6 +21,7 @@ TINY = ["--frames", "6", "--points", "2048", "--boxes", "3",
 
 def _run(argv, timeout=420):
     env = dict(os.environ, MCT_BENCH_BACKOFF_SCALE="0.05")  # fast retries
+    env.pop("MCT_BENCH_SUPERVISED", None)  # never inherit supervisor mode
     return subprocess.run([sys.executable, BENCH] + argv, env=env,
                           capture_output=True, timeout=timeout, cwd=REPO_ROOT)
 
