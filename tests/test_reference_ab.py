@@ -151,6 +151,134 @@ def test_evaluator_matches_reference_bit_level(tmp_path, no_class):
                                    equal_nan=True)
 
 
+def test_matterport_loader_matches_reference(tmp_path, monkeypatch):
+    """Our MatterportDataset vs the literal reference dataset/matterport.py
+    on the same .conf + depth PNGs: frame list, per-frame intrinsics, the
+    GL->CV extrinsic flip, and the 0.25 mm depth decode."""
+    pytest.importorskip("cv2")
+    from PIL import Image
+
+    _open3d_stub()
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)
+    import dataset.matterport as ref_mod  # noqa: PLC0415
+
+    from maskclustering_tpu.datasets.matterport import MatterportDataset
+
+    seq = "17DRP5sb8fy"
+    base = tmp_path / "data" / "matterport3d" / "scans" / seq / seq
+    (base / "undistorted_camera_parameters").mkdir(parents=True)
+    (base / "undistorted_depth_images").mkdir()
+    rng = np.random.default_rng(4)
+
+    def ext_line(i):
+        # non-identity rotation + translation: distinguishes the GL->CV
+        # COLUMN flip from a row-flip bug, which coincide on identity
+        th = 0.3 + 0.2 * i
+        c, s = np.cos(th), np.sin(th)
+        ext = np.eye(4)
+        ext[:3, :3] = [[c, -s, 0], [s, c, 0], [0, 0, 1.0]]
+        ext[:3, 3] = [1.0 + i, -2.0, 0.5 * i]
+        return " ".join(str(float(x)) for x in ext.flatten())
+
+    # real Matterport layout: each intrinsics_matrix governs the 6 scans
+    # after it (the reference indexes scan i into 6 appended copies; ours
+    # carries the current block forward — identical exactly per-format)
+    conf = ["dataset matterport",
+            "intrinsics_matrix 1000 0 640  0 1000 512  0 0 1"]
+    conf += [f"scan d{i}.png c{i}.jpg {ext_line(i)}" for i in range(6)]
+    conf += ["intrinsics_matrix 1077 0 630  0 1077 500  0 0 1",
+             f"scan d6.png c6.jpg {ext_line(6)}"]
+    (base / "undistorted_camera_parameters" / f"{seq}.conf").write_text(
+        "\n".join(conf) + "\n")
+    for i in range(7):
+        Image.fromarray(rng.integers(2000, 8000, size=(32, 40))
+                        .astype(np.uint16)).save(
+            base / "undistorted_depth_images" / f"d{i}.png")
+
+    monkeypatch.chdir(tmp_path)  # the reference hardcodes ./data/...
+    ref = ref_mod.MatterportDataset(seq)
+    ours = MatterportDataset(seq, data_root=str(tmp_path / "data"))
+
+    assert list(ref.get_frame_list(1)) == list(ours.get_frame_list(1))
+    for fid in ours.get_frame_list(1):
+        pin = ref.get_intrinsics(fid)
+        k = ours.get_intrinsics(fid)
+        np.testing.assert_allclose(
+            [pin.fx, pin.fy, pin.cx, pin.cy],
+            [k[0, 0], k[1, 1], k[0, 2], k[1, 2]])
+        np.testing.assert_array_equal(ref.get_extrinsic(fid),
+                                      ours.get_extrinsic(fid))
+        d_ref = ref.get_depth(fid)
+        d_ours = ours.get_depth(fid)
+        assert d_ref.dtype == d_ours.dtype == np.float32
+        np.testing.assert_allclose(d_ours, d_ref, rtol=3e-7, atol=0)
+
+
+def test_scannetpp_loader_matches_reference(tmp_path, monkeypatch):
+    """Our ScanNetPPDataset vs the literal reference dataset/scannetpp.py on
+    the same COLMAP text + render_depth: frame ids, quaternion->c2w
+    extrinsics (inv of world_to_camera), intrinsics, depth decode."""
+    pytest.importorskip("cv2")
+    from PIL import Image
+
+    _open3d_stub()
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)
+    import dataset.scannetpp as ref_mod  # noqa: PLC0415
+
+    from maskclustering_tpu.datasets.scannetpp import ScanNetPPDataset
+
+    seq = "abc123"
+    base = tmp_path / "data" / "scannetpp" / "data" / seq
+    colmap = base / "iphone" / "colmap"
+    colmap.mkdir(parents=True)
+    (base / "iphone" / "render_depth").mkdir()
+    (tmp_path / "data" / "scannetpp" / "pcld_0.25").mkdir()
+    (colmap / "cameras.txt").write_text(
+        "# cameras\n1 PINHOLE 1920 1440 1500 1500 960 720\n")
+    (colmap / "images.txt").write_text(
+        "# images\n"
+        "1 1 0 0 0 1 2 3 1 frame_000000.jpg\n"
+        "0.0 0.0 -1\n"
+        "2 0.7071067811865476 0 0.7071067811865476 0 0 0 0 1 frame_000010.jpg\n"
+        "\n"
+        # rotation AND translation together: c2w = [R^T | -R^T t] — catches
+        # the classic analytic-inverse bug [R^T | -t]
+        "3 0.7071067811865476 0 0.7071067811865476 0 1 2 3 1 frame_000020.jpg\n"
+        "1.0 -2.0 5\n")
+    rng = np.random.default_rng(6)
+    for i in (0, 10, 20):
+        Image.fromarray(rng.integers(500, 3000, size=(24, 32))
+                        .astype(np.uint16)).save(
+            base / "iphone" / "render_depth" / f"frame_{i:06d}.png")
+    # a tensor payload: the reference's bare torch.load runs under the
+    # torch>=2.6 weights_only default, which rejects pickled numpy arrays
+    torch.save({"sampled_coords": torch.tensor(rng.normal(size=(40, 3)))},
+               tmp_path / "data" / "scannetpp" / "pcld_0.25" / f"{seq}.pth")
+
+    monkeypatch.chdir(tmp_path)
+    ref = ref_mod.ScanNetPPDataset(seq)
+    ours = ScanNetPPDataset(seq, data_root=str(tmp_path / "data"))
+
+    assert list(ref.get_frame_list(1)) == list(ours.get_frame_list(1))
+    assert list(ref.get_frame_list(2)) == list(ours.get_frame_list(2))
+    for fid in ours.get_frame_list(1):
+        pin = ref.get_intrinsics(fid)
+        k = ours.get_intrinsics(fid)
+        np.testing.assert_allclose(
+            [pin.fx, pin.fy, pin.cx, pin.cy],
+            [k[0, 0], k[1, 1], k[0, 2], k[1, 2]])
+        np.testing.assert_allclose(ref.get_extrinsic(fid),
+                                   ours.get_extrinsic(fid), atol=1e-12)
+        d_ref = ref.get_depth(fid)
+        d_ours = ours.get_depth(fid)
+        assert d_ref.dtype == d_ours.dtype == np.float32
+        np.testing.assert_allclose(d_ours, d_ref, rtol=3e-7, atol=0)
+    np.testing.assert_array_equal(ref.get_scene_points(),
+                                  ours.get_scene_points())
+
+
 # --------------------------------------------------------------- postprocess
 
 def _import_reference_postprocess():
