@@ -279,6 +279,46 @@ def test_scannetpp_loader_matches_reference(tmp_path, monkeypatch):
                                   ours.get_scene_points())
 
 
+def test_sens_writer_parses_with_reference_sensordata(tmp_path):
+    """Binary .sens contract: a file produced by our write_sens must parse
+    bit-for-bit in the LITERAL reference parser (preprocess/scannet/
+    SensorData.py) — header fields, per-frame poses, zlib depth, jpeg color.
+
+    Only the `png` module (absent here) is stubbed; it is used by the
+    reference's exporter methods, never by the parser under test."""
+    if "png" not in sys.modules:
+        sys.modules["png"] = types.ModuleType("png")
+    ref_dir = os.path.join(REFERENCE, "preprocess", "scannet")
+    if ref_dir not in sys.path:
+        sys.path.insert(0, ref_dir)
+    import SensorData as ref_sens  # noqa: PLC0415
+
+    from test_preprocess import _make_sens  # noqa: PLC0415 — shared fixture
+
+    path = str(tmp_path / "scene.sens")
+    header, depths, poses = _make_sens(path, n_frames=5, dw=10, dh=8)
+
+    sd = ref_sens.SensorData(path)
+    assert sd.sensor_name.decode() == header.sensor_name
+    assert sd.depth_shift == header.depth_shift
+    assert (sd.color_width, sd.color_height) == (header.color_width,
+                                                 header.color_height)
+    assert (sd.depth_width, sd.depth_height) == (header.depth_width,
+                                                 header.depth_height)
+    assert sd.color_compression_type == "jpeg"
+    assert sd.depth_compression_type == "zlib_ushort"
+    np.testing.assert_array_equal(sd.intrinsic_depth, header.intrinsic_depth)
+    assert len(sd.frames) == 5
+    for i, frame in enumerate(sd.frames):
+        np.testing.assert_array_equal(frame.camera_to_world, poses[i])
+        depth = np.frombuffer(
+            frame.decompress_depth("zlib_ushort"), dtype=np.uint16
+        ).reshape(8, 10)
+        np.testing.assert_array_equal(depth, depths[i])
+        rgb = frame.decompress_color("jpeg")
+        assert rgb.shape == (12, 16, 3)
+
+
 # --------------------------------------------------------------- postprocess
 
 def _import_reference_postprocess():
