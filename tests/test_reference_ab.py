@@ -286,6 +286,8 @@ def test_sens_writer_parses_with_reference_sensordata(tmp_path):
 
     Only the `png` module (absent here) is stubbed; it is used by the
     reference's exporter methods, never by the parser under test."""
+    pytest.importorskip("cv2")
+    pytest.importorskip("imageio")
     if "png" not in sys.modules:
         sys.modules["png"] = types.ModuleType("png")
     ref_dir = os.path.join(REFERENCE, "preprocess", "scannet")
