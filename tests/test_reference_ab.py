@@ -79,19 +79,43 @@ def _synth_scan(rng, n=3000):
     return gt, masks, np.asarray(scores), np.asarray(classes, dtype=np.int32)
 
 
-def _write_scans(tmp_path, seeds):
+def _write_scans(tmp_path, seeds, synth=None):
+    synth = synth or _synth_scan
     gt_dir = tmp_path / "gt"
     pred_dir = tmp_path / "pred"
     gt_dir.mkdir()
     pred_dir.mkdir()
     for i, seed in enumerate(seeds):
         rng = np.random.default_rng(seed)
-        gt, masks, scores, classes = _synth_scan(rng)
+        gt, masks, scores, classes = synth(rng)
         name = f"scene{i:04d}_00"
         np.savetxt(gt_dir / f"{name}.txt", gt, fmt="%d")
         np.savez(pred_dir / f"{name}.npz", pred_masks=masks,
                  pred_score=scores, pred_classes=classes)
     return gt_dir, pred_dir
+
+
+def _assert_evaluators_agree(tmp_path, gt_dir, pred_dir, no_class):
+    """Run both evaluators on the scans in pred_dir/gt_dir and compare the
+    full result CSVs to 1e-6 (nan == nan)."""
+    from maskclustering_tpu.evaluation import evaluate_scans
+
+    names = sorted(p.name[:-4] for p in pred_dir.glob("*.npz"))
+    suffix = "_class_agnostic" if no_class else ""
+    ref_out = tmp_path / f"ref{suffix}.txt"  # pre-suffixed: the reference
+    # renames outputs lacking 'class_agnostic' in --no_class mode
+    _run_reference_evaluator(pred_dir, gt_dir, ref_out, no_class)
+    repo_out = tmp_path / "repo.txt"
+    evaluate_scans([str(pred_dir / f"{n}.npz") for n in names],
+                   [str(gt_dir / f"{n}.txt") for n in names],
+                   "scannet", no_class=no_class, output_file=str(repo_out),
+                   verbose=False)
+    ref_rows = _parse_result_csv(ref_out)
+    repo_rows = _parse_result_csv(repo_out)
+    assert len(ref_rows) == len(repo_rows)
+    for ref_row, repo_row in zip(ref_rows, repo_rows):
+        np.testing.assert_allclose(repo_row, ref_row, atol=1e-6, rtol=0,
+                                   equal_nan=True)
 
 
 def _run_reference_evaluator(pred_dir, gt_dir, out_file, no_class):
@@ -126,29 +150,44 @@ def _parse_result_csv(path):
     return rows
 
 
+def _synth_random_scan(rng, n=2500):
+    """Unstructured random scan: random instance spans and predictions with
+    random extents/scores/classes — sweeps protocol-branch combinations the
+    crafted scan doesn't enumerate."""
+    gt = np.ones(n, dtype=np.int64)  # unannotated
+    cur = 0
+    inst = 1
+    classes_pool = [3, 4, 5, 7, 99]  # 99 = void label
+    while cur < n - 100:
+        span = int(rng.integers(60, 400))
+        cls = int(classes_pool[rng.integers(0, len(classes_pool))])
+        gt[cur:cur + span] = cls * 1000 + inst
+        inst += 1
+        cur += span + int(rng.integers(0, 120))
+    cols, scores, classes = [], [], []
+    for _ in range(int(rng.integers(6, 14))):
+        a = int(rng.integers(0, n - 60))
+        b = a + int(rng.integers(40, 500))
+        m = np.zeros(n, dtype=bool)
+        m[a:min(b, n)] = True
+        cols.append(m)
+        scores.append(float(np.round(rng.random(), 2)))  # coarse -> real ties
+        classes.append(int(classes_pool[rng.integers(0, 4)]))
+    return gt, np.stack(cols, axis=1), np.asarray(scores), \
+        np.asarray(classes, dtype=np.int32)
+
+
+@pytest.mark.parametrize("no_class", [False, True])
+@pytest.mark.parametrize("seeds", [(41, 59), (71, 83, 97)])
+def test_evaluator_matches_reference_on_random_scans(tmp_path, seeds, no_class):
+    gt_dir, pred_dir = _write_scans(tmp_path, seeds, synth=_synth_random_scan)
+    _assert_evaluators_agree(tmp_path, gt_dir, pred_dir, no_class)
+
+
 @pytest.mark.parametrize("no_class", [False, True])
 def test_evaluator_matches_reference_bit_level(tmp_path, no_class):
-    from maskclustering_tpu.evaluation import evaluate_scans
-
     gt_dir, pred_dir = _write_scans(tmp_path, seeds=(11, 23))
-    names = sorted(p.name[:-4] for p in pred_dir.glob("*.npz"))
-    suffix = "_class_agnostic" if no_class else ""
-    ref_out = tmp_path / f"ref{suffix}.txt"  # name pre-suffixed: the reference
-    # renames outputs lacking 'class_agnostic' in --no_class mode
-    _run_reference_evaluator(pred_dir, gt_dir, ref_out, no_class)
-
-    repo_out = tmp_path / "repo.txt"
-    evaluate_scans([str(pred_dir / f"{n}.npz") for n in names],
-                   [str(gt_dir / f"{n}.txt") for n in names],
-                   "scannet", no_class=no_class, output_file=str(repo_out),
-                   verbose=False)
-
-    ref_rows = _parse_result_csv(ref_out)
-    repo_rows = _parse_result_csv(repo_out)
-    assert len(ref_rows) == len(repo_rows)
-    for ref_row, repo_row in zip(ref_rows, repo_rows):
-        np.testing.assert_allclose(repo_row, ref_row, atol=1e-6, rtol=0,
-                                   equal_nan=True)
+    _assert_evaluators_agree(tmp_path, gt_dir, pred_dir, no_class)
 
 
 def test_matterport_loader_matches_reference(tmp_path, monkeypatch):
