@@ -360,6 +360,63 @@ def test_sens_writer_parses_with_reference_sensordata(tmp_path):
         assert rgb.shape == (12, 16, 3)
 
 
+def test_gt_encoding_matches_reference_prepare_gt(tmp_path):
+    """GT preparation A/B: our scannet_scene_gt vs the literal reference
+    preprocess/scannet/prepare_gt.py handle_process on the same segs.json +
+    aggregation.json + label tsv — byte-identical GT txt, including the
+    invalid-label zeroing, group-id+1 instances, and overlap overwrite."""
+    pytest.importorskip("pandas")
+    import json as json_mod
+
+    import pandas as pd
+
+    ref_dir = os.path.join(REFERENCE, "preprocess", "scannet")
+    if ref_dir not in sys.path:
+        sys.path.insert(0, ref_dir)
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)  # prepare_gt imports evaluation.constants
+    import prepare_gt as ref_gt  # noqa: PLC0415
+
+    from maskclustering_tpu.preprocess.scannet import (
+        load_label_map,
+        scannet_scene_gt,
+    )
+
+    seq = "scene0042_00"
+    scene = tmp_path / "scans" / seq
+    scene.mkdir(parents=True)
+    rng = np.random.default_rng(12)
+    seg_indices = rng.integers(0, 40, size=500).tolist()
+    groups = [
+        {"id": 0, "label": "chair", "segments": [0, 1, 2, 3]},
+        {"id": 1, "label": "weird", "segments": [4, 5]},  # non-benchmark id
+        {"id": 2, "label": "nosuch", "segments": [6]},  # absent from tsv
+        {"id": 3, "label": "bed", "segments": [7, 8, 9]},
+        # overlapping segments with group 0: later group overwrites
+        {"id": 4, "label": "table", "segments": [3, 10, 11]},
+    ]
+    (scene / f"{seq}_vh_clean_2.0.010000.segs.json").write_text(
+        json_mod.dumps({"segIndices": seg_indices}))
+    (scene / f"{seq}.aggregation.json").write_text(
+        json_mod.dumps({"segGroups": groups}))
+    tsv = tmp_path / "labels.tsv"
+    tsv.write_text("id\traw_category\tcategory\n"
+                   "5\tchair\tchair\n999\tweird\tweird\n"
+                   "4\tbed\tbed\n7\ttable\ttable\n")
+
+    ref_out = tmp_path / "ref_gt"
+    ref_out.mkdir()
+    labels_pd = pd.read_csv(tsv, sep="\t", header=0)
+    ref_gt.handle_process(str(scene), str(ref_out), labels_pd)
+
+    ours = scannet_scene_gt(str(scene), str(tmp_path / "our_gt" / f"{seq}.txt"),
+                            load_label_map(str(tsv)))
+    ref_ids = np.loadtxt(ref_out / f"{seq}.txt", dtype=np.int64)
+    np.testing.assert_array_equal(ours, ref_ids)
+    # non-degenerate: several distinct encodings incl. label 0 groups
+    assert len(np.unique(ref_ids)) >= 5
+
+
 # --------------------------------------------------------------- postprocess
 
 def _import_reference_postprocess():
