@@ -62,11 +62,23 @@ class MaskTable(NamedTuple):
 
 
 def build_mask_table(mask_valid: np.ndarray, pad_multiple: int = 256) -> MaskTable:
-    """Compact (frame, id) table of valid masks from (F, K_max+1) validity."""
+    """Compact (frame, id) table of valid masks from (F, K_max+1) validity.
+
+    M_pad is a GEOMETRIC bucket of the valid-mask count (same
+    two-significant-bit ladder as the F/N pads): every (M_pad,)- and
+    (M_pad, M_pad)-shaped stage downstream (graph stats, clustering,
+    postprocess claims/assign) compiles per distinct M_pad, and with
+    linear 256-rounding nearly every real scene hit a fresh value —
+    ~25-40 s of recompile per scene in a mixed-size sweep.
+    """
+    from maskclustering_tpu.utils.compile_cache import (bucket_size,
+                                                        record_shape_bucket)
+
     mask_valid = np.asarray(mask_valid)
     f_idx, k_idx = np.nonzero(mask_valid)
     num = len(f_idx)
-    m_pad = max(pad_multiple, int(np.ceil(max(num, 1) / pad_multiple)) * pad_multiple)
+    m_pad = bucket_size(num, pad_multiple)
+    record_shape_bucket("masks", m_pad)
     frame = np.full(m_pad, mask_valid.shape[0], dtype=np.int32)
     mask_id = np.full(m_pad, -1, dtype=np.int32)
     frame[:num] = f_idx

@@ -42,19 +42,10 @@ class SceneResult(NamedTuple):
 K_MAX_CEILING = 1023
 
 
-def bucket_size(value: int, multiple: int) -> int:
-    """Geometric shape bucket: the multiple count is rounded up to two
-    significant bits (2^k or 3*2^(k-1)).
-
-    Linear rounding gives one jit bucket per `multiple` of size variance —
-    ScanNet clouds span ~80k-400k points, which would mean dozens of
-    compiles. Two-significant-bit steps waste <= 33% padded work and bound
-    the bucket count to ~2 per octave of size range.
-    """
-    m = max(1, -(-value // multiple))
-    bit = max(m.bit_length() - 2, 0)
-    m = -(-m >> bit) << bit
-    return m * multiple
+# canonical home is the compile-cache module (bounding distinct jit shapes
+# is its hit rate); re-exported here for the scripts/tests that always
+# imported it from the pipeline
+from maskclustering_tpu.utils.compile_cache import bucket_size  # noqa: E402
 
 
 def pad_scene_tensors(tensors: SceneTensors, f_pad: int, n_pad: int) -> SceneTensors:

@@ -8,7 +8,7 @@ uint16 feed, 192k points, k_max 63) and reports
 ``jax.stages.Compiled.memory_analysis()``: per-device argument / output /
 temp bytes, i.e. the HBM footprint XLA's buffer assignment plans per chip.
 
-Usage: PYTHONPATH=. python scripts/hbm_analysis.py [--frames 256] [--out -]
+Usage: python scripts/hbm_analysis.py [--frames 256] [--out -]
 """
 
 from __future__ import annotations

@@ -15,7 +15,11 @@ The reference's cost at this stage: 6.5 GPU-h / 311 scenes (README.md:205)
 ~= 75 s/scene on an RTX 3090; its per-GPU process model is the same
 scene-DP shape this projection uses (reference run.py:33-50).
 
-Usage: PYTHONPATH=. python scripts/northstar.py [--quick] [--out NORTHSTAR.md]
+Usage: python scripts/northstar.py [--quick] [--out NORTHSTAR.md]
+(the script puts the repo root on sys.path itself; do NOT override
+PYTHONPATH — on this rig it carries the TPU plugin's site dir, and
+replacing it leaves JAX_PLATFORMS=axon pointing at an unregistered
+backend)
 """
 
 from __future__ import annotations
@@ -74,7 +78,8 @@ def main():
 
     from maskclustering_tpu.config import PipelineConfig
     from maskclustering_tpu.models.pipeline import bucket_size, run_scene
-    from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
+    from maskclustering_tpu.utils.compile_cache import (seen_shape_buckets,
+                                                        setup_compilation_cache)
     from maskclustering_tpu.utils.synthetic import make_scene_device
 
     cache = setup_compilation_cache()
@@ -110,9 +115,13 @@ def main():
 
             bucket = (bucket_size(frames, cfg.frame_pad_multiple),
                       bucket_size(points, cfg.point_chunk))
-            first = bucket not in bucket_first
+            pre_buckets = seen_shape_buckets()
             t0 = time.time()
             result = run_scene(tensors, cfg, k_max=None if args.quick else 63)
+            # a scene pays compile when it lands ANY new jit shape bucket —
+            # the (F_pad, N_pad) scene bucket or the M_pad mask bucket
+            new_buckets = seen_shape_buckets() - pre_buckets
+            first = bool(new_buckets)
         except Exception as e:  # noqa: BLE001
             detail = str(e).splitlines()[0][:200] if str(e) else repr(e)
             print(f"[northstar] scene {i} FAILED ({type(e).__name__}: "
@@ -122,12 +131,14 @@ def main():
             break
         run_s = time.time() - t0
         if first:
-            bucket_first[bucket] = run_s
+            bucket_first[tuple(sorted(new_buckets))] = run_s
         n_obj = len(result.objects.point_ids_list)
         rows.append((i, frames, points, boxes, bucket, gen_s, run_s, n_obj, first))
         print(f"[northstar] scene {i}: F={frames} N={points} obj={boxes} "
-              f"bucket={bucket}{' WARM' if first else ''} gen={gen_s:.1f}s "
-              f"run={run_s:.2f}s objects={n_obj}",
+              f"bucket={bucket}"
+              + (f" WARM (new jit buckets: {sorted(new_buckets)})" if first
+                 else "")
+              + f" gen={gen_s:.1f}s run={run_s:.2f}s objects={n_obj}",
               file=sys.stderr, flush=True)
     sweep_s = time.time() - t_sweep0
     if not rows:
@@ -175,9 +186,13 @@ def main():
         "",
         "## Aggregates",
         "",
-        f"- distinct shape buckets hit: **{len(buckets)}** ({buckets})",
-        f"- per-bucket warm-up (first scene in bucket): "
-        + ", ".join(f"{b}: {bucket_first[b]:.1f}s" for b in buckets),
+        f"- distinct (F_pad, N_pad) scene buckets hit: **{len(buckets)}** "
+        f"({buckets})",
+        f"- all jit shape buckets (incl. M_pad mask buckets): "
+        f"{sorted(seen_shape_buckets())}",
+        f"- per-compile-event warm-up (scene that landed new buckets): "
+        + ", ".join(f"{list(b)}: {v:.1f}s"
+                    for b, v in bucket_first.items()),
         f"- warm-up total: **{warm_total:.1f} s** (persistent cache makes "
         "this a first-run-only cost per host)",
         f"- steady-state s/scene (median of {len(steady)} non-warm scenes): "
@@ -208,7 +223,9 @@ def main():
         f.write(out_text)
     print(out_text)
     print(json.dumps({
-        "buckets": len(buckets), "warm_total_s": round(warm_total, 1),
+        "buckets": len(buckets),
+        "jit_buckets": len(seen_shape_buckets()),
+        "warm_total_s": round(warm_total, 1),
         "steady_median_s": round(steady_median, 3),
         "proj_cold_min": round(proj_s / 60.0, 2),
         "proj_warm_min": round(proj_warm_cached / 60.0, 2),

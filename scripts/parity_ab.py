@@ -14,7 +14,7 @@ noisy synthetic RGB-D at ScanNet-like density:
 - Jaccard of per-mask claimed point sets between the paths (SURVEY.md §7
   stage 3's parity metric).
 
-Usage: PYTHONPATH=. python scripts/parity_ab.py [--points shallow,deep]
+Usage: python scripts/parity_ab.py [--points shallow,deep]
        [--out PARITY.md]
 """
 

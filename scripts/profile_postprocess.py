@@ -1,6 +1,6 @@
 """Profile postprocess_scene at bench scale (host-side; device platform irrelevant).
 
-Run from the repo root:  PYTHONPATH=. JAX_PLATFORMS=cpu python scripts/profile_postprocess.py
+Run from the repo root:  JAX_PLATFORMS=cpu python scripts/profile_postprocess.py
 """
 
 import os

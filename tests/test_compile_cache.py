@@ -41,6 +41,36 @@ def test_bucket_size_ladder():
     assert bucket_size(6 * 2048, 2048) == 6 * 2048
 
 
+def test_mask_table_pad_is_geometric():
+    """M_pad must ride the same 2-significant-bit ladder as F/N pads.
+
+    Linear 256-rounding gave nearly every real scene a fresh M_pad, so the
+    (M_pad,)/(M_pad, M_pad)-shaped stages (graph stats, clustering,
+    postprocess) recompiled per scene: 25-40 s each in the round-5
+    northstar sweep. Scenes in the same mask-count octave must share one
+    compile unit.
+    """
+    from maskclustering_tpu.models.graph import build_mask_table
+
+    def m_pad_for(num_masks):
+        mask_valid = np.zeros((num_masks, 1), dtype=bool)
+        mask_valid[:, 0] = True
+        return build_mask_table(mask_valid, pad_multiple=256).m_pad
+
+    # 125x16=2000 and 128x20=2560 masks (northstar scenes 1 vs 2) now land
+    # in adjacent ladder steps instead of per-scene fresh values
+    assert m_pad_for(2000) == 2048
+    assert m_pad_for(2560) == 3072
+    assert m_pad_for(2561) == 3072  # same bucket across the octave
+    assert m_pad_for(3072) == 3072
+    # tiny scenes still get the floor
+    assert m_pad_for(1) == 256
+    # ladder values are always multiples of the pad multiple (mesh row
+    # sharding over 8 frames relies on divisibility)
+    for n in (1, 300, 2000, 5000, 9000, 16000):
+        assert m_pad_for(n) % 256 == 0
+
+
 def test_bucket_accounting():
     reset_shape_buckets()
     assert record_shape_bucket("scene", 63, 32, 8192)
