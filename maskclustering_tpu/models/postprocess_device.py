@@ -35,11 +35,13 @@ of 2-3 (F, N) int32 tensors.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
 
 from maskclustering_tpu.models.postprocess import (
     SceneObjects,
@@ -48,6 +50,38 @@ from maskclustering_tpu.models.postprocess import (
     postprocess_scene,
 )
 from maskclustering_tpu.ops.dbscan import dbscan_labels_parallel
+
+
+class _DaemonPull:
+    """Background device->host pull on a daemon thread.
+
+    A ThreadPoolExecutor worker is joined by the interpreter at exit, so an
+    abandoned pull on a wedged device link would stall process shutdown
+    (the same reason run.py's prefetcher uses daemon threads). One pull per
+    scene -> a short-lived daemon thread per call is cheap and unjoinable.
+    """
+
+    def __init__(self, fn):
+        self._done = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+
+        def work():
+            try:
+                self._value = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised in result()
+                self._exc = e
+            finally:
+                self._done.set()
+
+        threading.Thread(target=work, daemon=True,
+                         name="postprocess-ratio-pull").start()
+
+    def result(self):
+        self._done.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._value
 
 
 def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
@@ -294,9 +328,19 @@ def postprocess_scene_device(
         first, last, jnp.asarray(rep_tab), node_visible,
         jnp.asarray(live_slots), jnp.asarray(live_valid),
         r_pad=r_pad, point_filter_threshold=float(point_filter_threshold))
-    claimed = _unpack_bits(np.asarray(claimed_p), n)
-    ratio_ok = _unpack_bits(np.asarray(ratio_p), n)
-    nv_any = np.asarray(nv_rep_d).any(axis=1)
+    # device->host transfers dominate this phase on a narrow link (the
+    # driver rig's tunnel moves ~2-3 MB/s; a TPU-VM's PCIe makes them
+    # ~free). Two cuts: pull only the len(reps) live rows of the
+    # (r_pad, N/8) planes, and pull ratio_ok — not needed until the emit
+    # phase — on a background thread overlapped with dbscan/mask_assign.
+    r_live = len(reps)
+    # quantize the row slice to multiples of 8 so the eager device slice op
+    # itself stays within a handful of compiled shapes per r_pad
+    r_pull = min(r_pad, -(-r_live // 8) * 8)
+    claimed = _unpack_bits(np.asarray(claimed_p[:r_pull]), n)
+    ratio_sliced = ratio_p[:r_pull]
+    ratio_fut = _DaemonPull(lambda: _unpack_bits(np.asarray(ratio_sliced), n))
+    nv_any = np.asarray(nv_rep_d[:r_pull])[:r_live].any(axis=1)
     t.mark("claims")
 
     # ---- DBSCAN split per live rep (host, native C++/sklearn) ----
@@ -332,6 +376,9 @@ def postprocess_scene_device(
     t.mark("dbscan")
 
     if group_offset == 0:
+        # consume the background pull so a transfer error surfaces here
+        # instead of being dropped, and the shared lane frees immediately
+        ratio_fut.result()
         return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
     s_pad = _bucket_pow2(group_offset)
     all_pts = np.concatenate(pt_chunks)
@@ -374,6 +421,7 @@ def postprocess_scene_device(
              float(cnt / group_size[gl])))
 
     # ---- emit candidate objects (same order/filters as the host path) ----
+    ratio_ok = ratio_fut.result()  # overlapped with dbscan/mask_assign
     total_point_ids: List[np.ndarray] = []
     total_bboxes: List[Tuple[np.ndarray, np.ndarray]] = []
     total_masks: List[List[Tuple]] = []
