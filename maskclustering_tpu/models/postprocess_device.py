@@ -35,7 +35,6 @@ of 2-3 (F, N) int32 tensors.
 from __future__ import annotations
 
 import functools
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -50,38 +49,7 @@ from maskclustering_tpu.models.postprocess import (
     postprocess_scene,
 )
 from maskclustering_tpu.ops.dbscan import dbscan_labels_parallel
-
-
-class _DaemonPull:
-    """Background device->host pull on a daemon thread.
-
-    A ThreadPoolExecutor worker is joined by the interpreter at exit, so an
-    abandoned pull on a wedged device link would stall process shutdown
-    (the same reason run.py's prefetcher uses daemon threads). One pull per
-    scene -> a short-lived daemon thread per call is cheap and unjoinable.
-    """
-
-    def __init__(self, fn):
-        self._done = threading.Event()
-        self._value = None
-        self._exc: Optional[BaseException] = None
-
-        def work():
-            try:
-                self._value = fn()
-            except BaseException as e:  # noqa: BLE001 — re-raised in result()
-                self._exc = e
-            finally:
-                self._done.set()
-
-        threading.Thread(target=work, daemon=True,
-                         name="postprocess-ratio-pull").start()
-
-    def result(self):
-        self._done.wait()
-        if self._exc is not None:
-            raise self._exc
-        return self._value
+from maskclustering_tpu.utils.daemon_future import DaemonFuture
 
 
 def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
@@ -159,48 +127,64 @@ def _node_stats_kernel(
     Returns (claimed_packed, ratio_packed, nv_rep): (r_pad, N8/8) uint8 x2
     plus the (r_pad, F) bool node-visibility rows for the live reps.
 
-    Each frame contributes one (2R, k2) @ (k2, N) matmul: local-id one-hots
-    of the claim extremes (with a -1 row correction so two masks of the same
-    rep claiming one cell count ONE unique (rep, point, frame) triple, like
-    the host path's sort) hit per-frame weight rows W[r, k] =
-    [rep_tab==r] (* node-visibility for the OVIR numerator). MXU work
-    replaces the (R, N) one-hot/select chain the scan used to materialize
-    per frame; bf16 one-hot operands with f32 accumulation stay exact. The
-    ratio denominator drops out of the scan entirely: one (R, F) @ (F, N)
-    matmul of node-visibility against point-visibility.
+    Frames are processed in chunks of C: each scan step contracts one
+    (2R, C*k2) @ (C*k2, N) matmul — local-id one-hots of the claim
+    extremes (with a -1 row correction so two masks of the same rep
+    claiming one cell count ONE unique (rep, point, frame) triple, like
+    the host path's sort) against per-frame weight rows W[c, r, k] =
+    [rep_tab==r] (* node-visibility for the OVIR numerator). One frame per
+    step made the contraction depth k2 (~65) — too shallow to feed the
+    128x128 systolic array — and paid F sequential steps; C frames per
+    step deepens the contraction C-fold and cuts the step count to F/C at
+    the cost of a (C, k2, N) bf16 operand window in HBM (~200 MB at
+    C=8, bench shapes). bf16 one-hot operands with f32 accumulation stay
+    exact. The ratio denominator drops out of the scan entirely: one
+    (R, F) @ (F, N) matmul of node-visibility against point-visibility.
     """
     f, n = first.shape
     k2 = rep_tab.shape[1]
     nv_rep = jnp.take(node_visible, live_slots, axis=0) & live_valid[:, None]
 
+    # largest divisor keeps (most of) the contraction depth when a caller
+    # pads F to a multiple of 4 or 2 instead of 8
+    chunk = next(c for c in (8, 4, 2, 1) if f % c == 0)
+
     def step(carry, inp):
         acc = carry
-        a, b, rt, nv_f = inp
-        # per-frame weight rows, built in-step from the scanned (k2,) rep row
-        # and (R,) nv column — negligible VPU work vs holding an (F, 2R, k2)
+        a, b, rt, nv_f = inp  # (C, N) x2, (C, k2), (C, R)
+        # per-chunk weight rows, built in-step from the scanned rep rows
+        # and nv columns — negligible VPU work vs holding an (F, 2R, k2)
         # tensor in HBM for the whole scan
-        rep_oh = jax.nn.one_hot(rt, r_pad, axis=0, dtype=jnp.bfloat16)  # (R, k2)
+        rep_oh = jax.nn.one_hot(rt, r_pad, axis=1, dtype=jnp.bfloat16)  # (C, R, k2)
         w = jnp.concatenate(
-            [rep_oh * nv_f.astype(jnp.bfloat16)[:, None], rep_oh], axis=0)
+            [rep_oh * nv_f.astype(jnp.bfloat16)[:, :, None], rep_oh],
+            axis=1)  # (C, 2R, k2)
         # id 0 = no claim and rep_tab[:, 0] is always -1 (ids are 1-based), so
         # W column 0 is zero — routing the a == b duplicate there drops it.
         # Distinct ids of one rep claiming the same cell must also count once
         # (one unique triple): detect rep_a == rep_b with a != b and subtract
         # the duplicate via a one-hot on the a id.
         b2 = jnp.where(b == a, 0, b)
-        rep_a = jnp.take(rt, a)  # (N,) dense rep index or -1
-        rep_b = jnp.take(rt, b2)
+        rep_a = jnp.take_along_axis(rt, a, axis=1)  # (C, N) dense rep or -1
+        rep_b = jnp.take_along_axis(rt, b2, axis=1)
         dup = (rep_a >= 0) & (rep_a == rep_b) & (a != b2)
-        oh_a = jax.nn.one_hot(a, k2, axis=0, dtype=jnp.bfloat16)
-        oh_b = jax.nn.one_hot(b2, k2, axis=0, dtype=jnp.bfloat16)
-        oh_dup = jax.nn.one_hot(jnp.where(dup, a, 0), k2, axis=0, dtype=jnp.bfloat16)
+        oh_a = jax.nn.one_hot(a, k2, axis=1, dtype=jnp.bfloat16)  # (C, k2, N)
+        oh_b = jax.nn.one_hot(b2, k2, axis=1, dtype=jnp.bfloat16)
+        oh_dup = jax.nn.one_hot(jnp.where(dup, a, 0), k2, axis=1,
+                                dtype=jnp.bfloat16)
         m = oh_a + oh_b - oh_dup
-        acc = acc + jnp.dot(w, m, preferred_element_type=jnp.float32)
+        # sum_c w[c] @ m[c] as ONE deep contraction over (c, k2)
+        acc = acc + jax.lax.dot_general(
+            w, m, (((0, 2), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32)
         return acc, None
 
     acc, _ = jax.lax.scan(
         step, jnp.zeros((2 * r_pad, n), jnp.float32),
-        (first, last, rep_tab, nv_rep.T))
+        (first.reshape(f // chunk, chunk, n),
+         last.reshape(f // chunk, chunk, n),
+         rep_tab.reshape(f // chunk, chunk, k2),
+         nv_rep.T.reshape(f // chunk, chunk, r_pad)))
     num = acc[:r_pad]
     claimed = acc[r_pad:] > 0
 
@@ -339,7 +323,8 @@ def postprocess_scene_device(
     r_pull = min(r_pad, -(-r_live // 8) * 8)
     claimed = _unpack_bits(np.asarray(claimed_p[:r_pull]), n)
     ratio_sliced = ratio_p[:r_pull]
-    ratio_fut = _DaemonPull(lambda: _unpack_bits(np.asarray(ratio_sliced), n))
+    ratio_fut = DaemonFuture(lambda: _unpack_bits(np.asarray(ratio_sliced), n),
+                             name="postprocess-ratio-pull")
     nv_any = np.asarray(nv_rep_d[:r_pull])[:r_live].any(axis=1)
     t.mark("claims")
 

@@ -237,30 +237,15 @@ def _spawn_load(cfg: PipelineConfig, seq_name: str, resume: bool,
 
     A daemon thread — unlike a ThreadPoolExecutor worker, which the
     interpreter joins at exit — can never stall process shutdown on an
-    abandoned multi-second load (Ctrl-C mid-scene). The result or the
-    raised error travels through a single-slot queue; resolve() re-raises
+    abandoned multi-second load (Ctrl-C mid-scene). resolve() re-raises
     load errors in the caller so they attribute to the right scene.
     """
-    import queue
-    import threading
+    from maskclustering_tpu.utils.daemon_future import DaemonFuture
 
-    slot: "queue.Queue" = queue.Queue(maxsize=1)
-
-    def work():
-        try:
-            slot.put((True, _load_for_cluster(cfg, seq_name, resume, prediction_root)))
-        except BaseException as e:  # noqa: BLE001 — travels to resolve()
-            slot.put((False, e))
-
-    threading.Thread(target=work, daemon=True, name=f"prefetch-{seq_name}").start()
-
-    def resolve():
-        ok, val = slot.get()
-        if not ok:
-            raise val
-        return val
-
-    return resolve
+    fut = DaemonFuture(
+        lambda: _load_for_cluster(cfg, seq_name, resume, prediction_root),
+        name=f"prefetch-{seq_name}")
+    return fut.result
 
 
 def _prefetched_loads(cfg: PipelineConfig, seq_names: Sequence[str], resume: bool,

@@ -1,0 +1,39 @@
+"""One-shot future on a daemon thread.
+
+A daemon thread — unlike a ThreadPoolExecutor worker, which the
+interpreter joins at exit — can never stall process shutdown on an
+abandoned blocking call: a scene load mid-Ctrl-C (run.py's prefetcher) or
+a device->host pull on a wedged accelerator link (postprocess_device's
+overlapped ratio pull). The result or the raised error is re-raised in
+``result()`` so failures attribute to the consuming stage.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class DaemonFuture:
+    """Run ``fn`` on a daemon thread; ``result()`` blocks and re-raises."""
+
+    def __init__(self, fn: Callable, name: str = "daemon-future"):
+        self._done = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+
+        def work():
+            try:
+                self._value = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised in result()
+                self._exc = e
+            finally:
+                self._done.set()
+
+        threading.Thread(target=work, daemon=True, name=name).start()
+
+    def result(self):
+        self._done.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._value
