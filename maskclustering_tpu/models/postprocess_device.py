@@ -102,6 +102,15 @@ def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
     return objects
 
 
+def _frame_chunk(f: int) -> int:
+    """Frames per claims-scan step: largest divisor of F in {8,4,2,1}.
+
+    Keeps (most of) the matmul contraction depth when a caller pads F to a
+    multiple of 4 or 2 instead of 8.
+    """
+    return next(c for c in (8, 4, 2, 1) if f % c == 0)
+
+
 def _bucket_pow2(value: int, minimum: int = 8) -> int:
     """Smallest power-of-two >= max(value, minimum) — jit shape buckets."""
     b = minimum
@@ -145,9 +154,7 @@ def _node_stats_kernel(
     k2 = rep_tab.shape[1]
     nv_rep = jnp.take(node_visible, live_slots, axis=0) & live_valid[:, None]
 
-    # largest divisor keeps (most of) the contraction depth when a caller
-    # pads F to a multiple of 4 or 2 instead of 8
-    chunk = next(c for c in (8, 4, 2, 1) if f % c == 0)
+    chunk = _frame_chunk(f)
 
     def step(carry, inp):
         acc = carry

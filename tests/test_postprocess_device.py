@@ -49,6 +49,35 @@ def test_device_matches_host_postprocess(seed, num_boxes):
     assert oh.mask_list == od.mask_list
 
 
+@pytest.mark.parametrize("num_frames,fpm,expect_chunk", [
+    (3, 1, 1),   # F_pad 3 -> odd, chunk falls to 1
+    (6, 1, 2),   # F_pad 6 -> chunk 2
+    (12, 4, 4),  # F_pad 12 -> chunk 4
+])
+def test_device_postprocess_chunk_fallbacks(num_frames, fpm, expect_chunk):
+    """Byte-identity must hold on every frame-chunk divisor of the claims
+    scan (8/4/2/1), not just the default-padded chunk=8 path."""
+    from maskclustering_tpu.models.pipeline import bucket_size
+    from maskclustering_tpu.models.postprocess_device import _frame_chunk
+
+    f_pad = bucket_size(num_frames, fpm)
+    assert _frame_chunk(f_pad) == expect_chunk
+
+    scene = make_scene(num_boxes=3, num_frames=num_frames, seed=11)
+    tensors = to_scene_tensors(scene)
+    res_host = run_scene(
+        tensors, _config(device_postprocess=False, frame_pad_multiple=fpm),
+        k_max=15)
+    res_dev = run_scene(
+        tensors, _config(device_postprocess=True, frame_pad_multiple=fpm),
+        k_max=15)
+    assert len(res_host.objects.point_ids_list) == len(res_dev.objects.point_ids_list)
+    for ph, pd in zip(res_host.objects.point_ids_list,
+                      res_dev.objects.point_ids_list):
+        np.testing.assert_array_equal(ph, pd)
+    assert res_host.objects.mask_list == res_dev.objects.mask_list
+
+
 def test_device_postprocess_empty_scene():
     """A scene with no recoverable masks yields an empty object list."""
     scene = make_scene(num_boxes=2, num_frames=4, seed=3)
