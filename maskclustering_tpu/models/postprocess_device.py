@@ -298,7 +298,15 @@ def postprocess_scene_device(
     if len(reps) == 0:
         t.mark("claims")
         return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
-    r_pad = _bucket_pow2(len(reps))
+    # floor 64: 2*r_pad = 128 exactly fills the MXU's systolic dimension, so
+    # padding small scenes up is compute-free — and it collapses the
+    # {8,16,32,64} r_pad compile variants (northstar's "scene 8" paid a
+    # hidden ~10 s _node_stats_kernel compile for being the first 32-rep
+    # scene) into one
+    r_pad = _bucket_pow2(len(reps), minimum=64)
+    from maskclustering_tpu.utils.compile_cache import record_shape_bucket
+
+    record_shape_bucket("post.nodestats", r_pad, m_pad, f, n, k2)
     rep_lut = np.full(m_pad, -1, dtype=np.int32)
     rep_lut[reps] = np.arange(len(reps), dtype=np.int32)
 
@@ -372,11 +380,15 @@ def postprocess_scene_device(
         # instead of being dropped, and the shared lane frees immediately
         ratio_fut.result()
         return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
-    s_pad = _bucket_pow2(group_offset)
+    # floor 128: the group-counts matmul's output width rides MXU lanes, so
+    # widths below 128 waste lanes — and small-scene s_pad compile variants
+    # ({32, 64, ...}) collapse into one
+    s_pad = _bucket_pow2(group_offset, minimum=128)
     all_pts = np.concatenate(pt_chunks)
     all_grps = np.concatenate(grp_chunks)
     group_size = np.bincount(all_grps, minlength=s_pad)
     c_pad = _bucket_pow2(len(all_pts), minimum=1024)
+    record_shape_bucket("post.groupcounts", s_pad, c_pad, m_pad, f, n, k2)
     pt_ids = np.full(c_pad, n, dtype=np.int32)  # sentinel n -> dropped scatter
     pt_grp = np.full(c_pad, s_pad, dtype=np.int32)
     pt_ids[: len(all_pts)] = all_pts
