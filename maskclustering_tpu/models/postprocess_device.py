@@ -49,7 +49,6 @@ from maskclustering_tpu.models.postprocess import (
     postprocess_scene,
 )
 from maskclustering_tpu.ops.dbscan import dbscan_labels_parallel
-from maskclustering_tpu.utils.daemon_future import DaemonFuture
 
 
 def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
@@ -330,16 +329,22 @@ def postprocess_scene_device(
     # device->host transfers dominate this phase on a narrow link (the
     # driver rig's tunnel moves ~2-3 MB/s; a TPU-VM's PCIe makes them
     # ~free). Two cuts: pull only the len(reps) live rows of the
-    # (r_pad, N/8) planes, and pull ratio_ok — not needed until the emit
-    # phase — on a background thread overlapped with dbscan/mask_assign.
+    # (r_pad, N/8) planes, and start the ratio plane's DMA now — it isn't
+    # consumed until the emit phase, so the copy rides the link while
+    # dbscan/mask_assign run on the host. copy_to_host_async (not a helper
+    # thread calling np.asarray: the blocking device_get holds the GIL on
+    # this backend, so a threaded "overlap" serialized the dbscan stage's
+    # Python loops — post.dbscan 0.11 -> 2.0 s measured on the driver rig).
     r_live = len(reps)
     # quantize the row slice to multiples of 8 so the eager device slice op
     # itself stays within a handful of compiled shapes per r_pad
     r_pull = min(r_pad, -(-r_live // 8) * 8)
     claimed = _unpack_bits(np.asarray(claimed_p[:r_pull]), n)
     ratio_sliced = ratio_p[:r_pull]
-    ratio_fut = DaemonFuture(lambda: _unpack_bits(np.asarray(ratio_sliced), n),
-                             name="postprocess-ratio-pull")
+    try:
+        ratio_sliced.copy_to_host_async()
+    except AttributeError:  # backend without async host copies
+        pass
     nv_any = np.asarray(nv_rep_d[:r_pull])[:r_live].any(axis=1)
     t.mark("claims")
 
@@ -376,9 +381,9 @@ def postprocess_scene_device(
     t.mark("dbscan")
 
     if group_offset == 0:
-        # consume the background pull so a transfer error surfaces here
-        # instead of being dropped, and the shared lane frees immediately
-        ratio_fut.result()
+        # materialize the in-flight ratio copy so a transfer error surfaces
+        # here instead of being dropped with the unconsumed buffer
+        np.asarray(ratio_sliced)
         return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
     # floor 128: the group-counts matmul's output width rides MXU lanes, so
     # widths below 128 waste lanes — and small-scene s_pad compile variants
@@ -425,7 +430,9 @@ def postprocess_scene_device(
              float(cnt / group_size[gl])))
 
     # ---- emit candidate objects (same order/filters as the host path) ----
-    ratio_ok = ratio_fut.result()  # overlapped with dbscan/mask_assign
+    # the async host copy started after the claims pull is resident (or
+    # nearly so) by now; this materializes it without re-transfer
+    ratio_ok = _unpack_bits(np.asarray(ratio_sliced), n)
     total_point_ids: List[np.ndarray] = []
     total_bboxes: List[Tuple[np.ndarray, np.ndarray]] = []
     total_masks: List[List[Tuple]] = []

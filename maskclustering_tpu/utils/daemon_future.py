@@ -2,10 +2,13 @@
 
 A daemon thread — unlike a ThreadPoolExecutor worker, which the
 interpreter joins at exit — can never stall process shutdown on an
-abandoned blocking call: a scene load mid-Ctrl-C (run.py's prefetcher) or
-a device->host pull on a wedged accelerator link (postprocess_device's
-overlapped ratio pull). The result or the raised error is re-raised in
-``result()`` so failures attribute to the consuming stage.
+abandoned blocking call, e.g. a scene load mid-Ctrl-C (run.py's
+prefetcher). Note: NOT for device->host pulls — ``np.asarray`` on a
+device array holds the GIL for the transfer on this backend, so a
+threaded pull serializes host compute instead of overlapping it; use
+``jax.Array.copy_to_host_async()`` for that (see PROFILE.md, round 5).
+The result or the raised error is re-raised in ``result()`` so failures
+attribute to the consuming stage.
 """
 
 from __future__ import annotations
