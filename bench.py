@@ -21,7 +21,9 @@ Chip-contention hardening: a wedged/busy TPU makes backend init hang with no
 exception, and a hung client can only be abandoned by killing the process.
 So the default entrypoint is a thin PARENT that runs the real bench as a
 fresh subprocess (--worker) and, when the worker dies in backend init
-(exit 2/3), retries with a new process and exponential backoff — up to
+(exit 2/3) OR hangs after init without ever emitting its JSON line (the
+wedge can also land between a fast init and the first device op), retries
+with a new process and exponential backoff — up to
 --init-attempts tries within a --retry-budget wall-clock budget. Exactly one
 JSON line still reaches stdout: the parent swallows failed workers' lines and
 forwards only the final one, annotated with "attempts". (BENCH_r04 was lost
@@ -121,6 +123,16 @@ def _init_backend(args):
     # one-JSON-line stdout contract.
     if os.environ.get("MCT_BENCH_SUPERVISED"):
         print(_INIT_OK_SENTINEL, flush=True)
+    hang_flag = os.environ.get("MCT_BENCH_TEST_HANG_AFTER_INIT")
+    if hang_flag and not os.path.exists(hang_flag):
+        # test knob: simulate the observed wedge mode where init answers in
+        # seconds and the first device op then stalls indefinitely. The
+        # value is a flag-file path so only the FIRST worker hangs — the
+        # retry then proceeds, mirroring a wedge that cleared.
+        with open(hang_flag, "w"):
+            pass
+        while True:
+            time.sleep(3600)
     return devices
 
 
@@ -182,19 +194,24 @@ def _build_parser():
                    help="max fresh-subprocess attempts when backend init fails")
     p.add_argument("--retry-budget", type=float, default=1500.0,
                    help="total wall-clock budget (s) across init retries")
-    p.add_argument("--worker-timeout", type=float, default=3600.0,
+    p.add_argument("--worker-timeout", type=float, default=900.0,
                    help="post-init run allowance (s) before the supervisor "
-                        "kills a worker outright (GIL-proof hang backstop)")
+                        "kills a worker outright (GIL-proof hang backstop); "
+                        "worst legitimate cold run is ~250s, so 900 leaves "
+                        "budget for a fresh attempt after a post-init wedge")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the timed repeats")
     return p
 
 
 def _supervise(args):
-    """Run the bench as fresh --worker subprocesses until init succeeds.
+    """Run the bench as fresh --worker subprocesses until one delivers.
 
-    Retries ONLY init-phase deaths (exit 2/3): once the backend is up the
-    worker owns the result, success or failure. Worker stderr streams
+    Retries the chip-wedge classes only: init-phase deaths (exit 2/3, or a
+    signal death before the INIT_OK sentinel) and a post-init hang that
+    never emitted a JSON line (init can answer in seconds and the first
+    device op still stall). A worker that emitted its JSON line — success,
+    partial, or in-run error — owns the verdict. Worker stderr streams
     through; worker stdout (the JSON line) is captured so exactly one line
     reaches our stdout.
     """
@@ -266,20 +283,27 @@ def _supervise(args):
         init_ok = init_ok_evt.is_set()  # re-read: drain may have caught up
         if killed:
             # a GIL-wedged init is the retryable class (rc 3, like the
-            # in-worker watchdog); a post-init hang belongs to the worker
+            # in-worker watchdog)
             rc = 3 if not init_ok else 1
         last_line = out[-1] if out else None
-        # Retryable = init-phase deaths only: the explicit init rcs, plus a
-        # signal death (negative rc, e.g. libtpu SIGABRT on a wedged chip)
-        # BEFORE the init-ok sentinel — a post-init signal death (e.g. OOM
-        # during the run) belongs to the worker and is terminal.
-        retryable = rc in _INIT_FAILED_RCS or (rc < 0 and not init_ok)
+        # Retryable = chip-wedge deaths: the explicit init rcs, a signal
+        # death (negative rc, e.g. libtpu SIGABRT on a wedged chip) BEFORE
+        # the init-ok sentinel, or a post-init hang that produced NO JSON
+        # line — the observed wedge mode where init answers in seconds and
+        # the first device op then stalls indefinitely (PROFILE.md round 5).
+        # A post-init signal death or a worker that emitted its JSON line
+        # (even a failure line) is terminal: the backend came up and the
+        # verdict — success, partial, or in-run error — is the worker's.
+        post_init_hang = killed and init_ok and last_line is None
+        retryable = (rc in _INIT_FAILED_RCS or (rc < 0 and not init_ok)
+                     or post_init_hang)
         if not retryable:
             break  # backend came up (or a permanent failure): verdict is final
         remaining = args.retry_budget - (time.time() - t_start)
         if attempt >= args.init_attempts or remaining <= 0:
-            print("[bench] giving up: backend never initialized "
-                  f"({attempt} attempts, {time.time()-t_start:.0f}s)",
+            print("[bench] giving up: chip never delivered a result "
+                  f"({attempt} attempts, {time.time()-t_start:.0f}s; "
+                  f"last failure: {'post-init hang' if post_init_hang else 'backend init'})",
                   file=sys.stderr, flush=True)
             break
         backoff = min(20.0 * attempt, 120.0) * _backoff_scale()
@@ -288,7 +312,7 @@ def _supervise(args):
             print(f"[bench] giving up: {remaining:.0f}s of budget left "
                   f"< {backoff:.0f}s backoff", file=sys.stderr, flush=True)
             break
-        print(f"[bench] backend init failed (rc={rc}); "
+        print(f"[bench] {'post-init hang' if post_init_hang else f'backend init failed (rc={rc})'}; "
               f"retrying in {backoff:.0f}s with a fresh process",
               file=sys.stderr, flush=True)
         time.sleep(backoff)

@@ -61,3 +61,25 @@ def test_direct_worker_keeps_one_line_contract():
     d = json.loads(out_lines[0])
     assert d["value"] is not None
     assert "attempts" not in d  # supervisor-only annotation
+
+
+def test_supervisor_retries_post_init_hang(tmp_path):
+    # init succeeds, then the worker wedges before producing any JSON (the
+    # chip-wedge mode PROFILE.md round 5 observed: devices() answers in
+    # seconds, the first device op stalls). The supervisor must kill the
+    # worker at --worker-timeout and retry; the flag file makes the second
+    # worker healthy, so the final line is a real result with attempts=2.
+    env = dict(os.environ, MCT_BENCH_BACKOFF_SCALE="0.05",
+               MCT_BENCH_TEST_HANG_AFTER_INIT=str(tmp_path / "hung-once"))
+    env.pop("MCT_BENCH_SUPERVISED", None)
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--platform", "cpu", "--worker-timeout", "30",
+         "--init-timeout", "60"] + TINY,
+        env=env, capture_output=True, timeout=420, cwd=REPO_ROOT)
+    out_lines = proc.stdout.decode().strip().splitlines()
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert len(out_lines) == 1, out_lines
+    d = json.loads(out_lines[0])
+    assert d["value"] is not None
+    assert d["attempts"] == 2
+    assert "post-init run allowance" in proc.stderr.decode()
