@@ -94,6 +94,12 @@ def main():
     rows = []  # (scene_idx, frames, points, boxes, bucket, gen_s, run_s, objects)
     bucket_first: dict = {}
     truncated = False
+    # per-scene flush: an external kill (timeout(1), driver, Ctrl-C) during
+    # a chip wedge must not lose the scenes already measured — the sweep's
+    # exception handler can't see a hang that never raises
+    partial_path = args.out + ".partial.jsonl"
+    with open(partial_path, "w"):
+        pass
     for i, (frames, points, boxes) in enumerate(specs):
         # the whole body touches the accelerator (make_scene_device renders
         # frames with a jitted ray tracer): a mid-sweep chip stall anywhere
@@ -134,6 +140,14 @@ def main():
             bucket_first[tuple(sorted(new_buckets))] = run_s
         n_obj = len(result.objects.point_ids_list)
         rows.append((i, frames, points, boxes, bucket, gen_s, run_s, n_obj, first))
+        with open(partial_path, "a") as f:
+            f.write(json.dumps({
+                "scene": i, "frames": frames, "points": points,
+                "objects": boxes, "bucket": list(bucket),
+                "gen_s": round(gen_s, 2), "run_s": round(run_s, 2),
+                "found": n_obj, "warm": first,
+                "new_buckets": sorted(map(list, new_buckets))}) + "\n")
+            f.flush()
         print(f"[northstar] scene {i}: F={frames} N={points} obj={boxes} "
               f"bucket={bucket}"
               + (f" WARM (new jit buckets: {sorted(new_buckets)})" if first
