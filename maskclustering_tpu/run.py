@@ -64,6 +64,9 @@ class SceneStatus:
     seconds: float = 0.0
     error: str = ""
     num_objects: int = -1
+    # per-stage wall seconds (associate/graph/cluster/postprocess + post.*),
+    # same keys the bench reports — production triage without a re-run
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -224,7 +227,9 @@ def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
                            object_dict_dir=ds.object_dict_dir,
                            prediction_root=prediction_root)
         return SceneStatus(seq_name, "ok", time.perf_counter() - t0,
-                           num_objects=len(result.objects.point_ids_list))
+                           num_objects=len(result.objects.point_ids_list),
+                           timings={k: round(v, 4)
+                                    for k, v in result.timings.items()})
     except Exception:
         log.exception("scene %s failed", seq_name)
         return SceneStatus(seq_name, "failed", time.perf_counter() - t0,
