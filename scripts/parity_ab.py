@@ -79,6 +79,9 @@ def jaccard_stats(sets_a, sets_b):
 OPERATING_POINTS = {
     "shallow": dict(scenes=3, frames=16, boxes=4, k_max=15),
     "deep": dict(scenes=2, frames=64, boxes=16, k_max=31),
+    # half a real ScanNet scene's schedule depth at the honest mask budget;
+    # CPU-hours heavy — run on demand, not in the default pair
+    "full": dict(scenes=1, frames=128, boxes=24, k_max=63),
 }
 
 
