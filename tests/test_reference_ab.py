@@ -657,12 +657,25 @@ def _import_reference_graph():
     return ref_node, ref_ic
 
 
-def _reference_partition(visible, contained, schedule, threshold):
-    """Run the literal reference clustering loop -> set of frozen mask-id sets."""
-    ref_node, ref_ic = _import_reference_graph()
+import contextlib
+
+
+@contextlib.contextmanager
+def _no_cuda():
+    """Make torch.Tensor.cuda a placement no-op (no GPU here; every op the
+    reference runs under it is device-agnostic)."""
     orig_cuda = torch.Tensor.cuda
     torch.Tensor.cuda = lambda self, *a, **k: self
     try:
+        yield
+    finally:
+        torch.Tensor.cuda = orig_cuda
+
+
+def _reference_partition(visible, contained, schedule, threshold):
+    """Run the literal reference clustering loop -> set of frozen mask-id sets."""
+    ref_node, ref_ic = _import_reference_graph()
+    with _no_cuda():
         nodes = [
             ref_node.Node([i], torch.tensor(visible[i], dtype=torch.float32),
                           torch.tensor(contained[i], dtype=torch.float32),
@@ -671,8 +684,6 @@ def _reference_partition(visible, contained, schedule, threshold):
         ]
         out = ref_ic.iterative_clustering(nodes, list(schedule), threshold,
                                           debug=False)
-    finally:
-        torch.Tensor.cuda = orig_cuda
     return {frozenset(n.mask_list) for n in out}
 
 
@@ -880,3 +891,144 @@ def test_clustering_matches_reference_on_hub_structure():
     ref_parts = _reference_partition(visible, contained, schedule, 0.7)
     repo_parts = _repo_partition(visible, contained, schedule, 0.7)
     assert repo_parts == ref_parts
+
+
+# ---------------------------------------------------------------- graph stats
+
+def _synth_mask_scene(rng, n_points, n_frames, max_masks=5):
+    """Reference-convention point-in-mask inputs with genuine overlaps.
+
+    Replays build_point_in_mask_matrix's zeroing semantics (reference
+    graph/construction.py:55-64): points hit by >= 2 masks of one frame
+    become that frame's boundary (matrix entry zeroed, point added to the
+    GLOBAL boundary set), while mask_point_clouds keeps the full original
+    point sets — process_one_mask subtracts the global boundary itself."""
+    point_in_mask = np.zeros((n_points, n_frames), dtype=np.uint16)
+    boundary = set()
+    mask_point_clouds = {}
+    frame_list = [f"{j:05d}" for j in range(n_frames)]
+    global_list = []
+    for j in range(n_frames):
+        appeared: set = set()
+        frame_boundary: set = set()
+        for mid in range(1, int(rng.integers(1, max_masks + 1)) + 1):
+            size = int(rng.integers(8, max(9, n_points // 6)))
+            pts = {int(p) for p in rng.choice(n_points, size=size, replace=False)}
+            frame_boundary |= pts & appeared
+            mask_point_clouds[f"{frame_list[j]}_{mid}"] = set(pts)
+            point_in_mask[list(pts), j] = mid
+            appeared |= pts
+            global_list.append((frame_list[j], mid))
+        point_in_mask[list(frame_boundary), j] = 0
+        boundary |= frame_boundary
+    return frame_list, global_list, point_in_mask, boundary, mask_point_clouds
+
+
+def _reference_process_masks(frame_list, global_list, point_in_mask, boundary,
+                             mask_point_clouds):
+    ref_con = _import_reference_construction()
+    args = types.SimpleNamespace(debug=False, mask_visible_threshold=0.3,
+                                 contained_threshold=0.8,
+                                 undersegment_filter_threshold=0.3)
+    with _no_cuda():
+        visible, contained, under = ref_con.process_masks(
+            frame_list, list(global_list), point_in_mask, set(boundary),
+            mask_point_clouds, args)
+    return (visible.numpy().astype(bool), contained.numpy().astype(bool),
+            sorted(under))
+
+
+def _repo_graph_stats(frame_list, global_list, point_in_mask, boundary,
+                      k_max=8):
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.models.graph import compute_graph_stats
+
+    n_points, n_frames = point_in_mask.shape
+    m = len(global_list)
+    frame_index = {fid: j for j, fid in enumerate(frame_list)}
+    # compute_graph_stats requires columns sorted by (frame, id); the
+    # reference's global list is built frame-major with ascending local ids,
+    # so the orders coincide — assert rather than remap
+    keys = [(frame_index[fid], mid) for fid, mid in global_list]
+    assert keys == sorted(keys)
+    # pad with the production sentinels (build_mask_table: frame=F, id=-1 —
+    # an id no point can carry, so padding columns of c are exactly zero)
+    m_pad = -(-m // 8) * 8
+    mask_frame = np.full(m_pad, n_frames, dtype=np.int32)
+    mask_id = np.full(m_pad, -1, dtype=np.int32)
+    mask_frame[:m] = [k[0] for k in keys]
+    mask_id[:m] = [k[1] for k in keys]
+    mask_active = np.zeros(m_pad, dtype=bool)
+    mask_active[:m] = True
+    bnd = np.zeros(n_points, dtype=bool)
+    bnd[list(boundary)] = True
+    stats = compute_graph_stats(
+        jnp.asarray(point_in_mask.T.astype(np.int32)), jnp.asarray(bnd),
+        jnp.asarray(mask_frame), jnp.asarray(mask_id),
+        jnp.asarray(mask_active), k_max=k_max, point_chunk=1024)
+    visible = np.asarray(stats.visible)
+    contained = np.asarray(stats.contained)
+    under = np.asarray(stats.undersegment)
+    # padding columns/rows must stay inert
+    assert not visible[m:].any() and not contained[m:].any() \
+        and not contained[:, m:].any() and not under[m:].any()
+    return visible[:m], contained[:m, :m], sorted(np.flatnonzero(under[:m]).tolist())
+
+
+@pytest.mark.parametrize("seed,n_points,n_frames", [
+    (5, 1500, 12), (23, 3000, 30), (41, 800, 6),
+])
+def test_graph_stats_match_reference_process_masks(seed, n_points, n_frames):
+    """compute_graph_stats (models/graph.py) vs the literal reference
+    process_masks (graph/construction.py:103-171) on shared point-in-mask
+    tensors: identical visible/contained matrices (post undersegment-undo)
+    and identical undersegment verdicts, including the boundary-point
+    subtraction and the lowest-id argmax tie-break."""
+    rng = np.random.default_rng(seed)
+    scene = _synth_mask_scene(rng, n_points, n_frames)
+    ref_vis, ref_con_m, ref_under = _reference_process_masks(*scene)
+    our_vis, our_con, our_under = _repo_graph_stats(*scene[:4])
+    assert our_under == ref_under
+    np.testing.assert_array_equal(our_vis, ref_vis)
+    np.testing.assert_array_equal(our_con, ref_con_m)
+
+
+def test_graph_stats_big_mask_and_all_boundary_edges():
+    """Two crafted edge cases through both implementations: the >= 500
+    visible-point override (reference process_one_mask's `< 500` clause
+    admits a big mask whose visible ratio is below the threshold) and a
+    fully-boundary mask pair (zero valid points -> undersegmented)."""
+    n_points, n_frames = 6000, 4
+    point_in_mask = np.zeros((n_points, n_frames), dtype=np.uint16)
+    frame_list = [f"{j:05d}" for j in range(n_frames)]
+    # frame 0: one giant mask (2000 pts)
+    point_in_mask[:2000, 0] = 1
+    # frame 1: covers 550 of the giant mask's points: ratio 0.275 < 0.3 but
+    # 550 >= 500 -> visible via the big-mask clause
+    point_in_mask[:550, 1] = 1
+    # frame 2: two identical masks -> every point is frame-2 boundary
+    dup = set(range(2500, 2600))
+    point_in_mask[2500:2600, 2] = 0  # zeroed by the boundary rule
+    # frame 3: a clean small mask, disjoint from the boundary points
+    point_in_mask[3000:3200, 3] = 1
+    boundary = set(dup)
+    mask_point_clouds = {
+        "00000_1": set(range(2000)),
+        "00001_1": set(range(550)),
+        "00002_1": set(dup),
+        "00002_2": set(dup),
+        "00003_1": set(range(3000, 3200)),
+    }
+    global_list = [("00000", 1), ("00001", 1), ("00002", 1), ("00002", 2),
+                   ("00003", 1)]
+    scene = (frame_list, global_list, point_in_mask, boundary,
+             mask_point_clouds)
+    ref_vis, ref_con_m, ref_under = _reference_process_masks(*scene)
+    our_vis, our_con, our_under = _repo_graph_stats(*scene[:4])
+    assert our_under == ref_under
+    assert 2 in ref_under and 3 in ref_under  # the all-boundary pair
+    assert ref_vis[0, 1]  # the big-mask clause fired in the reference...
+    assert our_vis[0, 1]  # ...and in the repo path
+    np.testing.assert_array_equal(our_vis, ref_vis)
+    np.testing.assert_array_equal(our_con, ref_con_m)
