@@ -348,7 +348,8 @@ def main():
 
     from maskclustering_tpu.config import PipelineConfig
     from maskclustering_tpu.models.pipeline import run_scene
-    from maskclustering_tpu.utils.synthetic import make_scene_device
+    from maskclustering_tpu.utils.synthetic import (make_scene_device,
+                                                    resize_scene_points)
 
     print(f"[bench] generating synthetic scene: F={args.frames} "
           f"N={args.points} boxes={args.boxes} {args.image_h}x{args.image_w} "
@@ -358,14 +359,8 @@ def main():
     tensors, _, _ = make_scene_device(
         num_boxes=args.boxes, num_frames=args.frames,
         image_hw=(args.image_h, args.image_w), spacing=args.spacing, seed=0)
-    # pad/trim the cloud to the requested static size (tile = harmless dups)
-    pts = tensors.scene_points
-    n = args.points
-    if pts.shape[0] < n:
-        pts = np.tile(pts, (-(-n // pts.shape[0]), 1))[:n]
-    else:
-        pts = pts[np.random.default_rng(0).choice(pts.shape[0], n, replace=False)]
-    tensors.scene_points = np.ascontiguousarray(pts, dtype=np.float32)
+    tensors.scene_points = resize_scene_points(tensors.scene_points,
+                                               args.points)
     print(f"[bench] scene ready in {time.time()-t0:.1f}s "
           f"(frames rendered in HBM)", file=sys.stderr, flush=True)
 

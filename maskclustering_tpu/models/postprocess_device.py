@@ -118,6 +118,47 @@ def _bucket_pow2(value: int, minimum: int = 8) -> int:
     return b
 
 
+def _live_rep_prep(mask_frame, mask_id, mask_active, assignment, f, k2,
+                   min_masks_per_object):
+    """Host prep for `_node_stats_kernel`: live reps + claim routing table.
+
+    Shared with scripts/claims_diag.py so the diagnostic always times the
+    exact shapes the pipeline runs. Returns None when no cluster reaches
+    ``min_masks_per_object`` members, else
+    ``(reps, r_pad, rep_lut, rep_tab, live_slots, live_valid, r_pull)``.
+    """
+    m_pad = mask_frame.shape[0]
+    sizes = np.bincount(assignment[mask_active], minlength=m_pad)
+    reps = np.nonzero(sizes >= min_masks_per_object)[0]
+    if len(reps) == 0:
+        return None
+    # floor 64: 2*r_pad = 128 exactly fills the MXU's systolic dimension, so
+    # padding small scenes up is compute-free — and it collapses the
+    # {8,16,32,64} r_pad compile variants (northstar's "scene 8" paid a
+    # hidden ~10 s _node_stats_kernel compile for being the first 32-rep
+    # scene) into one
+    r_pad = _bucket_pow2(len(reps), minimum=64)
+    rep_lut = np.full(m_pad, -1, dtype=np.int32)
+    rep_lut[reps] = np.arange(len(reps), dtype=np.int32)
+
+    # local (frame, id) -> dense live-rep index of the claiming mask's cluster
+    gmap = np.full((f, k2), -1, dtype=np.int64)
+    act_idx = np.nonzero(mask_active)[0]
+    gmap[mask_frame[act_idx], mask_id[act_idx]] = act_idx
+    rep_tab = np.full((f, k2), -1, dtype=np.int32)
+    mapped = gmap >= 0
+    rep_tab[mapped] = rep_lut[assignment[gmap[mapped]]]
+
+    live_slots = np.zeros(r_pad, dtype=np.int32)
+    live_slots[: len(reps)] = reps
+    live_valid = np.zeros(r_pad, dtype=bool)
+    live_valid[: len(reps)] = True
+    # quantize the row slice to multiples of 8 so the eager device slice op
+    # itself stays within a handful of compiled shapes per r_pad
+    r_pull = min(r_pad, -(-len(reps) // 8) * 8)
+    return reps, r_pad, rep_lut, rep_tab, live_slots, live_valid, r_pull
+
+
 @functools.partial(jax.jit, static_argnames=("r_pad", "point_filter_threshold"))
 def _node_stats_kernel(
     first: jnp.ndarray,  # (F, N) int32 smallest valid claiming id per (frame, point)
@@ -291,36 +332,15 @@ def postprocess_scene_device(
     m_pad = mask_frame.shape[0]
     k2 = k_max + 2
 
-    # ---- live representatives (>= min_masks members) ----
-    sizes = np.bincount(assignment[mask_active], minlength=m_pad)
-    reps = np.nonzero(sizes >= min_masks_per_object)[0]
-    if len(reps) == 0:
+    prep = _live_rep_prep(mask_frame, mask_id, mask_active, assignment,
+                          f, k2, min_masks_per_object)
+    if prep is None:
         t.mark("claims")
         return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
-    # floor 64: 2*r_pad = 128 exactly fills the MXU's systolic dimension, so
-    # padding small scenes up is compute-free — and it collapses the
-    # {8,16,32,64} r_pad compile variants (northstar's "scene 8" paid a
-    # hidden ~10 s _node_stats_kernel compile for being the first 32-rep
-    # scene) into one
-    r_pad = _bucket_pow2(len(reps), minimum=64)
+    reps, r_pad, rep_lut, rep_tab, live_slots, live_valid, r_pull = prep
     from maskclustering_tpu.utils.compile_cache import record_shape_bucket
 
     record_shape_bucket("post.nodestats", r_pad, m_pad, f, n, k2)
-    rep_lut = np.full(m_pad, -1, dtype=np.int32)
-    rep_lut[reps] = np.arange(len(reps), dtype=np.int32)
-
-    # local (frame, id) -> dense live-rep index of the claiming mask's cluster
-    gmap = np.full((f, k2), -1, dtype=np.int64)
-    act_idx = np.nonzero(mask_active)[0]
-    gmap[mask_frame[act_idx], mask_id[act_idx]] = act_idx
-    rep_tab = np.full((f, k2), -1, dtype=np.int32)
-    mapped = gmap >= 0
-    rep_tab[mapped] = rep_lut[assignment[gmap[mapped]]]
-
-    live_slots = np.zeros(r_pad, dtype=np.int32)
-    live_slots[: len(reps)] = reps
-    live_valid = np.zeros(r_pad, dtype=bool)
-    live_valid[: len(reps)] = True
 
     claimed_p, ratio_p, nv_rep_d = _node_stats_kernel(
         first, last, jnp.asarray(rep_tab), node_visible,
@@ -336,9 +356,6 @@ def postprocess_scene_device(
     # this backend, so a threaded "overlap" serialized the dbscan stage's
     # Python loops — post.dbscan 0.11 -> 2.0 s measured on the driver rig).
     r_live = len(reps)
-    # quantize the row slice to multiples of 8 so the eager device slice op
-    # itself stays within a handful of compiled shapes per r_pad
-    r_pull = min(r_pad, -(-r_live // 8) * 8)
     claimed = _unpack_bits(np.asarray(claimed_p[:r_pull]), n)
     ratio_sliced = ratio_p[:r_pull]
     try:

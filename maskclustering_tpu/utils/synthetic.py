@@ -380,6 +380,23 @@ def visibility_count(scene: SyntheticScene, tol: float = 0.03) -> np.ndarray:
     return count
 
 
+def resize_scene_points(points: np.ndarray, n: int, seed: int = 0) -> np.ndarray:
+    """Pad/trim a synthetic cloud to a static benchmark size.
+
+    Undersized clouds tile (harmless duplicate points); oversized clouds take
+    a seeded uniform subsample. Shared by every measurement script (bench,
+    northstar, mesh_bench, profile_*, claims_diag) so they all resample the
+    same way and benchmark the same cloud for a given seed.
+    """
+    if points.shape[0] < n:
+        points = np.tile(points, (-(-n // points.shape[0]), 1))[:n]
+    elif points.shape[0] > n:
+        idx = np.random.default_rng(seed).choice(points.shape[0], n,
+                                                 replace=False)
+        points = points[idx]
+    return np.ascontiguousarray(points, dtype=np.float32)
+
+
 def to_scene_tensors(scene: SyntheticScene):
     from maskclustering_tpu.datasets.base import SceneTensors
 

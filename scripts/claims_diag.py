@@ -1,0 +1,166 @@
+"""Attribute post.claims wall time: device kernel vs device->host pulls.
+
+The bench's ``post.claims`` phase (BENCH_builder_r05: ~0.97 s at the honest
+shape) spans three very different costs — the `_node_stats_kernel` dispatch
++ execution, the bit-packed claimed-plane pull, and host prep. A back-of-
+envelope HBM/FLOP floor for the kernel is tens of ms, so if the phase is
+~1 s the money is either in a fusion failure (visible to a profiler) or in
+the driver rig's ~MB/s tunnel (invisible to one). This script separates
+them on the live chip in one run:
+
+    python scripts/claims_diag.py [--frames 250 --points 196608 --boxes 36]
+
+It replays bench.py's scene through associate -> graph -> cluster, then
+times, over 5 repeats each:
+  kernel        `_node_stats_kernel` with a 1-element sync (device time)
+  pull_claimed  np.asarray of the (r_pull, N/8) claimed plane
+  pull_ratio    np.asarray of the ratio plane (what copy_to_host_async hides)
+  pull_calib    np.asarray of a fresh device buffer of the same byte size
+                (pure tunnel rate at that transfer size, for comparison)
+
+Interpretation: if kernel >> floor, capture a trace (bench --profile-dir)
+and look at the one-hot/dot fusion; if pull_* ~ pull_calib dominates, the
+phase is tunnel-bound — a rig artifact that PCIe on a real TPU-VM removes.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _sync(x):
+    np.asarray(x.ravel()[:1])
+
+
+def timeit(name, fn, iters=5):
+    fn()  # warm (compile / first dispatch)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    print(f"  {name:14s} {med*1e3:9.1f} ms  (runs: "
+          + " ".join(f"{t*1e3:.0f}" for t in times) + ")", flush=True)
+    return med
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--frames", type=int, default=250)
+    p.add_argument("--points", type=int, default=196608)
+    p.add_argument("--boxes", type=int, default=36)
+    p.add_argument("--k-max", type=int, default=63)
+    p.add_argument("--platform", default=None)
+    args = p.parse_args()
+
+    from maskclustering_tpu.utils.backend_init import init_backend
+
+    init_backend(args.platform, timeout_s=120.0, tag="claims_diag")
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.config import PipelineConfig
+    from maskclustering_tpu.models.backprojection import associate_scene_tensors
+    from maskclustering_tpu.models.clustering import iterative_clustering
+    from maskclustering_tpu.models.graph import (build_mask_table,
+                                                 compute_graph_stats,
+                                                 observer_schedule)
+    from maskclustering_tpu.models.pipeline import pad_scene_tensors
+    from maskclustering_tpu.models.postprocess_device import (
+        _live_rep_prep, _node_stats_kernel)
+    from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
+    from maskclustering_tpu.utils.synthetic import (make_scene_device,
+                                                    resize_scene_points)
+
+    setup_compilation_cache()
+    cfg = PipelineConfig(config_name="bench", dataset="demo",
+                         distance_threshold=0.01, few_points_threshold=25,
+                         point_chunk=8192)
+
+    print(f"[claims_diag] scene: F={args.frames} N={args.points} "
+          f"boxes={args.boxes}", flush=True)
+    tensors, _, _ = make_scene_device(
+        num_boxes=args.boxes, num_frames=args.frames, image_hw=(480, 640),
+        spacing=0.025, seed=0)
+    tensors.scene_points = resize_scene_points(tensors.scene_points,
+                                               args.points)
+
+    # ---- associate -> graph -> cluster, exactly as pipeline.run_scene ----
+    from maskclustering_tpu.utils.compile_cache import bucket_size
+
+    f_pad = bucket_size(tensors.num_frames, cfg.frame_pad_multiple)
+    n_pad = bucket_size(tensors.num_points, cfg.point_chunk)
+    tensors = pad_scene_tensors(tensors, f_pad, n_pad)
+    assoc = associate_scene_tensors(tensors, cfg, k_max=args.k_max)
+    table = build_mask_table(np.asarray(assoc.mask_valid),
+                             pad_multiple=cfg.mask_pad_multiple)
+    stats = compute_graph_stats(
+        assoc.mask_of_point, assoc.boundary, jnp.asarray(table.frame),
+        jnp.asarray(table.mask_id), jnp.asarray(table.valid),
+        k_max=args.k_max, point_chunk=cfg.point_chunk,
+        mask_visible_threshold=cfg.mask_visible_threshold,
+        contained_threshold=cfg.contained_threshold,
+        undersegment_filter_threshold=cfg.undersegment_filter_threshold,
+        big_mask_point_count=cfg.big_mask_point_count)
+    schedule = observer_schedule(stats.observer_hist,
+                                 max_len=cfg.max_cluster_iterations)
+    active = jnp.asarray(table.valid) & ~stats.undersegment
+    result = iterative_clustering(
+        stats.visible, stats.contained, active, jnp.asarray(schedule),
+        view_consensus_threshold=cfg.view_consensus_threshold)
+    assignment = np.asarray(result.assignment)
+    mask_active = np.asarray(active)
+
+    # ---- postprocess prep: the pipeline's own helper, same shapes ----
+    f, n = assoc.first_id.shape
+    k2 = args.k_max + 2
+    prep = _live_rep_prep(table.frame, table.mask_id, mask_active, assignment,
+                          f, k2, cfg.min_masks_per_object)
+    if prep is None:
+        print("[claims_diag] no live reps — nothing to time", flush=True)
+        return
+    reps, r_pad, _rep_lut, rep_tab, live_slots, live_valid, r_pull = prep
+    print(f"[claims_diag] reps={len(reps)} r_pad={r_pad} r_pull={r_pull} "
+          f"plane={(r_pull * (n // 8)) / 1e6:.2f} MB", flush=True)
+
+    rep_tab_d = jnp.asarray(rep_tab)
+    slots_d = jnp.asarray(live_slots)
+    valid_d = jnp.asarray(live_valid)
+
+    def kernel():
+        out = _node_stats_kernel(
+            assoc.first_id, assoc.last_id, rep_tab_d, result.node_visible,
+            slots_d, valid_d, r_pad=r_pad,
+            point_filter_threshold=float(cfg.point_filter_threshold))
+        _sync(out[0])
+        return out
+
+    claimed_p, ratio_p, nv_rep = kernel()
+    # calibration source: XOR with a fresh constant per call so every
+    # np.asarray transfers a NEW device array of the same byte size —
+    # jax.Array caches its host copy, so re-pulling one array is ~free
+    # and would read as a fantasy tunnel rate
+    calib_seq = iter(range(1, 1000))
+
+    def pull_calib():
+        return np.asarray(claimed_p[:r_pull] ^ np.uint8(next(calib_seq)))
+
+    print("[claims_diag] timings (median of 5):", flush=True)
+    t_kernel = timeit("kernel", kernel)
+    t_claim = timeit("pull_claimed", lambda: np.asarray(claimed_p[:r_pull]))
+    t_ratio = timeit("pull_ratio", lambda: np.asarray(ratio_p[:r_pull]))
+    t_calib = timeit("pull_calib", pull_calib)
+    mb = (r_pull * (n // 8)) / 1e6
+    print(f"[claims_diag] kernel={t_kernel*1e3:.0f}ms "
+          f"claimed_pull={t_claim*1e3:.0f}ms ratio_pull={t_ratio*1e3:.0f}ms "
+          f"calib({mb:.2f}MB)={t_calib*1e3:.0f}ms "
+          f"-> tunnel {mb/max(t_calib,1e-9):.1f} MB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,7 +80,8 @@ def main():
     from maskclustering_tpu.models.pipeline import bucket_size, run_scene
     from maskclustering_tpu.utils.compile_cache import (seen_shape_buckets,
                                                         setup_compilation_cache)
-    from maskclustering_tpu.utils.synthetic import make_scene_device
+    from maskclustering_tpu.utils.synthetic import (make_scene_device,
+                                                    resize_scene_points)
 
     cache = setup_compilation_cache()
     print(f"[northstar] persistent compile cache: {cache}",
@@ -110,13 +111,8 @@ def main():
                 num_boxes=boxes, num_frames=frames,
                 image_hw=(args.image_h, args.image_w),
                 spacing=0.025 if not args.quick else 0.08, seed=i)
-            pts = tensors.scene_points
-            if pts.shape[0] < points:
-                pts = np.tile(pts, (-(-points // pts.shape[0]), 1))[:points]
-            else:
-                pts = pts[np.random.default_rng(i).choice(
-                    pts.shape[0], points, replace=False)]
-            tensors.scene_points = np.ascontiguousarray(pts, dtype=np.float32)
+            tensors.scene_points = resize_scene_points(
+                tensors.scene_points, points, seed=i)
             gen_s = time.time() - t0
 
             bucket = (bucket_size(frames, cfg.frame_pad_multiple),

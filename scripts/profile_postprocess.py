@@ -13,7 +13,9 @@ import time
 import numpy as np
 
 from maskclustering_tpu.config import PipelineConfig
-from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                  resize_scene_points,
+                                                  to_scene_tensors)
 
 
 def main():
@@ -22,12 +24,7 @@ def main():
     scene = make_scene(num_boxes=boxes, num_frames=frames, image_hw=(240, 320),
                        spacing=0.02, seed=0)
     tensors = to_scene_tensors(scene)
-    pts = tensors.scene_points
-    if pts.shape[0] < points:
-        pts = np.tile(pts, (-(-points // pts.shape[0]), 1))[:points]
-    else:
-        pts = pts[np.random.default_rng(0).choice(pts.shape[0], points, replace=False)]
-    tensors.scene_points = np.ascontiguousarray(pts, dtype=np.float32)
+    tensors.scene_points = resize_scene_points(tensors.scene_points, points)
     print(f"scene ready {time.time()-t0:.1f}s", file=sys.stderr)
 
     cfg = PipelineConfig(config_name="bench", dataset="demo",

@@ -43,16 +43,15 @@ def main():
     from maskclustering_tpu.config import PipelineConfig
     from maskclustering_tpu.models.pipeline import run_scene
     from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
-    from maskclustering_tpu.utils.synthetic import make_scene_device
+    from maskclustering_tpu.utils.synthetic import (make_scene_device,
+                                                    resize_scene_points)
 
     setup_compilation_cache()
     tensors, _, _ = make_scene_device(
         num_boxes=args.boxes, num_frames=args.frames,
         image_hw=(args.image_h, args.image_w), seed=0)
-    pts = tensors.scene_points
-    if pts.shape[0] < args.points:
-        pts = np.tile(pts, (-(-args.points // pts.shape[0]), 1))[: args.points]
-    tensors.scene_points = np.ascontiguousarray(pts[: args.points], np.float32)
+    tensors.scene_points = resize_scene_points(tensors.scene_points,
+                                               args.points)
     cfg = PipelineConfig(config_name="profile", dataset="demo",
                          distance_threshold=args.distance_threshold,
                          point_chunk=8192)
