@@ -60,6 +60,14 @@ class PipelineConfig:
     # --- TPU-specific (no reference analog) ---
     backend: str = "tpu"  # "tpu" | "cpu" (tests) — which jax platform to target
     association_window: int = 1  # half-width of the pixel window in projective association
+    # frames vectorized per association-scan step (lax.map batch_size):
+    # 1 = strictly sequential (one frame's intermediates live at a time);
+    # B > 1 trades a B-fold intermediate footprint (~40 MB/frame at
+    # 480x640/192k pts) for B-wide utilization per step. Default stays 1
+    # until a live-chip measurement shows a win (CPU backend measures a
+    # slight loss; byte-identity at any B is pinned by
+    # tests/test_backprojection.py)
+    association_frame_batch: int = 1
     point_chunk: int = 8192  # point-chunk size for the affinity matmul
     mask_pad_multiple: int = 256  # pad N_masks to a multiple of this (bucketed recompiles)
     frame_pad_multiple: int = 32  # pad N_frames likewise (mesh batch path)
@@ -93,6 +101,9 @@ class PipelineConfig:
             raise ValueError(f"step must be >= 1, got {self.step}")
         if self.distance_threshold <= 0:
             raise ValueError("distance_threshold must be positive")
+        if self.association_frame_batch < 1:
+            raise ValueError(f"association_frame_batch must be >= 1, "
+                             f"got {self.association_frame_batch}")
         if self.backend not in ("tpu", "cpu", "gpu"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.mesh_shape and len(self.mesh_shape) != 2:

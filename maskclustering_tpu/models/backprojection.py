@@ -358,13 +358,16 @@ def _associate_scene_impl(
     depth_trunc: float = 20.0,
     few_points_threshold: int = 25,
     coverage_threshold: float = 0.3,
+    frame_batch: int = 1,
 ) -> SceneAssociation:
     """Projective association over all frames with lax.map (trace-time body).
 
     lax.map (not vmap) keeps per-frame intermediates (N x window gathers) at
     one frame's footprint; frames are still processed back-to-back inside a
-    single jit. Sharding over a `frames` mesh axis happens at the caller via
-    shard_map (parallel/).
+    single jit. ``frame_batch > 1`` vmaps that many frames per map step
+    (lax.map batch_size) — a bounded B-fold intermediate footprint for
+    B-wide utilization. Sharding over a `frames` mesh axis happens at the
+    caller via shard_map (parallel/).
     """
 
     def one(args):
@@ -374,16 +377,18 @@ def _associate_scene_impl(
             k_max=k_max, window=window, distance_threshold=distance_threshold,
             depth_trunc=depth_trunc, few_points_threshold=few_points_threshold,
             coverage_threshold=coverage_threshold,
-            # lax.map holds ONE frame's intermediates, so the quadratic
-            # full-window table has no F-fold footprint here: keep the
-            # single-take fast path at every window (the strip default
-            # targets the fused path's frame vmap, parallel/sharded.py)
-            full_tile_table=True,
+            # sequential map holds ONE frame's intermediates, so the
+            # quadratic full-window table is safe at every window; with
+            # frame_batch > 1 the step is a B-frame vmap, so fall back to
+            # the window-gated default (strip table when window > 1),
+            # matching the fused path's frame-vmap policy
+            full_tile_table=True if frame_batch == 1 else None,
         )
         return fa.mask_of_point, fa.first_id, fa.last_id, fa.mask_valid
 
     mop, first, last, mask_valid = jax.lax.map(
-        one, (depths, segs, intrinsics, cam_to_world, frame_valid)
+        one, (depths, segs, intrinsics, cam_to_world, frame_valid),
+        batch_size=frame_batch if frame_batch > 1 else None,
     )
     boundary = jnp.any(first != last, axis=0)
     point_visible = first > 0
@@ -399,7 +404,8 @@ def _associate_scene_impl(
 
 @functools.lru_cache(maxsize=None)
 def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
-                         few_points_threshold, coverage_threshold):
+                         few_points_threshold, coverage_threshold,
+                         frame_batch=1):
     """One cached top-level jit per static config.
 
     Calling lax.map eagerly re-traces AND re-compiles the whole frame scan
@@ -413,7 +419,7 @@ def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
         _associate_scene_impl, k_max=k_max, window=window,
         distance_threshold=distance_threshold, depth_trunc=depth_trunc,
         few_points_threshold=few_points_threshold,
-        coverage_threshold=coverage_threshold))
+        coverage_threshold=coverage_threshold, frame_batch=frame_batch))
 
 
 def associate_scene(
@@ -421,7 +427,7 @@ def associate_scene(
     vox_size=None, *,
     k_max: int = 127, window: int = 1, distance_threshold: float = 0.01,
     depth_trunc: float = 20.0, few_points_threshold: int = 25,
-    coverage_threshold: float = 0.3,
+    coverage_threshold: float = 0.3, frame_batch: int = 1,
 ) -> SceneAssociation:
     """Run projective association over all frames (jit-cached).
 
@@ -433,7 +439,7 @@ def associate_scene(
                                estimate_spacing(scene_points))
     fn = _associate_scene_jit(k_max, window, float(distance_threshold),
                               float(depth_trunc), few_points_threshold,
-                              float(coverage_threshold))
+                              float(coverage_threshold), int(frame_batch))
     return fn(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid,
               jnp.asarray(vox_size, jnp.float32))
 
@@ -462,4 +468,5 @@ def associate_scene_tensors(tensors, cfg, k_max: int = 127) -> SceneAssociation:
         depth_trunc=cfg.depth_trunc,
         few_points_threshold=cfg.few_points_threshold,
         coverage_threshold=cfg.coverage_threshold,
+        frame_batch=cfg.association_frame_batch,
     )

@@ -245,3 +245,23 @@ def test_strip_and_full_tile_tables_agree(scene, window):
         np.testing.assert_array_equal(
             np.asarray(getattr(full, name)), np.asarray(getattr(strip, name)),
             err_msg=f"{name} differs at window={window}")
+
+
+@pytest.mark.parametrize("frame_batch", [3, 8])
+def test_frame_batch_matches_sequential(frame_batch):
+    """lax.map batch_size (association_frame_batch) is a pure scheduling
+    knob: batched association must be byte-identical to the sequential
+    map, including at a batch that does not divide the frame count."""
+    scene = make_scene(num_boxes=4, num_frames=8, seed=11)
+    args = (jnp.asarray(scene.scene_points), jnp.asarray(scene.depths),
+            jnp.asarray(scene.segmentations), jnp.asarray(scene.intrinsics),
+            jnp.asarray(scene.cam_to_world), jnp.asarray(scene.frame_valid))
+    kw = dict(k_max=15, window=1, distance_threshold=DT,
+              few_points_threshold=25, coverage_threshold=COV)
+    seq = associate_scene(*args, frame_batch=1, **kw)
+    bat = associate_scene(*args, frame_batch=frame_batch, **kw)
+    for field in ("mask_of_point", "first_id", "last_id", "mask_valid",
+                  "boundary", "point_visible"):
+        np.testing.assert_array_equal(np.asarray(getattr(bat, field)),
+                                      np.asarray(getattr(seq, field)),
+                                      err_msg=field)
