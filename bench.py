@@ -88,6 +88,10 @@ def _emit(args, times, error=None, stage_timings=None):
     else:
         line = {"metric": _metric_name(args), "value": None, "unit": "s/scene",
                 "vs_baseline": None}
+    if getattr(args, "frame_batch", 1) != 1:
+        # attribute A/B records to their knob setting; the default record's
+        # shape stays unchanged for the driver
+        line["frame_batch"] = args.frame_batch
     if error is not None:
         line["error"] = str(error)[:300]
         if times:
@@ -201,6 +205,10 @@ def _build_parser():
                         "budget for a fresh attempt after a post-init wedge")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the timed repeats")
+    p.add_argument("--frame-batch", type=int, default=1,
+                   help="association_frame_batch (frames vectorized per "
+                        "association-scan step; A/B knob, byte-identical "
+                        "results at any value)")
     return p
 
 
@@ -366,7 +374,8 @@ def main():
 
     cfg = PipelineConfig(config_name="bench", dataset="demo",
                          distance_threshold=args.distance_threshold,
-                         few_points_threshold=25, point_chunk=8192)
+                         few_points_threshold=25, point_chunk=8192,
+                         association_frame_batch=args.frame_batch)
 
     times = []
     stage_timings = []

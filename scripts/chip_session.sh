@@ -1,0 +1,35 @@
+#!/bin/bash
+# One-shot live-chip capture session, priority-ordered for short recovery
+# windows (round 4 lost its headline number to a wedge; round 5's second
+# window lasted ~35 min). Runs each step with its own timeout and keeps
+# going on failure, so whatever the window allows is captured.
+#
+#   bash scripts/chip_session.sh [OUTDIR]
+#
+# Steps, most valuable first:
+#   1. bench.py (honest shape, 5 repeats)      -> OUTDIR/bench_default.json
+#   2. claims_diag (kernel vs tunnel split)    -> OUTDIR/claims_diag.txt
+#   3. bench.py --frame-batch 8 (A/B)          -> OUTDIR/bench_fb8.json
+#   4. northstar sweep (multi-bucket, ~3 min)  -> OUTDIR/NORTHSTAR_live.md
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/chip_session_$(date -u +%H%M)}
+mkdir -p "$OUT"
+echo "[chip_session] output -> $OUT"
+
+run() { # run NAME TIMEOUT CMD...
+  local name=$1 tmo=$2; shift 2
+  echo "[chip_session] === $name (timeout ${tmo}s) ==="
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  local rc=$?
+  echo "[chip_session] $name rc=$rc"
+  tail -3 "$OUT/$name.out" 2>/dev/null
+  return 0
+}
+
+run bench_default 900 python bench.py --retry-budget 300 --init-attempts 2
+run claims_diag   600 python scripts/claims_diag.py
+run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8
+run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md"
+echo "[chip_session] done; JSON lines:"
+grep -h '"value"' "$OUT"/bench_*.out 2>/dev/null
