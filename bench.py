@@ -205,7 +205,16 @@ def _build_parser():
                         "budget for a fresh attempt after a post-init wedge")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the timed repeats")
-    p.add_argument("--frame-batch", type=int, default=1,
+    def _positive_int(v):
+        iv = int(v)
+        if iv < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return iv
+
+    # validated at parse time: a bad value must fail BEFORE backend init
+    # burns minutes of a chip recovery window (PipelineConfig would only
+    # reject it after init + scene render, outside the JSON-line guard)
+    p.add_argument("--frame-batch", type=_positive_int, default=1,
                    help="association_frame_batch (frames vectorized per "
                         "association-scan step; A/B knob, byte-identical "
                         "results at any value)")
@@ -332,6 +341,9 @@ def _supervise(args):
         line = {"metric": _metric_name(args), "value": None, "unit": "s/scene",
                 "vs_baseline": None, "error": f"worker produced no JSON line (rc={rc})"}
     line["attempts"] = attempt
+    if args.frame_batch != 1 and "frame_batch" not in line:
+        # the fallback record must stay attributable to its A/B setting
+        line["frame_batch"] = args.frame_batch
     print(json.dumps(line))
     # Preserve the worker's verdict for shell callers (setup_tpu_vm.sh runs
     # under set -e): partial/errored runs must not look like clean passes.

@@ -6,11 +6,11 @@
 #
 #   bash scripts/chip_session.sh [OUTDIR]
 #
-# Steps, most valuable first:
-#   1. bench.py (honest shape, 5 repeats)      -> OUTDIR/bench_default.json
-#   2. claims_diag (kernel vs tunnel split)    -> OUTDIR/claims_diag.txt
-#   3. bench.py --frame-batch 8 (A/B)          -> OUTDIR/bench_fb8.json
-#   4. northstar sweep (multi-bucket, ~3 min)  -> OUTDIR/NORTHSTAR_live.md
+# Steps, most valuable first (each writes OUTDIR/NAME.out + NAME.err):
+#   1. bench.py (honest shape, 5 repeats)      -> bench_default.out (JSON line)
+#   2. claims_diag (kernel vs tunnel split)    -> claims_diag.out
+#   3. bench.py --frame-batch 8 (A/B)          -> bench_fb8.out (JSON line)
+#   4. northstar sweep (multi-bucket, ~3 min)  -> northstar.out + NORTHSTAR_live.md
 set -u
 cd "$(dirname "$0")/.."
 OUT=${1:-/tmp/chip_session_$(date -u +%H%M)}

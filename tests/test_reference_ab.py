@@ -95,7 +95,8 @@ def _write_scans(tmp_path, seeds, synth=None):
     return gt_dir, pred_dir
 
 
-def _assert_evaluators_agree(tmp_path, gt_dir, pred_dir, no_class):
+def _assert_evaluators_agree(tmp_path, gt_dir, pred_dir, no_class,
+                             dataset="scannet"):
     """Run both evaluators on the scans in pred_dir/gt_dir and compare the
     full result CSVs to 1e-6 (nan == nan)."""
     from maskclustering_tpu.evaluation import evaluate_scans
@@ -104,11 +105,11 @@ def _assert_evaluators_agree(tmp_path, gt_dir, pred_dir, no_class):
     suffix = "_class_agnostic" if no_class else ""
     ref_out = tmp_path / f"ref{suffix}.txt"  # pre-suffixed: the reference
     # renames outputs lacking 'class_agnostic' in --no_class mode
-    _run_reference_evaluator(pred_dir, gt_dir, ref_out, no_class)
+    _run_reference_evaluator(pred_dir, gt_dir, ref_out, no_class, dataset)
     repo_out = tmp_path / "repo.txt"
     evaluate_scans([str(pred_dir / f"{n}.npz") for n in names],
                    [str(gt_dir / f"{n}.txt") for n in names],
-                   "scannet", no_class=no_class, output_file=str(repo_out),
+                   dataset, no_class=no_class, output_file=str(repo_out),
                    verbose=False)
     ref_rows = _parse_result_csv(ref_out)
     repo_rows = _parse_result_csv(repo_out)
@@ -118,13 +119,14 @@ def _assert_evaluators_agree(tmp_path, gt_dir, pred_dir, no_class):
                                    equal_nan=True)
 
 
-def _run_reference_evaluator(pred_dir, gt_dir, out_file, no_class):
+def _run_reference_evaluator(pred_dir, gt_dir, out_file, no_class,
+                             dataset="scannet"):
     """Run the reference evaluator file as __main__ in a subprocess.
 
     sys.argv is set before runpy because evaluate.py parses flags at import
     time (reference evaluation/evaluate.py:7-13)."""
     argv = ["evaluate.py", "--pred_path", str(pred_dir), "--gt_path",
-            str(gt_dir), "--dataset", "scannet", "--output_file", str(out_file)]
+            str(gt_dir), "--dataset", dataset, "--output_file", str(out_file)]
     if no_class:
         argv.append("--no_class")
     runner = textwrap.dedent(f"""
@@ -150,17 +152,17 @@ def _parse_result_csv(path):
     return rows
 
 
-def _synth_random_scan(rng, n=2500):
+def _random_scan(rng, n, gt_pool, pred_pool):
     """Unstructured random scan: random instance spans and predictions with
-    random extents/scores/classes — sweeps protocol-branch combinations the
-    crafted scan doesn't enumerate."""
+    random extents/scores/classes drawn from the given class pools —
+    sweeps protocol-branch combinations the crafted scan doesn't
+    enumerate."""
     gt = np.ones(n, dtype=np.int64)  # unannotated
     cur = 0
     inst = 1
-    classes_pool = [3, 4, 5, 7, 99]  # 99 = void label
     while cur < n - 100:
         span = int(rng.integers(60, 400))
-        cls = int(classes_pool[rng.integers(0, len(classes_pool))])
+        cls = int(gt_pool[rng.integers(0, len(gt_pool))])
         gt[cur:cur + span] = cls * 1000 + inst
         inst += 1
         cur += span + int(rng.integers(0, 120))
@@ -172,9 +174,48 @@ def _synth_random_scan(rng, n=2500):
         m[a:min(b, n)] = True
         cols.append(m)
         scores.append(float(np.round(rng.random(), 2)))  # coarse -> real ties
-        classes.append(int(classes_pool[rng.integers(0, 4)]))
+        classes.append(int(pred_pool[rng.integers(0, len(pred_pool))]))
     return gt, np.stack(cols, axis=1), np.asarray(scores), \
         np.asarray(classes, dtype=np.int32)
+
+
+def _synth_random_scan(rng, n=2500):
+    # 99 = void label in GT; predictions draw valid scannet ids only
+    return _random_scan(rng, n, gt_pool=[3, 4, 5, 7, 99],
+                        pred_pool=[3, 4, 5, 7])
+
+
+def _make_vocab_synth(ids):
+    """Dataset-generic random-scan synth: GT instances and prediction
+    classes sampled from the dataset's benchmark vocabulary, plus a void
+    label (not in the vocabulary) and one invalid prediction class."""
+    ids = sorted(ids)
+    void = ids[-1] + 1
+    # deterministic spread across the vocabulary incl. both extremes
+    pool = sorted({ids[0], ids[len(ids) // 3], ids[len(ids) // 2],
+                   ids[(2 * len(ids)) // 3], ids[-1]})
+
+    def synth(rng, n=2500):
+        # void id doubles as an invalid prediction class
+        return _random_scan(rng, n, gt_pool=pool + [void],
+                            pred_pool=pool + [void])
+
+    return synth
+
+
+@pytest.mark.parametrize("dataset", ["matterport3d", "scannetpp"])
+@pytest.mark.parametrize("no_class", [False, True])
+def test_evaluator_matches_reference_other_vocabs(tmp_path, dataset, no_class):
+    """Protocol parity beyond ScanNet: the matterport3d (157-class) and
+    scannetpp (1554-class) vocabularies through both evaluators — same
+    1e-6 CSV agreement, including the full-vocabulary class-AP table."""
+    from maskclustering_tpu.semantics.vocab import get_vocab
+
+    _, ids = get_vocab(dataset)
+    gt_dir, pred_dir = _write_scans(tmp_path, (13, 29),
+                                    synth=_make_vocab_synth(ids))
+    _assert_evaluators_agree(tmp_path, gt_dir, pred_dir, no_class,
+                             dataset=dataset)
 
 
 @pytest.mark.parametrize("no_class", [False, True])
