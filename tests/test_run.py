@@ -214,3 +214,31 @@ def test_init_backend_or_die_cpu():
 
     devices = init_backend_or_die(60, platform="cpu")
     assert len(devices) >= 1
+
+
+class TestDaemonFuture:
+    """The prefetcher's one-shot future (utils/daemon_future.py)."""
+
+    def test_result_returns_value(self):
+        from maskclustering_tpu.utils.daemon_future import DaemonFuture
+
+        assert DaemonFuture(lambda: 41 + 1).result() == 42
+
+    def test_exception_reraises_in_consumer(self):
+        from maskclustering_tpu.utils.daemon_future import DaemonFuture
+
+        fut = DaemonFuture(lambda: (_ for _ in ()).throw(OSError("disk gone")))
+        with pytest.raises(OSError, match="disk gone"):
+            fut.result()
+
+    def test_runs_on_daemon_thread(self):
+        """The whole point vs ThreadPoolExecutor: an abandoned blocking load
+        must never stall interpreter shutdown."""
+        import threading
+
+        from maskclustering_tpu.utils.daemon_future import DaemonFuture
+
+        seen = {}
+        DaemonFuture(lambda: seen.setdefault(
+            "daemon", threading.current_thread().daemon)).result()
+        assert seen["daemon"] is True
