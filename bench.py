@@ -35,6 +35,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -235,16 +236,60 @@ def _supervise(args):
     child_argv = [sys.executable, os.path.abspath(__file__), "--worker"]
     child_argv += [a for a in sys.argv[1:] if a != "--worker"]
     t_start = time.time()
-    last_line = None
-    attempt = 0
-    rc = 3
+    # single source of truth for BOTH emission paths (the loop tail and the
+    # signal handler): shadow locals desynchronize them
+    state = {"last_line": None, "attempt": 0, "rc": 3, "proc": None,
+             "out": [], "emitted": False}
+
+    def _final_line():
+        last = state["last_line"]
+        if last is None and state["out"]:
+            # verdict emitted by the CURRENT attempt's worker but not yet
+            # promoted (it still sat in the drain buffer when a signal hit)
+            last = state["out"][-1]
+        try:
+            line = json.loads(last)
+            if not isinstance(line, dict):
+                raise ValueError("not a JSON object")
+        except (TypeError, ValueError):
+            line = {"metric": _metric_name(args), "value": None,
+                    "unit": "s/scene", "vs_baseline": None,
+                    "error": f"worker produced no JSON line (rc={state['rc']})"}
+        line["attempts"] = state["attempt"]
+        if args.frame_batch != 1 and "frame_batch" not in line:
+            # the fallback record must stay attributable to its A/B setting
+            line["frame_batch"] = args.frame_batch
+        return line
+
+    def _on_term(signum, frame):
+        # An external kill (driver timeout) mid-retry must still leave one
+        # JSON line on stdout — otherwise a long retry loop degrades the
+        # round's record from value=null to NOTHING. SIGKILL is the only
+        # unrecoverable case.
+        if state["emitted"]:
+            os._exit(3)  # the one line is already out; never print a second
+        state["emitted"] = True
+        proc = state["proc"]
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        line = _final_line()
+        if "value" not in line or line.get("value") is None:
+            # no worker verdict to preserve: the kill IS the story
+            line["error"] = f"supervisor killed by signal {signum}"
+        print(json.dumps(line))
+        sys.stdout.flush()
+        os._exit(3)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
     for attempt in range(1, max(args.init_attempts, 1) + 1):
+        state["attempt"] = attempt
         elapsed = time.time() - t_start
         if attempt > 1 and elapsed >= args.retry_budget:
             print(f"[bench] budget exhausted before attempt {attempt} "
                   f"({elapsed:.0f}s >= {args.retry_budget:.0f}s)",
                   file=sys.stderr, flush=True)
-            attempt -= 1
+            state["attempt"] = attempt - 1  # this attempt never launched
             break
         print(f"[bench] attempt {attempt}/{args.init_attempts} "
               f"(elapsed {elapsed:.0f}s of {args.retry_budget:.0f}s budget)",
@@ -257,7 +302,9 @@ def _supervise(args):
         # init cap (init_timeout + grace; keeps a wedged init retryable
         # within the budget) to the long run allowance (worker_timeout).
         proc = subprocess.Popen(child_argv, stdout=subprocess.PIPE, env=env)
-        out: list = []
+        state["proc"] = proc
+        out = state["out"] = []  # handler-visible: a signal mid-attempt must
+        # not drop a verdict still sitting in the drain buffer
         init_ok_evt = threading.Event()
 
         def _drain(stream=proc.stdout):
@@ -303,6 +350,7 @@ def _supervise(args):
             # in-worker watchdog)
             rc = 3 if not init_ok else 1
         last_line = out[-1] if out else None
+        state["last_line"], state["rc"], state["out"] = last_line, rc, []
         # Retryable = chip-wedge deaths: the explicit init rcs, a signal
         # death (negative rc, e.g. libtpu SIGABRT on a wedged chip) BEFORE
         # the init-ok sentinel, or a post-init hang that produced NO JSON
@@ -333,20 +381,17 @@ def _supervise(args):
               f"retrying in {backoff:.0f}s with a fresh process",
               file=sys.stderr, flush=True)
         time.sleep(backoff)
-    try:
-        line = json.loads(last_line)
-        if not isinstance(line, dict):
-            raise ValueError("not a JSON object")
-    except (TypeError, ValueError):
-        line = {"metric": _metric_name(args), "value": None, "unit": "s/scene",
-                "vs_baseline": None, "error": f"worker produced no JSON line (rc={rc})"}
-    line["attempts"] = attempt
-    if args.frame_batch != 1 and "frame_batch" not in line:
-        # the fallback record must stay attributable to its A/B setting
-        line["frame_batch"] = args.frame_batch
+    # a signal from here on must not produce a SECOND line
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGINT, signal.SIG_DFL)
+    if state["emitted"]:
+        os._exit(3)  # handler won the race and already printed
+    state["emitted"] = True
+    line = _final_line()
     print(json.dumps(line))
     # Preserve the worker's verdict for shell callers (setup_tpu_vm.sh runs
     # under set -e): partial/errored runs must not look like clean passes.
+    rc = state["rc"]
     sys.exit(rc if rc != 0 else (0 if line.get("value") is not None else 3))
 
 

@@ -83,3 +83,37 @@ def test_supervisor_retries_post_init_hang(tmp_path):
     assert d["value"] is not None
     assert d["attempts"] == 2
     assert "post-init run allowance" in proc.stderr.decode()
+
+
+def test_supervisor_sigterm_still_emits_json_line(tmp_path):
+    # An external kill (driver-side timeout) mid-supervision must degrade
+    # to a value=null JSON line, not to an empty stdout: the hang knob
+    # wedges the first worker post-init, and SIGTERM arrives while the
+    # supervisor is waiting out --worker-timeout.
+    import signal
+    import time
+
+    env = dict(os.environ, MCT_BENCH_BACKOFF_SCALE="0.05",
+               MCT_BENCH_TEST_HANG_AFTER_INIT=str(tmp_path / "hung-once"))
+    env.pop("MCT_BENCH_SUPERVISED", None)
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--platform", "cpu", "--worker-timeout",
+         "300", "--init-timeout", "120"] + TINY,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        cwd=REPO_ROOT)
+    # wait for the hang flag: proves the first worker is past init and the
+    # supervisor is in its long post-init wait
+    deadline = time.time() + 180
+    while time.time() < deadline and not (tmp_path / "hung-once").exists():
+        time.sleep(0.5)
+    assert (tmp_path / "hung-once").exists(), "worker never reached the hang"
+    time.sleep(1.0)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=60)
+    out_lines = out.decode().strip().splitlines()
+    assert proc.returncode == 3
+    assert len(out_lines) == 1, out_lines
+    d = json.loads(out_lines[0])
+    assert d["value"] is None
+    assert "signal" in d["error"]
+    assert d["attempts"] == 1
