@@ -6,6 +6,10 @@
 #
 #   bash scripts/chip_session.sh [OUTDIR]
 #
+# Env knobs (for smoke-testing the harness itself off-chip):
+#   MCT_PLATFORM=cpu  force a jax platform on every step
+#   MCT_QUICK=1       tiny shapes (validates plumbing, not performance)
+#
 # Steps, most valuable first (each writes OUTDIR/NAME.out + NAME.err):
 #   1. bench.py (honest shape, 5 repeats)      -> bench_default.out (JSON line)
 #   2. claims_diag (kernel vs tunnel split)    -> claims_diag.out
@@ -17,6 +21,15 @@ OUT=${1:-/tmp/chip_session_$(date -u +%H%M)}
 mkdir -p "$OUT"
 echo "[chip_session] output -> $OUT"
 
+PLAT=()
+[ -n "${MCT_PLATFORM:-}" ] && PLAT=(--platform "$MCT_PLATFORM")
+TINY=()
+NS_QUICK=()
+if [ -n "${MCT_QUICK:-}" ]; then
+  TINY=(--frames 8 --points 4096 --boxes 3 --image-h 48 --image-w 64 --repeats 1 --spacing 0.08)
+  NS_QUICK=(--quick)
+fi
+
 run() { # run NAME TIMEOUT CMD...
   local name=$1 tmo=$2; shift 2
   echo "[chip_session] === $name (timeout ${tmo}s) ==="
@@ -27,9 +40,9 @@ run() { # run NAME TIMEOUT CMD...
   return 0
 }
 
-run bench_default 900 python bench.py --retry-budget 300 --init-attempts 2
-run claims_diag   600 python scripts/claims_diag.py
-run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8
-run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md"
+run bench_default 900 python bench.py --retry-budget 300 --init-attempts 2 "${PLAT[@]}" "${TINY[@]}"
+run claims_diag   600 python scripts/claims_diag.py "${PLAT[@]}" ${MCT_QUICK:+--frames 8 --points 4096 --boxes 3}
+run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8 "${PLAT[@]}" "${TINY[@]}"
+run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md" "${PLAT[@]}" "${NS_QUICK[@]}"
 echo "[chip_session] done; JSON lines:"
 grep -h '"value"' "$OUT"/bench_*.out 2>/dev/null
