@@ -24,9 +24,13 @@ echo "[chip_session] output -> $OUT"
 PLAT=()
 [ -n "${MCT_PLATFORM:-}" ] && PLAT=(--platform "$MCT_PLATFORM")
 TINY=()
+DIAG_QUICK=()
 NS_QUICK=()
 if [ -n "${MCT_QUICK:-}" ]; then
-  TINY=(--frames 8 --points 4096 --boxes 3 --image-h 48 --image-w 64 --repeats 1 --spacing 0.08)
+  # one source of truth for the quick shape: DIAG_QUICK is the subset
+  # claims_diag accepts
+  DIAG_QUICK=(--frames 8 --points 4096 --boxes 3)
+  TINY=("${DIAG_QUICK[@]}" --image-h 48 --image-w 64 --repeats 1 --spacing 0.08)
   NS_QUICK=(--quick)
 fi
 
@@ -40,9 +44,9 @@ run() { # run NAME TIMEOUT CMD...
   return 0
 }
 
-run bench_default 900 python bench.py --retry-budget 300 --init-attempts 2 "${PLAT[@]}" "${TINY[@]}"
-run claims_diag   600 python scripts/claims_diag.py "${PLAT[@]}" ${MCT_QUICK:+--frames 8 --points 4096 --boxes 3}
-run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8 "${PLAT[@]}" "${TINY[@]}"
-run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md" "${PLAT[@]}" "${NS_QUICK[@]}"
+run bench_default 900 python bench.py --retry-budget 300 --init-attempts 2 ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
+run claims_diag   600 python scripts/claims_diag.py ${PLAT[@]+"${PLAT[@]}"} ${DIAG_QUICK[@]+"${DIAG_QUICK[@]}"}
+run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8 ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
+run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md" ${PLAT[@]+"${PLAT[@]}"} ${NS_QUICK[@]+"${NS_QUICK[@]}"}
 echo "[chip_session] done; JSON lines:"
 grep -h '"value"' "$OUT"/bench_*.out 2>/dev/null

@@ -30,7 +30,7 @@ def _cluster_quality(assignment, mask_active, object_of_masks, mask_frame_id):
     return reps, n_impure
 
 
-@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4)])
+@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (4, 2)])
 def test_fused_step_meshes(mesh_shape):
     cfg = PipelineConfig(
         config_name="test", dataset="demo", distance_threshold=0.06,
@@ -38,19 +38,21 @@ def test_fused_step_meshes(mesh_shape):
     )
     mesh = make_mesh(mesh_shape)
     k_max = 7
+    # the scene batch axis must fill the mesh's scene axis
+    n_scenes = max(2, mesh_shape[0])
     step = build_fused_step(mesh, cfg, k_max=k_max)
-    args = fused_step_example_args(num_scenes=2, num_frames=8)
+    args = fused_step_example_args(num_scenes=n_scenes, num_frames=8)
     out = jax.block_until_ready(step(*map(jax.numpy.asarray, args)))
 
-    assert out.assignment.shape == (2, 8 * k_max)
-    assert out.mask_of_point.shape[0] == 2
+    assert out.assignment.shape == (n_scenes, 8 * k_max)
+    assert out.mask_of_point.shape[0] == n_scenes
     # every scene finds at least the 3 boxes (floor may add one more object)
     n_obj = np.asarray(out.num_objects)
     assert (n_obj >= 3).all(), n_obj
     assert (n_obj <= 8).all(), n_obj
 
 
-@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (8, 1)])
+@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (4, 2), (8, 1)])
 def test_mesh_batch_matches_single_chip_artifacts(mesh_shape):
     """The fused mesh path must produce the exact objects (point sets, mask
     lists, coverages) of the single-chip pipeline on the same scenes —
