@@ -93,6 +93,9 @@ def _emit(args, times, error=None, stage_timings=None):
         # attribute A/B records to their knob setting; the default record's
         # shape stays unchanged for the driver
         line["frame_batch"] = args.frame_batch
+    if getattr(args, "obs_events", None) and not getattr(args, "no_obs", False):
+        # point the record at its own span stream (report CLI renders it)
+        line["obs_events"] = args.obs_events
     if error is not None:
         line["error"] = str(error)[:300]
         if times:
@@ -217,8 +220,19 @@ def _build_parser():
     # reject it after init + scene render, outside the JSON-line guard)
     p.add_argument("--frame-batch", type=_positive_int, default=1,
                    help="association_frame_batch (frames vectorized per "
-                        "association-scan step; A/B knob, byte-identical "
-                        "results at any value)")
+                        "association-scan step; A/B knob. Results are "
+                        "byte-identical at any value on the CPU backend "
+                        "(pinned by tests/test_backprojection.py); on TPU "
+                        "the batched path also switches tile tables, so "
+                        "verify once on chip via chip_session's fb_identity "
+                        "step)")
+    p.add_argument("--obs-events", default=None,
+                   help="arm obs span/metrics capture to this JSONL path "
+                        "(default: off in bench mode so honest-shape "
+                        "numbers carry zero instrumentation cost); render "
+                        "with python -m maskclustering_tpu.obs.report")
+    p.add_argument("--no-obs", action="store_true",
+                   help="force obs capture off even if --obs-events is set")
     return p
 
 
@@ -241,7 +255,12 @@ def _supervise(args):
     state = {"last_line": None, "attempt": 0, "rc": 3, "proc": None,
              "out": [], "emitted": False}
 
-    def _final_line():
+    def _final_line(kill_msg=None):
+        """The one JSON line. ``kill_msg`` (signal path) is attributed
+        carefully: a WORKER-emitted error record keeps its own error field
+        (the kill is not that verdict's story), while the synthetic
+        no-JSON-line fallback and an error-less null verdict take the kill
+        message — there the kill IS the story."""
         last = state["last_line"]
         if last is None and state["out"]:
             # verdict emitted by the CURRENT attempt's worker but not yet
@@ -251,10 +270,13 @@ def _supervise(args):
             line = json.loads(last)
             if not isinstance(line, dict):
                 raise ValueError("not a JSON object")
+            if kill_msg and line.get("value") is None and "error" not in line:
+                line["error"] = kill_msg
         except (TypeError, ValueError):
+            no_line = f"worker produced no JSON line (rc={state['rc']})"
             line = {"metric": _metric_name(args), "value": None,
                     "unit": "s/scene", "vs_baseline": None,
-                    "error": f"worker produced no JSON line (rc={state['rc']})"}
+                    "error": f"{kill_msg}; {no_line}" if kill_msg else no_line}
         line["attempts"] = state["attempt"]
         if args.frame_batch != 1 and "frame_batch" not in line:
             # the fallback record must stay attributable to its A/B setting
@@ -272,13 +294,14 @@ def _supervise(args):
         proc = state["proc"]
         if proc is not None and proc.poll() is None:
             proc.kill()
-        line = _final_line()
-        if "value" not in line or line.get("value") is None:
-            # no worker verdict to preserve: the kill IS the story
-            line["error"] = f"supervisor killed by signal {signum}"
+        line = _final_line(kill_msg=f"supervisor killed by signal {signum}")
         print(json.dumps(line))
         sys.stdout.flush()
-        os._exit(3)
+        # mirror the tail's exit contract: only a CLEAN preserved verdict
+        # (value non-null, no error) is a pass for set -e shell callers —
+        # a partial/errored record exits nonzero from the tail path too
+        os._exit(0 if (line.get("value") is not None
+                       and "error" not in line) else 3)
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
@@ -387,6 +410,23 @@ def _supervise(args):
     if state["emitted"]:
         os._exit(3)  # handler won the race and already printed
     state["emitted"] = True
+    if args.obs_events and not args.no_obs:
+        # append the supervision story to the worker's event stream so the
+        # report shows attempt/retry counts next to the stage tables. The
+        # obs import stays chip-free (configure never touches jax), keeping
+        # the supervisor's no-backend-init guarantee.
+        try:
+            from maskclustering_tpu import obs as _obs
+
+            _obs.configure(args.obs_events, sample_memory=False,
+                           meta={"tool": "bench-supervisor"})
+            _obs.count("bench.attempts", state["attempt"])
+            _obs.count("bench.retries", max(state["attempt"] - 1, 0))
+            _obs.flush_metrics()
+            _obs.disable()
+        except Exception as oe:  # noqa: BLE001 — never endanger the JSON line
+            print(f"[bench] WARNING: obs supervisor flush failed: {oe}",
+                  file=sys.stderr, flush=True)
     line = _final_line()
     print(json.dumps(line))
     # Preserve the worker's verdict for shell callers (setup_tpu_vm.sh runs
@@ -404,6 +444,21 @@ def main():
     _init_backend(args)
 
     import numpy as np
+
+    obs_armed = bool(args.obs_events) and not args.no_obs
+    if obs_armed:
+        import jax
+
+        from maskclustering_tpu import obs
+
+        # armed only on request: the default bench keeps the no-op tracer so
+        # honest-shape numbers carry zero instrumentation cost (no fences,
+        # no event I/O); with capture on, every run_scene stage span and
+        # transfer counter streams to the JSONL, crash-safe per line
+        obs.configure(args.obs_events, annotations=bool(args.profile_dir),
+                      meta={"tool": "bench", "backend": jax.default_backend(),
+                            "frames": args.frames, "points": args.points,
+                            "frame_batch": args.frame_batch})
 
     from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
 
@@ -457,6 +512,10 @@ def main():
                 t0 = time.time()
                 result = run_scene(tensors, cfg, k_max=args.k_max)
                 times.append(time.time() - t0)
+                if obs_armed:
+                    from maskclustering_tpu import obs
+
+                    obs.record_span("bench.repeat", times[-1], repeat=i)
                 stage_timings.append(dict(result.timings))
                 print(f"[bench] run {i}: {times[-1]:.2f}s "
                       f"({len(result.objects.point_ids_list)} objects, "
@@ -473,9 +532,18 @@ def main():
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
         print(f"[bench] ERROR after {len(times)} completed runs: {e}",
               file=sys.stderr, flush=True)
+        if obs_armed:
+            from maskclustering_tpu import obs
+
+            obs.count("bench.run_errors")
+            obs.flush_metrics()
         _emit(args, times, error=e, stage_timings=stage_timings)
         sys.exit(1)
 
+    if obs_armed:
+        from maskclustering_tpu import obs
+
+        obs.flush_metrics()
     _emit(args, times, stage_timings=stage_timings)
 
 

@@ -103,13 +103,18 @@ def to_device_frames(
     Arrays already on device (the synthetic bench renders frames directly
     in HBM) pass through untouched.
     """
+    from maskclustering_tpu import obs
+
     if isinstance(depths, jnp.ndarray) and not isinstance(depths, np.ndarray):
         d_dev = jnp.asarray(depths, jnp.float32)
     else:
         enc, scale = encode_depth(np.asarray(depths))
+        obs.count_transfer("h2d", enc.nbytes, "associate.feed")
         d_dev = decode_depth(jnp.asarray(enc), scale)
     if isinstance(segs, jnp.ndarray) and not isinstance(segs, np.ndarray):
         s_dev = jnp.asarray(segs, jnp.int32)
     else:
-        s_dev = decode_seg(jnp.asarray(encode_seg(np.asarray(segs))))
+        enc_s = encode_seg(np.asarray(segs))
+        obs.count_transfer("h2d", enc_s.nbytes, "associate.feed")
+        s_dev = decode_seg(jnp.asarray(enc_s))
     return d_dev, s_dev

@@ -452,9 +452,16 @@ def associate_scene_tensors(tensors, cfg, k_max: int = 127) -> SceneAssociation:
     depth is uint16 mm), f32 passthrough otherwise — halves-to-quarters the
     dominant per-scene transfer at identical results.
     """
+    from maskclustering_tpu import obs
     from maskclustering_tpu.io.feed import to_device_frames
 
     depths_dev, segs_dev = to_device_frames(tensors.depths, tensors.segmentations)
+    # the codec accounts depth/seg bytes itself (it sees the encoded size);
+    # the remaining per-scene uploads are the cloud + the small pose tables
+    for arr in (tensors.scene_points, tensors.intrinsics,
+                tensors.cam_to_world, tensors.frame_valid):
+        if isinstance(arr, np.ndarray):
+            obs.count_transfer("h2d", arr.nbytes, "associate")
     return associate_scene(
         jnp.asarray(tensors.scene_points),
         depths_dev,

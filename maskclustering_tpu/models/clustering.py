@@ -57,8 +57,29 @@ def _connected_components(adj: jnp.ndarray) -> jnp.ndarray:
     return labels
 
 
-@functools.partial(jax.jit, static_argnames=("view_consensus_threshold",))
 def iterative_clustering(
+    visible: jnp.ndarray,
+    contained: jnp.ndarray,
+    active: jnp.ndarray,
+    schedule: jnp.ndarray,
+    *,
+    view_consensus_threshold: float = 0.9,
+) -> ClusterResult:
+    """Dispatch wrapper: one obs span (and, when armed with annotations,
+    one ``jax.profiler.TraceAnnotation``) around the jitted solve so the
+    clustering step is identifiable inside XLA profile traces. Static
+    shapes only — no device sync, zero cost when obs is disarmed."""
+    from maskclustering_tpu import obs
+
+    with obs.span("cluster.solve", m_pad=int(visible.shape[0]),
+                  schedule_len=int(schedule.shape[0])):
+        return _iterative_clustering_jit(
+            visible, contained, active, schedule,
+            view_consensus_threshold=view_consensus_threshold)
+
+
+@functools.partial(jax.jit, static_argnames=("view_consensus_threshold",))
+def _iterative_clustering_jit(
     visible: jnp.ndarray,  # (M_pad, F) bool mask-level visible_frame
     contained: jnp.ndarray,  # (M_pad, M_pad) bool mask-level contained_mask
     active: jnp.ndarray,  # (M_pad,) bool: valid & not undersegmented

@@ -289,6 +289,9 @@ def observer_schedule(observer_hist, max_len: int = 20) -> np.ndarray:
     Only the (F+1,)-sized histogram crosses the device->host boundary; the
     order statistics it yields are exactly the sorted flat matrix's values.
     """
+    from maskclustering_tpu import obs
+
+    obs.count_transfer("d2h", getattr(observer_hist, "nbytes", 0), "graph")
     hist = np.asarray(observer_hist, dtype=np.int64)
     cum = np.cumsum(hist)
     total = int(cum[-1])

@@ -10,12 +10,12 @@ crosses to numpy.
 from __future__ import annotations
 
 import logging
-import time
 from typing import Dict, NamedTuple, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+from maskclustering_tpu import obs
 from maskclustering_tpu.config import PipelineConfig
 from maskclustering_tpu.datasets.base import SceneTensors
 from maskclustering_tpu.models.backprojection import associate_scene_tensors
@@ -116,74 +116,92 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
     ``k_max`` (max mask id per frame) defaults to a power-of-two bucket of the
     scene's true max segmentation id, so crowded frames (CropFormer id-maps
     are uint16) are never truncated while jit recompiles stay rare.
+
+    Stage timing comes from obs spans (obs.scene_tracer()): with obs armed
+    every stage is sync-fenced at its boundary (``sp.sync``), so device
+    work is attributed to the stage that dispatched it instead of the
+    stage that first pulls a result; disarmed, the spans are timing-only
+    and add no syncs — identical behavior to the legacy perf_counter
+    timings. The ``timings`` keys are unchanged either way.
     """
     timings: Dict[str, float] = {}
-    t0 = time.perf_counter()
+    tracer = obs.scene_tracer()
 
     if k_max is None:
         max_id = int(np.max(tensors.segmentations)) if np.size(tensors.segmentations) else 0
         k_max = bucket_k_max(max_id)
 
     n_real = tensors.num_points
-    if cfg.use_exact_ball_query:
-        # host-only parity path: no jit shape buckets, padding would only
-        # add pointless device round-trips
-        from maskclustering_tpu.models.exact_backprojection import associate_scene_exact
+    with tracer.span("associate", scene=seq_name, k_max=k_max,
+                     num_frames=tensors.num_frames, num_points=n_real) as sp:
+        if cfg.use_exact_ball_query:
+            # host-only parity path: no jit shape buckets, padding would only
+            # add pointless device round-trips
+            from maskclustering_tpu.models.exact_backprojection import associate_scene_exact
 
-        assoc = associate_scene_exact(tensors, cfg, k_max=k_max)
-    else:
-        # shape buckets: heterogeneous scenes (ScanNet frame counts and
-        # cloud sizes vary per scan) land on a handful of padded shapes, so
-        # the jit caches — and the persistent compilation cache — hit
-        # across scenes
-        f_pad = bucket_size(tensors.num_frames, max(cfg.frame_pad_multiple, 1))
-        n_pad = bucket_size(n_real, max(cfg.point_chunk, 1))
-        tensors = pad_scene_tensors(tensors, f_pad, n_pad)
-        from maskclustering_tpu.utils.compile_cache import record_shape_bucket
+            assoc = associate_scene_exact(tensors, cfg, k_max=k_max)
+        else:
+            # shape buckets: heterogeneous scenes (ScanNet frame counts and
+            # cloud sizes vary per scan) land on a handful of padded shapes, so
+            # the jit caches — and the persistent compilation cache — hit
+            # across scenes
+            f_pad = bucket_size(tensors.num_frames, max(cfg.frame_pad_multiple, 1))
+            n_pad = bucket_size(n_real, max(cfg.point_chunk, 1))
+            tensors = pad_scene_tensors(tensors, f_pad, n_pad)
+            from maskclustering_tpu.utils.compile_cache import record_shape_bucket
 
-        record_shape_bucket("scene", k_max, f_pad, n_pad)
-        assoc = associate_scene_tensors(tensors, cfg, k_max=k_max)
-    mask_valid_host = np.asarray(assoc.mask_valid)
-    timings["associate"] = time.perf_counter() - t0
+            record_shape_bucket("scene", k_max, f_pad, n_pad)
+            sp.set(f_pad=f_pad, n_pad=n_pad)
+            assoc = associate_scene_tensors(tensors, cfg, k_max=k_max)
+            sp.sync(assoc.mask_valid)
+        mask_valid_host = np.asarray(assoc.mask_valid)
+    timings["associate"] = sp.duration
 
-    t0 = time.perf_counter()
-    table = build_mask_table(mask_valid_host, pad_multiple=cfg.mask_pad_multiple)
-    stats = compute_graph_stats(
-        assoc.mask_of_point,
-        assoc.boundary,
-        jnp.asarray(table.frame),
-        jnp.asarray(table.mask_id),
-        jnp.asarray(table.valid),
-        k_max=k_max,
-        point_chunk=cfg.point_chunk,
-        mask_visible_threshold=cfg.mask_visible_threshold,
-        contained_threshold=cfg.contained_threshold,
-        undersegment_filter_threshold=cfg.undersegment_filter_threshold,
-        big_mask_point_count=cfg.big_mask_point_count,
-    )
-    schedule = observer_schedule(stats.observer_hist,
-                                 max_len=cfg.max_cluster_iterations)
-    timings["graph"] = time.perf_counter() - t0
+    with tracer.span("graph", scene=seq_name) as sp:
+        table = build_mask_table(mask_valid_host, pad_multiple=cfg.mask_pad_multiple)
+        sp.set(m_pad=table.m_pad)
+        stats = compute_graph_stats(
+            assoc.mask_of_point,
+            assoc.boundary,
+            jnp.asarray(table.frame),
+            jnp.asarray(table.mask_id),
+            jnp.asarray(table.valid),
+            k_max=k_max,
+            point_chunk=cfg.point_chunk,
+            mask_visible_threshold=cfg.mask_visible_threshold,
+            contained_threshold=cfg.contained_threshold,
+            undersegment_filter_threshold=cfg.undersegment_filter_threshold,
+            big_mask_point_count=cfg.big_mask_point_count,
+        )
+        schedule = observer_schedule(stats.observer_hist,
+                                     max_len=cfg.max_cluster_iterations)
+        sp.sync(stats)
+    timings["graph"] = sp.duration
 
-    t0 = time.perf_counter()
-    active = jnp.asarray(table.valid) & ~stats.undersegment
-    result = iterative_clustering(
-        stats.visible, stats.contained, active, jnp.asarray(schedule),
-        view_consensus_threshold=cfg.view_consensus_threshold,
-    )
-    assignment = np.asarray(result.assignment)
-    timings["cluster"] = time.perf_counter() - t0
+    with tracer.span("cluster", scene=seq_name) as sp:
+        active = jnp.asarray(table.valid) & ~stats.undersegment
+        result = iterative_clustering(
+            stats.visible, stats.contained, active, jnp.asarray(schedule),
+            view_consensus_threshold=cfg.view_consensus_threshold,
+        )
+        assignment = np.asarray(sp.sync(result.assignment))
+        obs.count_transfer("d2h", assignment.nbytes, "cluster")
+    timings["cluster"] = sp.duration
 
-    t0 = time.perf_counter()
-    post_timings: Dict[str, float] = {}
-    from maskclustering_tpu.models.postprocess_device import run_postprocess
+    with tracer.span("postprocess", scene=seq_name) as sp:
+        post_timings: Dict[str, float] = {}
+        from maskclustering_tpu.models.postprocess_device import run_postprocess
 
-    objects = run_postprocess(
-        cfg, tensors.scene_points, assoc.first_id, assoc.last_id,
-        table.frame, table.mask_id, active, assignment, result.node_visible,
-        tensors.frame_ids, k_max=k_max, timings=post_timings, n_real=n_real)
-    timings["postprocess"] = time.perf_counter() - t0
-    timings.update({f"post.{k}": v for k, v in post_timings.items()})
+        objects = run_postprocess(
+            cfg, tensors.scene_points, assoc.first_id, assoc.last_id,
+            table.frame, table.mask_id, active, assignment, result.node_visible,
+            tensors.frame_ids, k_max=k_max, timings=post_timings, n_real=n_real)
+    timings["postprocess"] = sp.duration
+    for k, v in post_timings.items():
+        # phase wall times measured by the postprocess _PhaseTimer become
+        # child spans of "postprocess": same event schema, no double-timing
+        obs.record_span(f"post.{k}", v, parent="postprocess")
+        timings[f"post.{k}"] = v
 
     if export:
         if seq_name is None or object_dict_dir is None:

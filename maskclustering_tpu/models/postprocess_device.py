@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-
+from maskclustering_tpu import obs
 from maskclustering_tpu.models.postprocess import (
     SceneObjects,
     _merge_overlapping,
@@ -84,10 +84,19 @@ def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
             mask_id, mask_active, assignment, jnp.asarray(node_visible),
             frame_ids, **kwargs)
     else:
-        first_h = np.asarray(first)
+        with obs.span("post.host_pull") as sp:
+            # the host path pulls the full (F, N) claim tensors — the very
+            # transfer the device path exists to avoid; on the books so a
+            # report makes the paths' cost difference legible
+            first_h = np.asarray(first)
+            last_h = np.asarray(last)
+            nv_h = np.asarray(node_visible)
+            obs.count_transfer(
+                "d2h", first_h.nbytes + last_h.nbytes + nv_h.nbytes,
+                "postprocess")
         objects = postprocess_scene(
-            scene_points, first_h, np.asarray(last), first_h > 0, mask_frame,
-            mask_id, mask_active, assignment, np.asarray(node_visible),
+            scene_points, first_h, last_h, first_h > 0, mask_frame,
+            mask_id, mask_active, assignment, nv_h,
             frame_ids, **kwargs)
     if n_real is not None and objects.num_points != n_real:
         for pids in objects.point_ids_list:
@@ -342,10 +351,18 @@ def postprocess_scene_device(
 
     record_shape_bucket("post.nodestats", r_pad, m_pad, f, n, k2)
 
-    claimed_p, ratio_p, nv_rep_d = _node_stats_kernel(
-        first, last, jnp.asarray(rep_tab), node_visible,
-        jnp.asarray(live_slots), jnp.asarray(live_valid),
-        r_pad=r_pad, point_filter_threshold=float(point_filter_threshold))
+    # The round-5 open question — is post.claims kernel time or transfer
+    # time? — is answered by fencing the two halves separately: with obs
+    # armed, the kernel span syncs on the kernel outputs (pure device
+    # compute) and the pull span owns only the device->host DMA + unpack.
+    # Disarmed, both spans are timing-only no-ops with NO extra sync, so
+    # the async-dispatch overlap this phase depends on is preserved.
+    with obs.span("post.claims.kernel", r_pad=r_pad, m_pad=m_pad,
+                  f=f, n=n) as sp:
+        claimed_p, ratio_p, nv_rep_d = sp.sync(_node_stats_kernel(
+            first, last, jnp.asarray(rep_tab), node_visible,
+            jnp.asarray(live_slots), jnp.asarray(live_valid),
+            r_pad=r_pad, point_filter_threshold=float(point_filter_threshold)))
     # device->host transfers dominate this phase on a narrow link (the
     # driver rig's tunnel moves ~2-3 MB/s; a TPU-VM's PCIe makes them
     # ~free). Two cuts: pull only the len(reps) live rows of the
@@ -356,13 +373,18 @@ def postprocess_scene_device(
     # this backend, so a threaded "overlap" serialized the dbscan stage's
     # Python loops — post.dbscan 0.11 -> 2.0 s measured on the driver rig).
     r_live = len(reps)
-    claimed = _unpack_bits(np.asarray(claimed_p[:r_pull]), n)
-    ratio_sliced = ratio_p[:r_pull]
-    try:
-        ratio_sliced.copy_to_host_async()
-    except AttributeError:  # backend without async host copies
-        pass
-    nv_any = np.asarray(nv_rep_d[:r_pull])[:r_live].any(axis=1)
+    with obs.span("post.claims.pull", r_pull=r_pull) as sp:
+        claimed_host = np.asarray(claimed_p[:r_pull])
+        claimed = _unpack_bits(claimed_host, n)
+        ratio_sliced = ratio_p[:r_pull]
+        try:
+            ratio_sliced.copy_to_host_async()
+        except AttributeError:  # backend without async host copies
+            pass
+        nv_host = np.asarray(nv_rep_d[:r_pull])
+        nv_any = nv_host[:r_live].any(axis=1)
+        obs.count_transfer(
+            "d2h", claimed_host.nbytes + nv_host.nbytes, "post.claims")
     t.mark("claims")
 
     # ---- DBSCAN split per live rep (host, native C++/sklearn) ----
@@ -427,12 +449,15 @@ def postprocess_scene_device(
                  + np.clip(mask_id, 0, k2 - 1)).astype(np.int32)
     mask_flat[~alive] = 0
 
-    best_group_d, best_count_d = _mask_group_counts_kernel(
-        first, last, jnp.asarray(pt_ids), jnp.asarray(pt_grp),
-        jnp.asarray(mask_flat), jnp.asarray(glo), jnp.asarray(ghi),
-        k2=k2, s_pad=s_pad)
+    with obs.span("post.mask_assign.kernel", s_pad=s_pad, c_pad=c_pad) as sp:
+        best_group_d, best_count_d = sp.sync(_mask_group_counts_kernel(
+            first, last, jnp.asarray(pt_ids), jnp.asarray(pt_grp),
+            jnp.asarray(mask_flat), jnp.asarray(glo), jnp.asarray(ghi),
+            k2=k2, s_pad=s_pad))
     best_group = np.asarray(best_group_d)
     best_count = np.asarray(best_count_d)
+    obs.count_transfer("d2h", best_group.nbytes + best_count.nbytes,
+                       "post.mask_assign")
     t.mark("mask_assign")
 
     # ---- assemble mask lists per global group (ascending mask order) ----
@@ -449,7 +474,9 @@ def postprocess_scene_device(
     # ---- emit candidate objects (same order/filters as the host path) ----
     # the async host copy started after the claims pull is resident (or
     # nearly so) by now; this materializes it without re-transfer
-    ratio_ok = _unpack_bits(np.asarray(ratio_sliced), n)
+    ratio_host = np.asarray(ratio_sliced)
+    obs.count_transfer("d2h", ratio_host.nbytes, "post.emit")
+    ratio_ok = _unpack_bits(ratio_host, n)
     total_point_ids: List[np.ndarray] = []
     total_bboxes: List[Tuple[np.ndarray, np.ndarray]] = []
     total_masks: List[List[Tuple]] = []

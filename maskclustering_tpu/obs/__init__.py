@@ -1,0 +1,152 @@
+"""Unified tracing + metrics for the pipeline (spans, counters, JSONL).
+
+One import point for all instrumentation call sites::
+
+    from maskclustering_tpu import obs
+
+    with obs.span("graph", scene=seq, m_pad=m_pad) as sp:
+        stats = compute_graph_stats(...)
+        sp.sync(stats)            # device time charged to THIS span
+
+    obs.count_transfer("d2h", planes.nbytes, "post.claims")
+
+Disabled (the default) everything routes to a no-op tracer singleton:
+``span`` returns a shared null span whose ``sync`` does NOT touch the
+device — instrumented code has zero extra syncs and no event I/O, so
+honest-shape bench numbers are unaffected. ``configure(path)`` arms the
+real tracer: spans fence at their boundaries, every span/metrics flush
+appends one schema-versioned JSON line to ``path``, and live HBM is
+sampled at span ends. Render/diff captured files with::
+
+    python -m maskclustering_tpu.obs.report events.jsonl [--diff other.jsonl]
+
+Modules: tracer (spans + fencing), metrics (registry), events (JSONL
+sink/reader), report (CLI).
+"""
+
+from __future__ import annotations
+
+import atexit
+from typing import Optional
+
+from maskclustering_tpu.obs.events import SCHEMA_VERSION, EventSink, read_events
+from maskclustering_tpu.obs.metrics import (count, count_transfer, gauge,
+                                            gauge_max, observe, registry,
+                                            sample_hbm)
+from maskclustering_tpu.obs.tracer import NULL_TRACER, NullTracer, Span, Tracer
+
+__all__ = [
+    "configure", "disable", "enabled", "events_path", "get_tracer",
+    "scene_tracer", "span", "record_span", "traced", "flush_metrics",
+    "count", "count_transfer", "gauge", "gauge_max", "observe", "registry",
+    "sample_hbm", "read_events", "EventSink", "Tracer", "NullTracer",
+    "Span", "NULL_TRACER", "SCHEMA_VERSION",
+]
+
+_active = NULL_TRACER
+_sink: Optional[EventSink] = None
+# timing-only fallback: run_scene's per-stage timings dict must exist with
+# or without obs, so scene_tracer() never returns the null tracer — but
+# this one never fences, emits, or samples (sink=None disables all three)
+_TIMING_TRACER = Tracer(sink=None)
+
+
+def configure(path: str, *, fence: bool = True, annotations: bool = False,
+              sample_memory: bool = True, meta: Optional[dict] = None,
+              truncate: bool = False) -> Tracer:
+    """Arm tracing: spans + metrics flushes append to the JSONL at ``path``.
+
+    Idempotent per path; re-configuring to a new path closes the old sink.
+    Writes one ``meta`` event up front (schema version + caller context) so
+    a report can label the run without side-channel files.
+
+    ``truncate``: start the file fresh. For callers that OWN the path and
+    re-derive it per run (run.py's --report default) — mixing a rerun's
+    spans into a stale capture would silently skew every percentile. Leave
+    False when several processes share one file by design (bench worker
+    attempts + supervisor).
+    """
+    global _active, _sink
+    if _sink is not None and _sink.path == path and isinstance(_active, Tracer):
+        return _active
+    disable()
+    _sink = EventSink(path, truncate=truncate)
+    # NO jax probe here: ``jax.default_backend()`` initializes the backend,
+    # and configure() must stay safe in chip-free processes (bench.py's
+    # supervisor). Callers that know the backend pass it via ``meta``.
+    payload = {"schema": SCHEMA_VERSION}
+    if meta:
+        payload.update(meta)
+    _sink.emit("meta", payload)
+    _active = Tracer(_sink, fence=fence, annotations=annotations,
+                     sample_memory=sample_memory)
+    return _active
+
+
+def disable() -> None:
+    """Back to the zero-cost singleton; flushes and closes any open sink."""
+    global _active, _sink
+    if _sink is not None:
+        try:
+            _active.flush_metrics()
+        except Exception:  # noqa: BLE001
+            pass
+        _sink.close()
+        _sink = None
+    _active = NULL_TRACER
+
+
+atexit.register(disable)  # final metrics flush on clean interpreter exit
+
+
+def enabled() -> bool:
+    return _active is not NULL_TRACER
+
+
+def events_path() -> Optional[str]:
+    return _sink.path if _sink is not None else None
+
+
+def get_tracer():
+    """The active tracer: a real ``Tracer`` when armed, else the no-op
+    singleton. Library instrumentation goes through this (or the
+    module-level ``span``/``traced`` shortcuts)."""
+    return _active
+
+
+def scene_tracer() -> Tracer:
+    """The tracer ``run_scene`` times its stages with: the armed tracer
+    when obs is on, else a shared timing-only tracer (no fence, no events)
+    so ``SceneResult.timings`` exists either way."""
+    return _active if isinstance(_active, Tracer) else _TIMING_TRACER
+
+
+def span(name: str, **attrs):
+    return _active.span(name, **attrs)
+
+
+def record_span(name: str, seconds: float, **kw) -> None:
+    _active.record_span(name, seconds, **kw)
+
+
+def traced(name: str, **attrs):
+    """Decorator: trace every call of the wrapped function as one span.
+
+    Late-binds the active tracer so functions decorated at import time
+    still pick up a tracer configured afterwards.
+    """
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with _active.span(name, **attrs):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def flush_metrics() -> None:
+    _active.flush_metrics()

@@ -1,0 +1,165 @@
+"""Process-local metrics registry: counters, gauges, histograms.
+
+Covers the counters the tree previously had no home for: compile-cache
+bucket hits/misses (utils/compile_cache.py), host<->device bytes per stage
+(io/feed.py, models/*), scene/worker retry and failure counts (run.py,
+bench.py), and live-HBM gauges sampled at span ends (obs/tracer.py).
+
+Design constraints, in order:
+
+1. **zero-cost when idle** — a counter bump is one dict lookup + add; no
+   locks on the hot path beyond a plain dict (CPython dict ops are atomic
+   enough for monotonic counters; the registry is process-local, and the
+   only concurrent writers are the prefetch daemon threads whose bumps
+   are independent keys).
+2. **flat names** — ``h2d.bytes.feed`` not nested objects, so a snapshot
+   is one JSON-able dict and a diff is set arithmetic.
+3. **bounded memory** — histograms keep a capped reservoir (deterministic
+   stride-decimation, not random sampling: reproducible percentiles).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+_HIST_CAP = 4096  # per-histogram value cap before stride decimation
+
+
+class Histogram:
+    """Value series with bounded memory and exact-until-capped percentiles."""
+
+    __slots__ = ("values", "count", "total", "_stride", "_skip")
+
+    def __init__(self):
+        self.values: List[float] = []
+        self.count = 0
+        self.total = 0.0
+        self._stride = 1
+        self._skip = 0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self._skip += 1
+        if self._skip >= self._stride:
+            self._skip = 0
+            self.values.append(value)
+            if len(self.values) >= _HIST_CAP:
+                # decimate deterministically: keep every other sample and
+                # double the stride — percentiles stay representative while
+                # memory stays O(cap) over arbitrarily long runs
+                self.values = self.values[::2]
+                self._stride *= 2
+
+    def percentile(self, q: float) -> Optional[float]:
+        if not self.values:
+            return None
+        vals = sorted(self.values)
+        idx = min(int(q / 100.0 * len(vals)), len(vals) - 1)
+        return vals[idx]
+
+    def summary(self) -> Dict:
+        return {
+            "count": self.count,
+            "total": self.total,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "max": max(self.values) if self.values else None,
+        }
+
+
+class Registry:
+    """Flat-namespace counters/gauges/histograms with one snapshot call."""
+
+    def __init__(self):
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()  # structure mutations only
+
+    # -- write paths (hot) --------------------------------------------------
+    def count(self, name: str, delta: float = 1.0) -> None:
+        self._counters[name] = self._counters.get(name, 0.0) + delta
+
+    def gauge(self, name: str, value: float) -> None:
+        self._gauges[name] = float(value)
+
+    def gauge_max(self, name: str, value: float) -> None:
+        """High-water gauge: keeps the max ever seen (HBM high-water)."""
+        cur = self._gauges.get(name)
+        if cur is None or value > cur:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(name, Histogram())
+        h.observe(float(value))
+
+    # -- read paths ---------------------------------------------------------
+    def histogram(self, name: str) -> Optional[Histogram]:
+        return self._hists.get(name)
+
+    def snapshot(self) -> Dict:
+        """One JSON-able dict of everything; cheap enough to flush per scene."""
+        return {
+            "counters": dict(self._counters),
+            "gauges": dict(self._gauges),
+            "histograms": {k: h.summary() for k, h in self._hists.items()},
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    return _REGISTRY
+
+
+# module-level conveniences: the instrumentation call sites read better as
+# obs.count("...") than obs.registry().count("...")
+count = _REGISTRY.count
+gauge = _REGISTRY.gauge
+gauge_max = _REGISTRY.gauge_max
+observe = _REGISTRY.observe
+
+
+def count_transfer(direction: str, nbytes: int, stage: str) -> None:
+    """Account one host<->device transfer: per-stage + total counters.
+
+    direction: "h2d" or "d2h". Call sites pass nbytes from the host-side
+    buffer (``arr.nbytes``); this measures payload, not link framing.
+    """
+    _REGISTRY.count(f"{direction}.bytes.{stage}", float(nbytes))
+    _REGISTRY.count(f"{direction}.bytes", float(nbytes))
+
+
+def sample_hbm() -> Optional[Dict[str, float]]:
+    """Live device-memory stats of device 0, or None when unavailable.
+
+    ``memory_stats()`` is a host-side query (no device sync, safe at span
+    ends); CPU backends return None or {} — both map to None here.
+    """
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — no backend / no stats support
+        return None
+    if not stats:
+        return None
+    out = {k: float(v) for k, v in stats.items()
+           if isinstance(v, (int, float))}
+    in_use = out.get("bytes_in_use")
+    if in_use is not None:
+        _REGISTRY.gauge("hbm.bytes_in_use", in_use)
+        _REGISTRY.gauge_max("hbm.high_water_bytes", in_use)
+    return out or None

@@ -1,0 +1,212 @@
+"""Run-report CLI over obs JSONL event files.
+
+    python -m maskclustering_tpu.obs.report events.jsonl
+    python -m maskclustering_tpu.obs.report new.jsonl --diff old.jsonl
+
+Renders per-stage span tables — count, p50/p95 wall, device (fenced sync)
+vs host split, per-stage host<->device bytes, HBM high-water — and diffs
+two runs stage by stage. This makes ``BENCH_*.json`` and ``run_report``
+captures self-explaining: the post.claims kernel-vs-transfer split is a
+by-product of any run with obs armed, not a bespoke diagnostic script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from maskclustering_tpu.obs.events import KIND_METRICS, KIND_SPAN, read_events
+
+
+class RunData:
+    """Parsed event file: ordered span series + final metrics snapshot."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.meta: Dict = {}
+        self.spans: Dict[str, List[Dict]] = {}  # name -> span events, in order
+        self.order: List[str] = []
+        self.hbm_high_water: Optional[float] = None
+        metrics_by_pid: Dict = {}  # counters are monotonic PER PROCESS:
+        # keep each pid's last flush, then sum counters across pids (one
+        # file can hold several worker attempts plus the supervisor)
+        for ev in read_events(path):
+            kind = ev.get("kind")
+            if kind == "meta" and not self.meta:
+                self.meta = {k: v for k, v in ev.items()
+                             if k not in ("v", "kind", "ts", "pid")}
+            elif kind == KIND_SPAN:
+                name = ev.get("name")
+                if not isinstance(name, str):
+                    continue
+                if name not in self.spans:
+                    self.spans[name] = []
+                    self.order.append(name)
+                self.spans[name].append(ev)
+                mem = ev.get("mem") or {}
+                in_use = mem.get("bytes_in_use")
+                if in_use is not None and (self.hbm_high_water is None
+                                           or in_use > self.hbm_high_water):
+                    self.hbm_high_water = float(in_use)
+            elif kind == KIND_METRICS:
+                metrics_by_pid[ev.get("pid")] = ev.get("metrics") or {}
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        for m in metrics_by_pid.values():
+            for k, v in (m.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0.0) + v
+            for k, v in (m.get("gauges") or {}).items():
+                # max across processes: correct for the high-water/bucket
+                # gauges this subsystem emits (all are "largest seen" style)
+                if k not in gauges or v > gauges[k]:
+                    gauges[k] = v
+        hw = gauges.get("hbm.high_water_bytes")
+        if hw is not None and (self.hbm_high_water is None
+                               or hw > self.hbm_high_water):
+            self.hbm_high_water = float(hw)
+        self._counters = counters
+        self._gauges = gauges
+
+    def stage_rows(self) -> List[Dict]:
+        """One aggregate row per span name, in first-appearance order."""
+        rows = []
+        for name in self.order:
+            evs = self.spans[name]
+            durs = sorted(float(e.get("dur_s", 0.0)) for e in evs)
+            syncs = sorted(float(e.get("sync_s", 0.0)) for e in evs)
+            rows.append({
+                "stage": name,
+                "count": len(evs),
+                "total_s": sum(durs),
+                "p50_s": _pct(durs, 50),
+                "p95_s": _pct(durs, 95),
+                "device_p50_s": _pct(syncs, 50),
+                "host_p50_s": max(_pct(durs, 50) - _pct(syncs, 50), 0.0),
+                "h2d_bytes": self._counters.get(f"h2d.bytes.{name}"),
+                "d2h_bytes": self._counters.get(f"d2h.bytes.{name}"),
+            })
+        return rows
+
+    def summary(self) -> Dict:
+        """JSON-able digest for embedding (run_report.json / bench line)."""
+        return {
+            "events": self.path,
+            "stages": {r["stage"]: {"count": r["count"],
+                                    "p50_s": round(r["p50_s"], 4),
+                                    "p95_s": round(r["p95_s"], 4),
+                                    "device_p50_s": round(r["device_p50_s"], 4)}
+                       for r in self.stage_rows()},
+            "hbm_high_water_bytes": self.hbm_high_water,
+            "h2d_bytes": self._counters.get("h2d.bytes"),
+            "d2h_bytes": self._counters.get("d2h.bytes"),
+            "counters": {k: v for k, v in sorted(self._counters.items())
+                         if k.startswith(("run.", "bench.", "compile_cache."))},
+        }
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q / 100.0 * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v:.3f}"
+
+
+def _fmt_bytes(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(v) < 1024 or unit == "TB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024.0
+    return "-"  # unreachable
+
+
+def _render(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    fmt_row = lambda cells: "  ".join(c.ljust(w) if i == 0 else c.rjust(w)  # noqa: E731
+                                      for i, (c, w) in enumerate(zip(cells, widths)))
+    lines = [fmt_row(headers), fmt_row(["-" * w for w in widths])]
+    lines += [fmt_row(r) for r in rows]
+    return "\n".join(lines)
+
+
+def render_report(run: RunData) -> str:
+    rows = [[r["stage"], str(r["count"]), _fmt_s(r["p50_s"]), _fmt_s(r["p95_s"]),
+             _fmt_s(r["device_p50_s"]), _fmt_s(r["host_p50_s"]),
+             _fmt_s(r["total_s"]), _fmt_bytes(r["h2d_bytes"]),
+             _fmt_bytes(r["d2h_bytes"])]
+            for r in run.stage_rows()]
+    out = [f"== obs report: {run.path} =="]
+    if run.meta:
+        out.append("meta: " + json.dumps(run.meta, sort_keys=True))
+    out.append(_render(
+        ["stage", "n", "p50[s]", "p95[s]", "dev.p50[s]", "host.p50[s]",
+         "total[s]", "h2d", "d2h"], rows))
+    tail = []
+    if run.hbm_high_water is not None:
+        tail.append(f"HBM high-water: {_fmt_bytes(run.hbm_high_water)}")
+    for d in ("h2d", "d2h"):
+        total = run._counters.get(f"{d}.bytes")
+        if total is not None:
+            tail.append(f"{d} total: {_fmt_bytes(total)}")
+    hits = {k: v for k, v in run._counters.items()
+            if k.startswith("compile_cache.")}
+    if hits:
+        tail.append("compile cache: " + ", ".join(
+            f"{k.split('.', 1)[1]}={int(v)}" for k, v in sorted(hits.items())))
+    if tail:
+        out.append(" | ".join(tail))
+    return "\n".join(out)
+
+
+def render_diff(run_a: RunData, run_b: RunData) -> str:
+    """Stage-by-stage p50 diff: A (the file argument) vs B (--diff)."""
+    rows_a = {r["stage"]: r for r in run_a.stage_rows()}
+    rows_b = {r["stage"]: r for r in run_b.stage_rows()}
+    names = list(run_a.order) + [n for n in run_b.order if n not in rows_a]
+    rows = []
+    for name in names:
+        a, b = rows_a.get(name), rows_b.get(name)
+        pa = a["p50_s"] if a else None
+        pb = b["p50_s"] if b else None
+        if pa is not None and pb is not None and pb > 0:
+            delta = f"{100.0 * (pa - pb) / pb:+.1f}%"
+        else:
+            delta = "-"
+        rows.append([name, _fmt_s(pa), _fmt_s(pb), delta])
+    head = [f"== obs diff: A={run_a.path}  B={run_b.path} =="]
+    return "\n".join(head + [_render(["stage", "A p50[s]", "B p50[s]", "A vs B"],
+                                     rows)])
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m maskclustering_tpu.obs.report",
+        description="render / diff obs JSONL event captures")
+    p.add_argument("events", help="events.jsonl written by an obs-armed run")
+    p.add_argument("--diff", default=None,
+                   help="second events.jsonl to diff against (B side)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable summary instead of tables")
+    args = p.parse_args(argv)
+
+    run = RunData(args.events)
+    if args.json:
+        print(json.dumps(run.summary(), indent=2))
+        return 0
+    print(render_report(run))
+    if args.diff:
+        print()
+        print(render_diff(run, RunData(args.diff)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

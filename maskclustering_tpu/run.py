@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from maskclustering_tpu import obs
 from maskclustering_tpu.config import PipelineConfig, load_config
 from maskclustering_tpu.datasets import get_dataset
 from maskclustering_tpu.semantics.vocab import vocab_name
@@ -78,6 +79,10 @@ class RunReport:
     # machine-checked environment fact: local CLIP checkpoint dir, or None
     # (the reference downloads ViT-H-14 at run time; no egress here)
     clip_checkpoint: Optional[str] = None
+    # obs digest (per-stage p50/p95, transfer bytes, HBM high-water) plus
+    # the events.jsonl path — render/diff it with
+    # ``python -m maskclustering_tpu.obs.report <events>``
+    obs: Optional[Dict] = None
 
     @property
     def failed(self) -> List[SceneStatus]:
@@ -96,6 +101,7 @@ class RunReport:
                 "scenes": [dataclasses.asdict(s) for s in self.scenes],
                 "step_errors": self.step_errors,
                 "clip_checkpoint": self.clip_checkpoint,
+                "obs": self.obs,
             }, f, indent=2)
 
 
@@ -222,16 +228,19 @@ def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
         ds, tensors = (_preloaded() if _preloaded is not None
                        else _load_for_cluster(cfg, seq_name, resume, prediction_root))
         if tensors is None:
+            obs.count("run.scenes_skipped")
             return SceneStatus(seq_name, "skipped")
         result = run_scene(tensors, cfg, seq_name=seq_name, export=True,
                            object_dict_dir=ds.object_dict_dir,
                            prediction_root=prediction_root)
+        obs.count("run.scenes_ok")
         return SceneStatus(seq_name, "ok", time.perf_counter() - t0,
                            num_objects=len(result.objects.point_ids_list),
                            timings={k: round(v, 4)
                                     for k, v in result.timings.items()})
     except Exception:
         log.exception("scene %s failed", seq_name)
+        obs.count("run.scenes_failed")
         return SceneStatus(seq_name, "failed", time.perf_counter() - t0,
                            error=traceback.format_exc(limit=20))
 
@@ -321,6 +330,7 @@ def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
         except Exception:
             log.exception("mesh batch %s failed", [b[0] for b in batch])
             err = traceback.format_exc(limit=20)
+            obs.count("run.scenes_failed", len(batch))
             for seq, _, _ in batch:
                 statuses[seq] = SceneStatus(seq, "failed", time.perf_counter() - t0,
                                             error=err)
@@ -331,10 +341,12 @@ def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
                 export_artifacts(objects, seq, cfg.config_name, ds.object_dict_dir,
                                  prediction_root=prediction_root,
                                  top_k_repre=cfg.num_representative_masks)
+                obs.count("run.scenes_ok")
                 statuses[seq] = SceneStatus(seq, "ok", per_scene,
                                             num_objects=len(objects.point_ids_list))
             except Exception:
                 log.exception("scene %s export failed", seq)
+                obs.count("run.scenes_failed")
                 statuses[seq] = SceneStatus(seq, "failed", per_scene,
                                             error=traceback.format_exc(limit=20))
 
@@ -558,10 +570,52 @@ def run_pipeline(
     mask_predictor=None,
     profile_dir: Optional[str] = None,
     report_path: Optional[str] = None,
+    obs_events: Optional[str] = None,
 ) -> RunReport:
     unknown = set(steps) - set(ALL_STEPS)
     if unknown:
         raise ValueError(f"unknown steps {sorted(unknown)}; valid: {ALL_STEPS}")
+    if obs_events:
+        # arm span/metrics capture for the whole run: every run_scene stage
+        # span and transfer counter lands in the JSONL, and the report below
+        # embeds the digest — production runs self-report their timing.
+        # truncate: this call owns the path (typically derived from
+        # --report, which is itself overwritten); appending to a previous
+        # run's capture would silently pool stale spans into the digest
+        obs.configure(obs_events, annotations=bool(profile_dir), truncate=True,
+                      meta={"tool": "run", "config": cfg.config_name})
+        try:
+            return _run_pipeline_body(
+                cfg, seq_names, steps=steps, workers=workers, resume=resume,
+                encoder_spec=encoder_spec, mask_command=mask_command,
+                mask_predictor=mask_predictor, profile_dir=profile_dir,
+                report_path=report_path, obs_events=obs_events)
+        finally:
+            # a step/encoder exception must not leave the global tracer
+            # armed (fences on, sink open) for the rest of the process —
+            # this call armed it, this call disarms it on every path
+            obs.disable()
+    return _run_pipeline_body(
+        cfg, seq_names, steps=steps, workers=workers, resume=resume,
+        encoder_spec=encoder_spec, mask_command=mask_command,
+        mask_predictor=mask_predictor, profile_dir=profile_dir,
+        report_path=report_path, obs_events=None)
+
+
+def _run_pipeline_body(
+    cfg: PipelineConfig,
+    seq_names: Sequence[str],
+    *,
+    steps: Sequence[str],
+    workers: int,
+    resume: bool,
+    encoder_spec: str,
+    mask_command: Optional[str],
+    mask_predictor,
+    profile_dir: Optional[str],
+    report_path: Optional[str],
+    obs_events: Optional[str],
+) -> RunReport:
     from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
 
     setup_compilation_cache(cfg.compilation_cache_dir)
@@ -647,6 +701,16 @@ def run_pipeline(
             timed("top_images", lambda: top_images_step(
                 cfg, seq_names, resume=resume, scene_points_cache=pts_cache))
 
+    if obs_events and obs.enabled():
+        obs.flush_metrics()
+        try:
+            from maskclustering_tpu.obs.report import RunData
+
+            report.obs = RunData(obs_events).summary()
+        except Exception:  # noqa: BLE001 — a digest failure must not fail the run
+            log.exception("obs digest failed for %s", obs_events)
+            report.obs = {"events": obs_events}
+        # run_pipeline's finally disarms; nothing more to do here
     if report_path:
         report.save(report_path)
     return report
@@ -688,6 +752,12 @@ def main(argv=None) -> int:
     parser.add_argument("--profile_dir", default=None,
                         help="write a jax.profiler trace of the cluster step here")
     parser.add_argument("--report", default=None, help="run report JSON path")
+    parser.add_argument("--obs_events", default=None,
+                        help="obs span/metrics JSONL path (default: derived "
+                             "from --report; render with "
+                             "python -m maskclustering_tpu.obs.report)")
+    parser.add_argument("--no-obs", action="store_true",
+                        help="disable obs capture even when --report is set")
     parser.add_argument("--data_root", default=None,
                         help="override the config's data root")
     parser.add_argument("--init_timeout", type=float, default=120.0,
@@ -704,6 +774,15 @@ def main(argv=None) -> int:
     seq_names = get_seq_name_list(cfg.dataset, args.splits_dir, args.seq_name_list)
     log.info("there are %d scenes", len(seq_names))
 
+    obs_events = args.obs_events
+    if obs_events is None and args.report:
+        # a reported run captures events by default: the report JSON then
+        # carries the digest and the path to the full span stream
+        root, _ = os.path.splitext(args.report)
+        obs_events = root + "_events.jsonl"
+    if args.no_obs:
+        obs_events = None
+
     t0 = time.time()
     report = run_pipeline(
         cfg, seq_names,
@@ -714,6 +793,7 @@ def main(argv=None) -> int:
         mask_command=args.mask_command,
         profile_dir=args.profile_dir,
         report_path=args.report,
+        obs_events=obs_events,
     )
     total = time.time() - t0
     log.info("total time %.1f min (%.1f s/scene)", total / 60,

@@ -78,11 +78,21 @@ def bucket_size(value: int, multiple: int) -> int:
 
 
 def record_shape_bucket(kind: str, *bucket) -> bool:
-    """Record a jit shape bucket; returns True (and logs) if new."""
+    """Record a jit shape bucket; returns True (and logs) if new.
+
+    Doubles as the compile-cache hit-rate metric: a repeat bucket is a
+    guaranteed in-process jit-cache hit, a new one is (at best) a
+    persistent-cache deserialize and (at worst) a fresh compile.
+    """
+    from maskclustering_tpu import obs
+
     key = (kind, *bucket)
     if key in _SEEN_BUCKETS:
+        obs.count("compile_cache.bucket_hit")
         return False
     _SEEN_BUCKETS.add(key)
+    obs.count("compile_cache.bucket_new")
+    obs.gauge("compile_cache.distinct_buckets", len(_SEEN_BUCKETS))
     log.info("new %s shape bucket: %s", kind, bucket)
     return True
 

@@ -9,15 +9,22 @@
 # Env knobs (for smoke-testing the harness itself off-chip):
 #   MCT_PLATFORM=cpu  force a jax platform on every step
 #   MCT_QUICK=1       tiny shapes (validates plumbing, not performance)
+#   MCT_NO_OBS=1      disable the default obs span/metrics capture
 #
 # Steps, most valuable first (each writes OUTDIR/NAME.out + NAME.err):
 #   1. bench.py (honest shape, 5 repeats)      -> bench_default.out (JSON line)
+#      + obs events (default-armed)            -> bench_default_events.jsonl
 #   2. claims_diag (kernel vs tunnel split)    -> claims_diag.out
-#   3. bench.py --frame-batch 8 (A/B)          -> bench_fb8.out (JSON line)
-#   4. northstar sweep (multi-bucket, ~3 min)  -> northstar.out + NORTHSTAR_live.md
+#   3. fb_identity (frame-batch byte-identity  -> fb_identity.out
+#      on the LIVE backend; CPU-only pinned by tests until this runs)
+#   4. bench.py --frame-batch 8 (A/B)          -> bench_fb8.out (JSON line)
+#   5. northstar sweep (multi-bucket, ~3 min)  -> northstar.out + NORTHSTAR_live.md
+#   6. obs report render of the bench captures -> obs_report.out
 set -u
 cd "$(dirname "$0")/.."
-OUT=${1:-/tmp/chip_session_$(date -u +%H%M)}
+# date AND time in the default OUTDIR: same-minute sessions on later days
+# must not silently overwrite earlier captures
+OUT=${1:-/tmp/chip_session_$(date -u +%m%d_%H%M)}
 mkdir -p "$OUT"
 echo "[chip_session] output -> $OUT"
 
@@ -33,6 +40,16 @@ if [ -n "${MCT_QUICK:-}" ]; then
   TINY=("${DIAG_QUICK[@]}" --image-h 48 --image-w 64 --repeats 1 --spacing 0.08)
   NS_QUICK=(--quick)
 fi
+# obs capture armed by default: every bench step leaves a span/metrics JSONL
+# that `python -m maskclustering_tpu.obs.report` renders per-stage — the
+# kernel-vs-transfer split becomes a by-product of any session, not a
+# bespoke diagnostic that needs its own recovery window
+OBS_DEFAULT=(--obs-events "$OUT/bench_default_events.jsonl")
+OBS_FB8=(--obs-events "$OUT/bench_fb8_events.jsonl")
+if [ -n "${MCT_NO_OBS:-}" ]; then
+  OBS_DEFAULT=(--no-obs)
+  OBS_FB8=(--no-obs)
+fi
 
 run() { # run NAME TIMEOUT CMD...
   local name=$1 tmo=$2; shift 2
@@ -44,9 +61,17 @@ run() { # run NAME TIMEOUT CMD...
   return 0
 }
 
-run bench_default 900 python bench.py --retry-budget 300 --init-attempts 2 ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
+run bench_default 900 python bench.py --retry-budget 300 --init-attempts 2 "${OBS_DEFAULT[@]}" ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
 run claims_diag   600 python scripts/claims_diag.py ${PLAT[@]+"${PLAT[@]}"} ${DIAG_QUICK[@]+"${DIAG_QUICK[@]}"}
-run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8 ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
+run fb_identity   600 python scripts/fb_identity.py --frame-batch 8 ${PLAT[@]+"${PLAT[@]}"}
+run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8 "${OBS_FB8[@]}" ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
 run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md" ${PLAT[@]+"${PLAT[@]}"} ${NS_QUICK[@]+"${NS_QUICK[@]}"}
+if [ -z "${MCT_NO_OBS:-}" ] && [ -f "$OUT/bench_default_events.jsonl" ]; then
+  if [ -f "$OUT/bench_fb8_events.jsonl" ]; then
+    run obs_report 120 python -m maskclustering_tpu.obs.report "$OUT/bench_default_events.jsonl" --diff "$OUT/bench_fb8_events.jsonl"
+  else
+    run obs_report 120 python -m maskclustering_tpu.obs.report "$OUT/bench_default_events.jsonl"
+  fi
+fi
 echo "[chip_session] done; JSON lines:"
 grep -h '"value"' "$OUT"/bench_*.out 2>/dev/null

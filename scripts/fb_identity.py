@@ -1,0 +1,85 @@
+"""On-chip --frame-batch identity check (chip_session step).
+
+The ``association_frame_batch`` knob is pinned byte-identical by a
+CPU-backend test only (tests/test_backprojection.py
+test_frame_batch_matches_sequential); on TPU the batched path also flips
+``full_tile_table`` to the strip table, and cross-backend byte-identity of
+the float distance compares has never been measured on a live chip
+(ADVICE round 5). This runs the same A/B on whatever backend is live and
+prints one verdict line:
+
+    python scripts/fb_identity.py [--frame-batch 8] [--platform cpu]
+
+Exit 0 = byte-identical, 1 = mismatch (with the first differing field),
+2 = backend init failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--frames", type=int, default=24)
+    p.add_argument("--points", type=int, default=32768)
+    p.add_argument("--boxes", type=int, default=6)
+    p.add_argument("--frame-batch", type=int, default=8)
+    p.add_argument("--k-max", type=int, default=63)
+    p.add_argument("--distance-threshold", type=float, default=0.01)
+    p.add_argument("--spacing", type=float, default=0.025)
+    p.add_argument("--init-timeout", type=float, default=120.0)
+    p.add_argument("--platform", default=None)
+    args = p.parse_args()
+
+    from maskclustering_tpu.utils.backend_init import init_backend
+
+    try:
+        init_backend(args.platform, timeout_s=args.init_timeout,
+                     tag="fb_identity")
+    except Exception as e:  # noqa: BLE001 — one-line verdict contract
+        print(f"[fb_identity] FAIL: backend init: {e}", flush=True)
+        return 2
+
+    import jax
+    import numpy as np
+
+    from maskclustering_tpu.models.backprojection import associate_scene
+    from maskclustering_tpu.utils.synthetic import (make_scene_device,
+                                                    resize_scene_points)
+
+    tensors, _, _ = make_scene_device(
+        num_boxes=args.boxes, num_frames=args.frames,
+        image_hw=(96, 128), spacing=args.spacing, seed=3)
+    tensors.scene_points = resize_scene_points(tensors.scene_points,
+                                               args.points)
+    a = (np.asarray(tensors.scene_points), tensors.depths,
+         tensors.segmentations, np.asarray(tensors.intrinsics),
+         np.asarray(tensors.cam_to_world), np.asarray(tensors.frame_valid))
+    kw = dict(k_max=args.k_max, window=1,
+              distance_threshold=args.distance_threshold,
+              few_points_threshold=25, coverage_threshold=0.3)
+    seq = associate_scene(*a, frame_batch=1, **kw)
+    bat = associate_scene(*a, frame_batch=args.frame_batch, **kw)
+    for field in type(seq)._fields:
+        got = np.asarray(getattr(bat, field))
+        want = np.asarray(getattr(seq, field))
+        if not np.array_equal(got, want):
+            ndiff = int((got != want).sum())
+            print(f"[fb_identity] FAIL on {jax.default_backend()}: "
+                  f"{field} differs in {ndiff} cells at "
+                  f"frame_batch={args.frame_batch}", flush=True)
+            return 1
+    print(f"[fb_identity] OK: frame_batch={args.frame_batch} byte-identical "
+          f"to sequential on backend={jax.default_backend()} "
+          f"(F={args.frames}, N={args.points}, boxes={args.boxes})",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,329 @@
+"""Contract tests for the obs subsystem (tracer, metrics, events, report).
+
+Pins the properties the retrofit depends on: disabled mode is a true
+no-op (no events, NO device syncs), spans nest and carry attrs, the JSONL
+schema round-trips (including torn-final-line crash tolerance), counters
+aggregate per process, and the report CLI renders/diffs captures.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from maskclustering_tpu import obs
+from maskclustering_tpu.obs.metrics import Histogram, Registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Every test starts disarmed with an empty registry and ends the same,
+    so obs state never leaks between tests (the tracer/registry are
+    process-global by design)."""
+    obs.disable()
+    obs.registry().reset()
+    yield
+    obs.disable()
+    obs.registry().reset()
+
+
+class _SyncProbe:
+    """Pytree leaf that records block_until_ready calls (jax protocol)."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def block_until_ready(self):
+        self.calls += 1
+        return self
+
+
+# ---------------------------------------------------------------------------
+# no-op (disarmed) mode
+# ---------------------------------------------------------------------------
+
+
+def test_noop_mode_is_null_tracer_singleton():
+    assert obs.get_tracer() is obs.NULL_TRACER
+    assert not obs.enabled()
+    assert obs.events_path() is None
+
+
+def test_noop_mode_emits_nothing_and_never_syncs(tmp_path):
+    probe = _SyncProbe()
+    with obs.span("stage", scene="s0") as sp:
+        out = sp.sync(probe)
+    assert out is probe
+    assert probe.calls == 0, "disabled obs must not add device syncs"
+    # shared null span: no per-call allocation
+    assert obs.span("a") is obs.span("b")
+    obs.record_span("x", 1.0)
+    obs.flush_metrics()
+    assert list(tmp_path.iterdir()) == []  # nothing ever written anywhere
+
+
+def test_scene_tracer_times_without_emitting():
+    """run_scene's fallback: spans measure wall time but fence/emit nothing."""
+    tracer = obs.scene_tracer()
+    assert tracer.enabled and not tracer.fence
+    probe = _SyncProbe()
+    with tracer.span("stage") as sp:
+        time.sleep(0.01)
+        sp.sync(probe)
+    assert sp.duration >= 0.01
+    assert probe.calls == 0  # timing-only tracer never fences
+    assert obs.registry().snapshot()["histograms"] == {}  # and never aggregates
+
+
+# ---------------------------------------------------------------------------
+# armed mode: spans, nesting, fencing, events
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_timing_attrs_and_fencing(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    obs.configure(path, fence=True, sample_memory=False,
+                  meta={"tool": "test"})
+    assert obs.enabled() and obs.events_path() == path
+    probe = _SyncProbe()
+    with obs.span("outer", scene="s1", n_pad=2048) as outer:
+        with obs.span("inner") as inner:
+            time.sleep(0.012)
+            inner.set(k_max=63)
+            inner.sync(probe)
+    obs.record_span("post.claims", 0.25, parent="postprocess")
+    obs.disable()
+
+    assert probe.calls == 1, "armed fencing must block_until_ready"
+    events = list(obs.read_events(path))
+    metas = [e for e in events if e["kind"] == "meta"]
+    assert metas and metas[0]["tool"] == "test"
+    spans = {e["name"]: e for e in events if e["kind"] == "span"}
+    assert set(spans) == {"outer", "inner", "post.claims"}
+    assert spans["inner"]["parent"] == "outer"
+    assert spans["inner"]["depth"] == 1
+    assert spans["inner"]["attrs"] == {"k_max": 63}
+    assert spans["inner"]["dur_s"] >= 0.012
+    assert spans["outer"]["depth"] == 0
+    assert spans["outer"]["attrs"] == {"scene": "s1", "n_pad": 2048}
+    assert spans["outer"]["dur_s"] >= spans["inner"]["dur_s"]
+    assert spans["post.claims"]["dur_s"] == 0.25
+    assert spans["post.claims"]["parent"] == "postprocess"
+    # every event carries the schema envelope
+    for e in events:
+        assert e["v"] == obs.SCHEMA_VERSION
+        assert {"kind", "ts", "pid"} <= set(e)
+
+
+def test_traced_decorator_and_exception_attr(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    obs.configure(path, sample_memory=False)
+
+    @obs.traced("work", tag="deco")
+    def work(x):
+        return x * 2
+
+    assert work(21) == 42
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("x")
+    obs.disable()
+    spans = {e["name"]: e for e in obs.read_events(path) if e["kind"] == "span"}
+    assert spans["work"]["attrs"] == {"tag": "deco"}
+    assert spans["boom"]["attrs"]["error"] == "ValueError"
+
+
+def test_jsonl_round_trip_tolerates_torn_line_and_foreign_versions(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    obs.configure(path, sample_memory=False)
+    with obs.span("ok"):
+        pass
+    obs.flush_metrics()
+    obs.disable()
+    with open(path, "a") as f:
+        f.write(json.dumps({"v": 999, "kind": "span", "name": "future"}) + "\n")
+        f.write('{"v": 1, "kind": "span", "name": "torn", "dur')  # crash cut
+    events = list(obs.read_events(path))
+    names = [e.get("name") for e in events if e["kind"] == "span"]
+    assert names == ["ok"], "unknown versions and torn lines must be skipped"
+    assert any(e["kind"] == "metrics" for e in events)
+    # kind filter
+    assert all(e["kind"] == "span"
+               for e in obs.read_events(path, kinds=["span"]))
+
+
+def test_configure_truncate_starts_fresh(tmp_path):
+    """Single-owner paths (run.py's derived events file) must not pool a
+    rerun's spans into a stale capture."""
+    path = str(tmp_path / "events.jsonl")
+    obs.configure(path, sample_memory=False)
+    with obs.span("old"):
+        pass
+    obs.disable()
+    obs.configure(path, sample_memory=False, truncate=True)
+    with obs.span("new"):
+        pass
+    obs.disable()
+    names = [e["name"] for e in obs.read_events(path) if e["kind"] == "span"]
+    assert names == ["new"]
+    # default (no truncate) appends — the bench multi-process contract
+    obs.configure(path, sample_memory=False)
+    with obs.span("appended"):
+        pass
+    obs.disable()
+    names = [e["name"] for e in obs.read_events(path) if e["kind"] == "span"]
+    assert names == ["new", "appended"]
+
+
+def test_sink_failure_disables_not_raises(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    tracer = obs.configure(path, sample_memory=False)
+    tracer.sink._f.close()  # simulate a dead disk under the sink
+    with obs.span("after-death"):
+        pass  # must not raise
+    assert tracer.sink._dead
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counters_gauges_histograms():
+    reg = Registry()
+    reg.count("c")
+    reg.count("c", 4)
+    reg.gauge("g", 7.0)
+    reg.gauge_max("hw", 5.0)
+    reg.gauge_max("hw", 3.0)  # lower: ignored
+    for v in range(100):
+        reg.observe("h", float(v))
+    snap = reg.snapshot()
+    assert snap["counters"]["c"] == 5
+    assert snap["gauges"] == {"g": 7.0, "hw": 5.0}
+    h = snap["histograms"]["h"]
+    assert h["count"] == 100 and h["total"] == sum(range(100))
+    assert 45 <= h["p50"] <= 55 and 90 <= h["p95"] <= 99
+
+
+def test_histogram_bounded_memory():
+    h = Histogram()
+    for v in range(100_000):
+        h.observe(float(v))
+    assert h.count == 100_000
+    assert len(h.values) < 5000, "reservoir must stay bounded"
+    assert 40_000 <= h.percentile(50) <= 60_000
+
+
+def test_count_transfer_per_stage_and_total():
+    obs.count_transfer("d2h", 1000, "post.claims")
+    obs.count_transfer("d2h", 500, "post.claims")
+    obs.count_transfer("h2d", 64, "associate")
+    c = obs.registry().snapshot()["counters"]
+    assert c["d2h.bytes.post.claims"] == 1500
+    assert c["d2h.bytes"] == 1500
+    assert c["h2d.bytes.associate"] == 64
+
+
+def test_compile_cache_bucket_counters():
+    from maskclustering_tpu.utils.compile_cache import (record_shape_bucket,
+                                                        reset_shape_buckets)
+
+    reset_shape_buckets()
+    try:
+        assert record_shape_bucket("obs_test", 1, 2)
+        assert not record_shape_bucket("obs_test", 1, 2)
+        assert record_shape_bucket("obs_test", 3, 4)
+        snap = obs.registry().snapshot()
+        assert snap["counters"]["compile_cache.bucket_new"] == 2
+        assert snap["counters"]["compile_cache.bucket_hit"] == 1
+        assert snap["gauges"]["compile_cache.distinct_buckets"] == 2
+    finally:
+        reset_shape_buckets()
+
+
+# ---------------------------------------------------------------------------
+# report CLI
+# ---------------------------------------------------------------------------
+
+
+def _canned_events(tmp_path, name="events.jsonl", scale=1.0):
+    path = str(tmp_path / name)
+    obs.configure(path, sample_memory=False, meta={"tool": "canned"})
+    for i in range(4):
+        obs.record_span("associate", 0.10 * scale, scene=f"s{i}")
+        obs.record_span("graph", 0.02 * scale)
+        obs.record_span("cluster", 0.03 * scale, sync_s=0.02 * scale)
+        obs.record_span("postprocess", 0.40 * scale)
+        obs.record_span("post.claims", 0.30 * scale, parent="postprocess")
+    obs.count_transfer("d2h", 4 * 1024 * 1024, "post.claims")
+    obs.count_transfer("h2d", 64 * 1024 * 1024, "associate.feed")
+    obs.flush_metrics()
+    obs.disable()
+    return path
+
+
+def test_report_cli_smoke(tmp_path, capsys):
+    from maskclustering_tpu.obs.report import main
+
+    path = _canned_events(tmp_path)
+    assert main([path]) == 0
+    out = capsys.readouterr().out
+    for stage in ("associate", "graph", "cluster", "postprocess",
+                  "post.claims"):
+        assert stage in out
+    assert "dev.p50" in out and "host.p50" in out
+    assert "4.0MB" in out  # the post.claims d2h column
+    assert "64.0MB" in out  # h2d total line
+
+
+def test_report_cli_as_module(tmp_path):
+    """The documented entrypoint: python -m maskclustering_tpu.obs.report."""
+    path = _canned_events(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "maskclustering_tpu.obs.report", path,
+         "--json"],
+        capture_output=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-500:]
+    summary = json.loads(proc.stdout)
+    assert summary["stages"]["cluster"]["device_p50_s"] == pytest.approx(0.02)
+    assert summary["h2d_bytes"] == 64 * 1024 * 1024
+
+
+def test_report_diff(tmp_path, capsys):
+    from maskclustering_tpu.obs.report import main
+
+    a = _canned_events(tmp_path, "a.jsonl", scale=1.0)
+    b = _canned_events(tmp_path, "b.jsonl", scale=2.0)
+    assert main([a, "--diff", b]) == 0
+    out = capsys.readouterr().out
+    assert "obs diff" in out
+    assert "-50.0%" in out  # every A stage is half of B's p50
+
+
+def test_report_merges_counters_across_pids(tmp_path):
+    """One file, several processes (bench worker attempts + supervisor):
+    counters sum across pids but stay last-write within one pid."""
+    from maskclustering_tpu.obs.report import RunData
+
+    path = str(tmp_path / "events.jsonl")
+    obs.configure(path, sample_memory=False)
+    obs.count("bench.attempts", 1)
+    obs.flush_metrics()
+    obs.count("bench.attempts", 1)  # now 2; same pid, later flush supersedes
+    obs.flush_metrics()
+    obs.disable()
+    with open(path, "a") as f:  # a second process's flush
+        f.write(json.dumps({
+            "v": 1, "kind": "metrics", "ts": 0.0, "pid": -1,
+            "metrics": {"counters": {"bench.attempts": 3},
+                        "gauges": {"hbm.high_water_bytes": 123.0}}}) + "\n")
+    run = RunData(path)
+    assert run.summary()["counters"]["bench.attempts"] == 5
+    assert run.hbm_high_water == 123.0

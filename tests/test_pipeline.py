@@ -23,15 +23,24 @@ def _iou(pred_ids, gt_mask):
 
 
 @pytest.fixture(scope="module")
-def result_and_scene():
+def result_and_scene(tmp_path_factory):
+    """One module-scoped scene run, with obs capture armed: the span tests
+    piggyback on this run instead of paying for another one."""
+    from maskclustering_tpu import obs
+
     scene = make_scene(num_boxes=4, num_frames=10, seed=21)
     cfg = _config()
-    res = run_scene(to_scene_tensors(scene), cfg, k_max=15)
-    return scene, res
+    events = str(tmp_path_factory.mktemp("obs") / "events.jsonl")
+    obs.configure(events, fence=True, sample_memory=False)
+    try:
+        res = run_scene(to_scene_tensors(scene), cfg, k_max=15)
+    finally:
+        obs.disable()
+    return scene, res, events
 
 
 def test_pipeline_recovers_objects(result_and_scene):
-    scene, res = result_and_scene
+    scene, res, _ = result_and_scene
     objs = res.objects
     n_gt = scene.gt_instance.max()
     assert len(objs.point_ids_list) == n_gt, (
@@ -51,7 +60,7 @@ def test_pipeline_recovers_objects(result_and_scene):
 
 
 def test_pipeline_mask_lists(result_and_scene):
-    scene, res = result_and_scene
+    scene, res, _ = result_and_scene
     for mlist in res.objects.mask_list:
         assert len(mlist) >= 2
         for frame_id, mask_id, cov in mlist:
@@ -75,7 +84,7 @@ def test_auto_k_max_handles_ids_beyond_128(result_and_scene):
     assert bucket_k_max(64) == 127
     assert bucket_k_max(200) == 255
 
-    scene, res_ref = result_and_scene
+    scene, res_ref, _ = result_and_scene
     t = to_scene_tensors(scene)
     # order-preserving relabel 1..15 -> 120..400: ids now exceed 127
     seg = t.segmentations
@@ -91,7 +100,7 @@ def test_auto_k_max_handles_ids_beyond_128(result_and_scene):
 def test_export_artifacts(tmp_path, result_and_scene):
     from maskclustering_tpu.models.postprocess import export_artifacts
 
-    scene, res = result_and_scene
+    scene, res, _ = result_and_scene
     paths = export_artifacts(
         res.objects, "synth0", "synthetic",
         object_dict_dir=str(tmp_path / "object"),
@@ -111,6 +120,38 @@ def test_export_artifacts(tmp_path, result_and_scene):
                                       np.nonzero(data["pred_masks"][:, i])[0])
         assert od[i]["repre_mask_list"] == sorted(
             od[i]["mask_list"], key=lambda t: t[2], reverse=True)[:5]
+
+
+def test_run_scene_timings_come_from_spans(result_and_scene):
+    """The per-stage ``timings`` dict is derived from obs spans now: with
+    capture armed (the module fixture runs its scene that way), every
+    legacy timings key appears as a span in the events file with a
+    matching duration — and the legacy key set itself is unchanged (bench
+    stage breakdowns and run_report consumers keep their schema)."""
+    from maskclustering_tpu import obs
+
+    _, res, path = result_and_scene
+    legacy_keys = {"associate", "graph", "cluster", "postprocess",
+                   "post.claims", "post.dbscan", "post.mask_assign",
+                   "post.emit", "post.merge"}
+    assert set(res.timings) == legacy_keys
+    spans = [e for e in obs.read_events(path) if e["kind"] == "span"]
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    # every timings key is backed by a span of the same name...
+    assert legacy_keys <= set(by_name)
+    for key, secs in res.timings.items():
+        assert by_name[key][-1]["dur_s"] == pytest.approx(secs, rel=1e-6, abs=1e-6)
+    # ...the post.* phases attribute to their parent stage...
+    for name, evs in by_name.items():
+        if name.startswith("post.") and not name.endswith(".kernel") \
+                and not name.endswith(".pull"):
+            assert evs[-1]["parent"] == "postprocess", name
+    # ...and the stage spans carry the scene-shape attrs the report keys on
+    assoc = by_name["associate"][-1]["attrs"]
+    assert assoc["num_frames"] == 10 and assoc["k_max"] == 15
+    assert "n_pad" in assoc and "f_pad" in assoc
 
 
 def test_device_renderer_matches_numpy():

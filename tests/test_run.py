@@ -38,14 +38,37 @@ def _cfg(data_root):
 
 
 def test_full_pipeline(scene_root):
+    import json
+
+    from maskclustering_tpu import obs
+
     cfg = _cfg(scene_root)
-    report = run_pipeline(
-        cfg, ["scene0001_00"], steps=DEFAULT_STEPS, resume=True,
-        encoder_spec="hash:16",
-        report_path=os.path.join(scene_root, "report.json"))
+    events = os.path.join(scene_root, "events.jsonl")
+    try:
+        report = run_pipeline(
+            cfg, ["scene0001_00"], steps=DEFAULT_STEPS, resume=True,
+            encoder_spec="hash:16", obs_events=events,
+            report_path=os.path.join(scene_root, "report.json"))
+    finally:
+        obs.disable()
+    assert not obs.enabled(), "run_pipeline must disarm what it armed"
     assert [s.status for s in report.scenes] == ["ok"]
     assert report.scenes[0].num_objects == 3
     assert set(report.step_seconds) == set(DEFAULT_STEPS)
+
+    # obs wiring: the digest is embedded in the saved report, its stage set
+    # covers the legacy per-scene timings keys, and the report CLI renders
+    # a table from the same events file (the observability acceptance path)
+    saved = json.load(open(os.path.join(scene_root, "report.json")))
+    assert saved["obs"]["events"] == events
+    assert set(report.scenes[0].timings) <= set(saved["obs"]["stages"])
+    assert saved["obs"]["counters"]["run.scenes_ok"] >= 1
+    assert saved["obs"]["h2d_bytes"] > 0 and saved["obs"]["d2h_bytes"] > 0
+    from maskclustering_tpu.obs.report import RunData, render_report
+
+    table = render_report(RunData(events))
+    for key in report.scenes[0].timings:
+        assert key in table
 
     pred_dir = os.path.join(scene_root, "prediction")
     ca = np.load(os.path.join(pred_dir, "testrun_class_agnostic", "scene0001_00.npz"))
