@@ -102,6 +102,35 @@ def _emit(args, times, error=None, stage_timings=None):
             line["partial"] = True
     print(json.dumps(line))
     sys.stdout.flush()
+    if not os.environ.get("MCT_BENCH_SUPERVISED"):
+        # direct --worker invocations own their verdict; under supervision
+        # the parent appends the FINAL line instead (a retried worker's
+        # failed line must not pollute the trajectory)
+        _ledger_append(args, line)
+
+
+def _ledger_append(args, line, fast=False):
+    """One perf-ledger row per bench verdict (schema-versioned, crash-safe).
+
+    Never endangers the one-JSON-line stdout contract: failures print a
+    stderr warning and move on. ``fast=True`` (the signal-handler path)
+    skips the git-rev subprocess — a handler must not block up to 10 s on
+    a hung filesystem before os._exit while a supervisor escalates to
+    SIGKILL.
+    """
+    if getattr(args, "no_ledger", False):
+        return
+    try:
+        from maskclustering_tpu.obs import ledger as led
+
+        path = getattr(args, "ledger", None) or led.default_ledger_path()
+        row = led.bench_row(line)
+        if fast:
+            row["git"] = None  # presence of the key skips _git_rev
+        led.append_row(path, row)
+    except Exception as e:  # noqa: BLE001 — the ledger must never sink the bench
+        print(f"[bench] WARNING: perf ledger append failed: {e}",
+              file=sys.stderr, flush=True)
 
 
 def _init_backend(args):
@@ -233,6 +262,19 @@ def _build_parser():
                         "with python -m maskclustering_tpu.obs.report")
     p.add_argument("--no-obs", action="store_true",
                    help="force obs capture off even if --obs-events is set")
+    p.add_argument("--ledger", default=None,
+                   help="perf ledger JSONL the verdict appends to (default: "
+                        "PERF_LEDGER.jsonl / $MCT_PERF_LEDGER; render with "
+                        "obs.report --history)")
+    p.add_argument("--no-ledger", action="store_true",
+                   help="do not append this verdict to the perf ledger")
+    p.add_argument("--xprof", default=None, metavar="SPANS",
+                   help="comma-joined span names to bracket with a "
+                        "jax.profiler trace (needs --obs-events; e.g. "
+                        "cluster,post.claims.kernel; * = every span)")
+    p.add_argument("--xprof-dir", default=None,
+                   help="trace output dir for --xprof (default: next to "
+                        "--obs-events)")
     return p
 
 
@@ -297,6 +339,7 @@ def _supervise(args):
         line = _final_line(kill_msg=f"supervisor killed by signal {signum}")
         print(json.dumps(line))
         sys.stdout.flush()
+        _ledger_append(args, line, fast=True)
         # mirror the tail's exit contract: only a CLEAN preserved verdict
         # (value non-null, no error) is a pass for set -e shell callers —
         # a partial/errored record exits nonzero from the tail path too
@@ -429,6 +472,7 @@ def _supervise(args):
                   file=sys.stderr, flush=True)
     line = _final_line()
     print(json.dumps(line))
+    _ledger_append(args, line)
     # Preserve the worker's verdict for shell callers (setup_tpu_vm.sh runs
     # under set -e): partial/errored runs must not look like clean passes.
     rc = state["rc"]
@@ -446,6 +490,9 @@ def main():
     import numpy as np
 
     obs_armed = bool(args.obs_events) and not args.no_obs
+    if args.xprof and not obs_armed:
+        print("[bench] WARNING: --xprof needs obs capture (--obs-events, "
+              "without --no-obs); ignored", file=sys.stderr, flush=True)
     if obs_armed:
         import jax
 
@@ -455,10 +502,22 @@ def main():
         # honest-shape numbers carry zero instrumentation cost (no fences,
         # no event I/O); with capture on, every run_scene stage span and
         # transfer counter streams to the JSONL, crash-safe per line
+        xprof_dir, xprof_spans = None, None
+        if args.xprof and args.profile_dir:
+            print("[bench] WARNING: --xprof ignored (jax has one profiler "
+                  "session and --profile-dir already owns it)",
+                  file=sys.stderr, flush=True)
+        elif args.xprof:
+            from maskclustering_tpu.obs.xprof import parse_spans
+
+            xprof_spans = parse_spans(args.xprof)
+            xprof_dir = args.xprof_dir or os.path.join(
+                os.path.dirname(os.path.abspath(args.obs_events)), "xprof")
         obs.configure(args.obs_events, annotations=bool(args.profile_dir),
                       meta={"tool": "bench", "backend": jax.default_backend(),
                             "frames": args.frames, "points": args.points,
-                            "frame_batch": args.frame_batch})
+                            "frame_batch": args.frame_batch},
+                      xprof_dir=xprof_dir, xprof_spans=xprof_spans)
 
     from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
 

@@ -69,6 +69,13 @@ def iterative_clustering(
     one ``jax.profiler.TraceAnnotation``) around the jitted solve so the
     clustering step is identifiable inside XLA profile traces. Static
     shapes only — no device sync, zero cost when obs is disarmed."""
+    if isinstance(visible, jax.core.Tracer):
+        # called from inside another jit (the fused mesh path): a span here
+        # would time Python TRACING once per compile and nothing per cached
+        # execution — a bogus row; the enclosing stage span owns the timing
+        return _iterative_clustering_jit(
+            visible, contained, active, schedule,
+            view_consensus_threshold=view_consensus_threshold)
     from maskclustering_tpu import obs
 
     with obs.span("cluster.solve", m_pad=int(visible.shape[0]),

@@ -29,18 +29,20 @@ from __future__ import annotations
 import atexit
 from typing import Optional
 
-from maskclustering_tpu.obs.events import SCHEMA_VERSION, EventSink, read_events
+from maskclustering_tpu.obs.events import (SCHEMA_VERSION, EventSink,
+                                           ReadStats, read_events)
 from maskclustering_tpu.obs.metrics import (count, count_transfer, gauge,
                                             gauge_max, observe, registry,
                                             sample_hbm)
 from maskclustering_tpu.obs.tracer import NULL_TRACER, NullTracer, Span, Tracer
+from maskclustering_tpu.obs.xprof import XprofArm
 
 __all__ = [
     "configure", "disable", "enabled", "events_path", "get_tracer",
     "scene_tracer", "span", "record_span", "traced", "flush_metrics",
     "count", "count_transfer", "gauge", "gauge_max", "observe", "registry",
     "sample_hbm", "read_events", "EventSink", "Tracer", "NullTracer",
-    "Span", "NULL_TRACER", "SCHEMA_VERSION",
+    "Span", "NULL_TRACER", "SCHEMA_VERSION", "ReadStats", "XprofArm",
 ]
 
 _active = NULL_TRACER
@@ -53,7 +55,9 @@ _TIMING_TRACER = Tracer(sink=None)
 
 def configure(path: str, *, fence: bool = True, annotations: bool = False,
               sample_memory: bool = True, meta: Optional[dict] = None,
-              truncate: bool = False) -> Tracer:
+              truncate: bool = False, xprof_dir: Optional[str] = None,
+              xprof_spans: Optional[tuple] = None,
+              xprof_limit: int = 1) -> Tracer:
     """Arm tracing: spans + metrics flushes append to the JSONL at ``path``.
 
     Idempotent per path; re-configuring to a new path closes the old sink.
@@ -65,11 +69,26 @@ def configure(path: str, *, fence: bool = True, annotations: bool = False,
     spans into a stale capture would silently skew every percentile. Leave
     False when several processes share one file by design (bench worker
     attempts + supervisor).
+
+    ``xprof_dir`` + ``xprof_spans``: arm span-triggered ``jax.profiler``
+    capture (obs/xprof.py) — the first ``xprof_limit`` openings of each
+    named span are bracketed by start/stop_trace, flushed to
+    ``xprof_dir/<span>-<k>``. Off by default: profiling is the one obs
+    feature with real runtime cost.
     """
     global _active, _sink
-    if _sink is not None and _sink.path == path and isinstance(_active, Tracer):
+    if (_sink is not None and _sink.path == path
+            and isinstance(_active, Tracer)
+            and not truncate and not (xprof_dir and xprof_spans)):
+        # idempotent ONLY for a plain re-arm of the same path: a truncate
+        # or xprof request must reconfigure, not be silently dropped
         return _active
     disable()
+    if truncate:
+        # a truncating owner starts a FRESH capture: stale process-local
+        # counters from an earlier run in this process would otherwise pool
+        # into the new digest (same skew the span truncate defends against)
+        registry().reset()
     _sink = EventSink(path, truncate=truncate)
     # NO jax probe here: ``jax.default_backend()`` initializes the backend,
     # and configure() must stay safe in chip-free processes (bench.py's
@@ -78,8 +97,11 @@ def configure(path: str, *, fence: bool = True, annotations: bool = False,
     if meta:
         payload.update(meta)
     _sink.emit("meta", payload)
+    arm = None
+    if xprof_dir and xprof_spans:
+        arm = XprofArm(xprof_dir, xprof_spans, limit=xprof_limit)
     _active = Tracer(_sink, fence=fence, annotations=annotations,
-                     sample_memory=sample_memory)
+                     sample_memory=sample_memory, xprof=arm)
     return _active
 
 
@@ -91,6 +113,11 @@ def disable() -> None:
             _active.flush_metrics()
         except Exception:  # noqa: BLE001
             pass
+        xprof = getattr(_active, "xprof", None)
+        if xprof is not None:
+            # stops a trace left open by a crashed span body before the
+            # interpreter can exit with a wedged profiler session
+            xprof.close()
         _sink.close()
         _sink = None
     _active = NULL_TRACER

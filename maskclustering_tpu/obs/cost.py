@@ -1,0 +1,384 @@
+"""Compile-time cost observatory: HLO censuses + rooflines, no chip needed.
+
+Runtime observability (obs/tracer.py) needs a healthy accelerator — a
+resource this project's round history shows up rarely (BENCH_r0{2,4,5} are
+null on backend-init wedges). This module answers the cost questions
+*statically*: it AOT-lowers each staged pipeline stage (and the whole fused
+step) over CPU virtual devices — the HLO is backend-shaped by the mesh and
+shardings, not chip-timed — and reads, per (stage, mesh config):
+
+- **collective census**: counts and payload bytes of every all-gather /
+  all-reduce / reduce-scatter / collective-permute / all-to-all in the
+  optimized module. This turns "no cross-chip comm on the critical path"
+  (VERDICT Weak #5) from an argument into a table: pure scene-DP compiles
+  to zero data collectives (only O(1)-byte while-loop predicates), while
+  frame-sharded meshes show exactly which stages pay ICI and how much.
+- **fusion & op census**: fusions, copies, transposes, and output-transfer
+  bytes — the static half of the post.claims kernel-vs-tunnel question.
+- **rooflines**: XLA's own FLOP and bytes-accessed estimates
+  (``Compiled.cost_analysis``) plus the buffer-assignment memory plan
+  (``Compiled.memory_analysis``), with v5e peak-rate context so the table
+  reads as "this stage is HBM-bound, that one is ICI-visible".
+
+Every row is emitted as a schema-versioned ``cost`` event into the obs
+JSONL sink; render with ``python -m maskclustering_tpu.obs.report --cost``
+or run this module directly::
+
+    JAX_PLATFORMS=cpu python -m maskclustering_tpu.obs.cost \
+        --mesh 1x8 --mesh 8x1 --events /tmp/cost_events.jsonl
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from maskclustering_tpu.obs.events import KIND_COST, EventSink
+
+log = logging.getLogger("maskclustering_tpu")
+
+# v5e peak rates, used only to contextualize static byte/FLOP counts as
+# lower-bound microseconds (HBM: 819 GB/s; ICI: 1600 Gbit/s = 200 GB/s per
+# chip across links; MXU: 197 TFLOP/s bf16). Sources: TPU v5e system
+# architecture docs; same constants family as scripts/hbm_analysis.py.
+V5E_HBM_GBPS = 819.0
+V5E_ICI_GBPS = 200.0
+V5E_BF16_TFLOPS = 197.0
+V5E_HBM_GB = 16.0
+
+COLLECTIVE_OPS = ("all-gather", "all-reduce", "reduce-scatter",
+                  "collective-permute", "all-to-all", "collective-broadcast")
+_OP_CENSUS_OPS = ("fusion", "copy", "transpose")
+
+# HLO primitive type -> element size in bytes (pred is byte-backed)
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _element_bytes(type_str: str) -> List[int]:
+    """Per-array byte sizes of every shape inside an HLO type string.
+
+    Handles plain (``f32[64,8]{0,1}``), scalar (``pred[]``) and tuple
+    (``(f32[8,2], u8[4])``) types; unknown primitive types contribute 0
+    (a census must not crash on an exotic dtype).
+    """
+    out: List[int] = []
+    for prim, dims in _SHAPE_RE.findall(type_str):
+        size = _DTYPE_BYTES.get(prim)
+        if size is None:
+            out.append(0)
+            continue
+        count = 1
+        for d in dims.split(","):
+            if d:
+                count *= int(d)
+        out.append(count * size)
+    return out
+
+
+def shape_bytes(type_str: str) -> int:
+    """Total byte size of an HLO result type string (tuples sum)."""
+    return sum(_element_bytes(type_str))
+
+
+def _op_pattern(op: str, *, start: bool = False) -> re.Pattern:
+    # one HLO instruction: `%name = TYPE op(...)`
+    suffix = "-start" if start else ""
+    return re.compile(
+        r"=\s+(?P<type>\([^=]*?\)|[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?)"
+        r"\s+" + re.escape(op) + suffix + r"\(")
+
+
+def collective_census(hlo_text: str) -> Dict[str, Dict[str, float]]:
+    """Count + byte-total every collective in an optimized HLO module.
+
+    Bytes are the payload (result-shape) bytes per collective instruction —
+    a lower bound on link traffic (ring algorithms move up to 2x) that is
+    comparable across mesh configs. Async collectives lower to
+    ``op-start``/``op-done`` pairs: the start is counted once and — since
+    its tuple type aliases BOTH the operand and result buffers (plus
+    context scalars on some backends) — its payload is the largest tuple
+    element, not the tuple sum, which would double-count the transfer.
+    The done is never counted. Returns only ops that appear.
+    """
+    out: Dict[str, Dict[str, float]] = {}
+    for op in COLLECTIVE_OPS:
+        count = 0
+        total = 0.0
+        # sync form: a tuple result is a variadic collective — sum it
+        sync_matches = _op_pattern(op).findall(hlo_text)
+        count += len(sync_matches)
+        total += sum(shape_bytes(t) for t in sync_matches)
+        # async form: tuple holds (operand, result, context...) — max
+        start_matches = _op_pattern(op, start=True).findall(hlo_text)
+        count += len(start_matches)
+        total += sum(max(_element_bytes(t) or [0]) for t in start_matches)
+        if count:
+            out[op] = {"count": count, "bytes": float(total)}
+    return out
+
+
+def op_census(hlo_text: str) -> Dict[str, int]:
+    """Fusion / copy / transpose instruction counts over the module text.
+
+    A textual census (includes fusion-computation bodies): fusions
+    approximate kernel-launch count, top-level copies and transposes are
+    the layout-churn signal behind the post.claims kernel-vs-tunnel
+    question. Async copy-start/copy-done pairs count once (the start).
+    """
+    return {op: (len(_op_pattern(op).findall(hlo_text))
+                 + len(_op_pattern(op, start=True).findall(hlo_text)))
+            for op in _OP_CENSUS_OPS}
+
+
+def ici_bytes(census: Dict[str, Dict[str, float]]) -> float:
+    return float(sum(c["bytes"] for c in census.values()))
+
+
+def analyze_compiled(compiled, *, lower_s: float = 0.0,
+                     compile_s: float = 0.0) -> Dict:
+    """Extract the full static cost row from a ``jax.stages.Compiled``.
+
+    Never raises on a backend that lacks an analysis — missing pieces are
+    None/empty so a row stays renderable.
+    """
+    row: Dict = {"lower_s": round(lower_s, 3), "compile_s": round(compile_s, 3)}
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    except Exception:  # noqa: BLE001 — analysis is optional per backend
+        ca = {}
+    row["flops"] = float(ca["flops"]) if "flops" in ca else None
+    row["hbm_bytes"] = (float(ca["bytes accessed"])
+                        if "bytes accessed" in ca else None)
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001
+        ma = None
+    if ma is not None:
+        row["arg_bytes"] = float(ma.argument_size_in_bytes)
+        row["out_bytes"] = float(ma.output_size_in_bytes)
+        row["temp_bytes"] = float(ma.temp_size_in_bytes)
+        row["alias_bytes"] = float(ma.alias_size_in_bytes)
+        # aliased bytes are counted in both args and outputs
+        row["peak_bytes"] = (row["arg_bytes"] + row["out_bytes"]
+                             + row["temp_bytes"] - row["alias_bytes"])
+    try:
+        text = compiled.as_text()
+    except Exception:  # noqa: BLE001
+        text = ""
+    census = collective_census(text)
+    row["collectives"] = census
+    row["ici_bytes"] = ici_bytes(census)
+    row["ops"] = op_census(text)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# the observatory driver
+# ---------------------------------------------------------------------------
+
+DEFAULT_MESHES: Tuple[Tuple[int, int], ...] = ((1, 8), (8, 1))
+ALL_STAGES = ("backprojection", "graph", "clustering", "postprocess", "fused")
+
+
+def parse_mesh_specs(specs: Sequence[str]) -> List[Tuple[int, int]]:
+    """CLI mesh parsing shared by ``obs.cost`` and ``report --cost``.
+
+    Accepts ``SCENExFRAME`` items, each optionally comma-joined
+    (``["1x8", "8x1"]`` or ``["1x8,8x1"]``). Raises ValueError with a
+    message the CLIs can surface instead of a traceback.
+    """
+    meshes: List[Tuple[int, int]] = []
+    for item in specs:
+        for m in item.split(","):
+            if not m:
+                continue
+            s, sep, f = m.partition("x")
+            try:
+                if not sep:
+                    raise ValueError
+                meshes.append((int(s), int(f)))
+            except ValueError:
+                raise ValueError(
+                    f"bad mesh spec {m!r}: expected SCENExFRAME, e.g. 1x8"
+                ) from None
+    return meshes
+
+
+def ensure_cpu_devices(count: int = 8) -> int:
+    """Best-effort: a CPU backend with ``count`` virtual devices.
+
+    Must run before jax initializes a backend (XLA_FLAGS is read at
+    backend init, not import). If a backend already exists — e.g. inside
+    a pytest session — whatever device count it has is what the caller
+    gets; meshes that do not fit are skipped with a warning.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={count}").strip()
+    import jax
+
+    try:
+        # config, not env: the environment may preload a TPU platform and
+        # JAX_PLATFORMS would be read too late (same move as tests/conftest)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — already initialized elsewhere
+        pass
+    return jax.device_count()
+
+
+def _default_pipeline_cfg(point_chunk: int):
+    from maskclustering_tpu.config import PipelineConfig
+
+    return PipelineConfig(config_name="cost_observatory", dataset="demo",
+                          distance_threshold=0.01, few_points_threshold=25,
+                          point_chunk=point_chunk)
+
+
+def observe_costs(
+    mesh_shapes: Sequence[Tuple[int, int]] = DEFAULT_MESHES,
+    *,
+    stages: Sequence[str] = ALL_STAGES,
+    frames: int = 8,
+    points: int = 1024,
+    image_hw: Tuple[int, int] = (24, 32),
+    k_max: int = 7,
+    cfg=None,
+    sink: Optional[EventSink] = None,
+) -> List[Dict]:
+    """AOT-lower every (stage, mesh) pair and return/emit the cost rows.
+
+    Scene count per mesh equals the ``scene`` axis size (one scene shard
+    per scene group — the honest serving shape); ``frames`` must divide by
+    every frame axis requested. Rows are plain dicts (JSON-able); when
+    ``sink`` is given each row is also emitted as a ``cost`` event.
+    """
+    import jax
+
+    if cfg is None:
+        cfg = _default_pipeline_cfg(point_chunk=max(256, points // 4))
+    from maskclustering_tpu.parallel.mesh import make_mesh
+    from maskclustering_tpu.parallel.sharded import (
+        build_fused_step,
+        build_stage_step,
+        stage_arg_shapes,
+    )
+
+    n_dev = jax.device_count()
+    rows: List[Dict] = []
+    fingerprint = {"frames": frames, "points": points,
+                   "image_hw": list(image_hw), "k_max": k_max,
+                   "backend": jax.default_backend()}
+    for mesh_shape in mesh_shapes:
+        s_ax, f_ax = mesh_shape
+        if s_ax * f_ax != n_dev:
+            log.warning("cost observatory: mesh %s needs %d devices, have %d "
+                        "— skipped", mesh_shape, s_ax * f_ax, n_dev)
+            continue
+        if frames % f_ax:
+            log.warning("cost observatory: frames=%d not divisible by frame "
+                        "axis %d — mesh %s skipped", frames, f_ax, mesh_shape)
+            continue
+        mesh = make_mesh(mesh_shape)
+        scenes = s_ax
+        for stage in stages:
+            t0 = time.perf_counter()
+            try:
+                if stage == "fused":
+                    step = build_fused_step(mesh, cfg, k_max=k_max)
+                    shapes = stage_arg_shapes(
+                        "backprojection", scenes=scenes, frames=frames,
+                        points=points, image_hw=image_hw, k_max=k_max)
+                else:
+                    step = build_stage_step(stage, mesh, cfg, k_max=k_max)
+                    shapes = stage_arg_shapes(
+                        stage, scenes=scenes, frames=frames, points=points,
+                        image_hw=image_hw, k_max=k_max,
+                        max_iters=cfg.max_cluster_iterations)
+                lowered = step.lower(*shapes)
+                t1 = time.perf_counter()
+                compiled = lowered.compile()
+                t2 = time.perf_counter()
+            except Exception as e:  # noqa: BLE001 — one stage must not sink the sweep
+                log.exception("cost observatory: %s @ mesh %s failed",
+                              stage, mesh_shape)
+                rows.append({"stage": stage, "mesh": list(mesh_shape),
+                             "error": f"{type(e).__name__}: {e}",
+                             "fingerprint": fingerprint})
+                continue
+            row = analyze_compiled(compiled, lower_s=t1 - t0,
+                                   compile_s=t2 - t1)
+            row.update({"stage": stage, "mesh": list(mesh_shape),
+                        "devices": n_dev, "fingerprint": fingerprint})
+            rows.append(row)
+            if sink is not None:
+                sink.emit(KIND_COST, row)
+            log.info("cost observatory: %s @ mesh %s: %d collective(s), "
+                     "%.0f ICI bytes", stage, mesh_shape,
+                     sum(c["count"] for c in row["collectives"].values()),
+                     row["ici_bytes"])
+    return rows
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m maskclustering_tpu.obs.cost",
+        description="AOT cost observatory: collective census + rooflines "
+                    "per (stage, mesh), computed on CPU virtual devices")
+    p.add_argument("--mesh", action="append", default=None,
+                   metavar="SxF", help="mesh config, e.g. 1x8 (repeatable; "
+                   "default: 1x8 and 8x1)")
+    p.add_argument("--stages", default=",".join(ALL_STAGES),
+                   help=f"comma-separated subset of {ALL_STAGES}")
+    p.add_argument("--frames", type=int, default=8)
+    p.add_argument("--points", type=int, default=1024)
+    p.add_argument("--image-h", type=int, default=24)
+    p.add_argument("--image-w", type=int, default=32)
+    p.add_argument("--k-max", type=int, default=7)
+    p.add_argument("--events", default=None,
+                   help="append cost events to this JSONL (render later with "
+                        "obs.report --cost)")
+    p.add_argument("--devices", type=int, default=8,
+                   help="CPU virtual device count to request")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
+    ensure_cpu_devices(args.devices)
+    try:
+        meshes = parse_mesh_specs(args.mesh or ["1x8", "8x1"])
+    except ValueError as e:
+        p.error(str(e))
+
+    sink = EventSink(args.events) if args.events else None
+    rows = observe_costs(
+        meshes, stages=tuple(s for s in args.stages.split(",") if s),
+        frames=args.frames, points=args.points,
+        image_hw=(args.image_h, args.image_w), k_max=args.k_max, sink=sink)
+    if sink is not None:
+        sink.close()
+    from maskclustering_tpu.obs.report import render_cost
+
+    print(render_cost(rows))
+    ok = [r for r in rows if "error" not in r]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,32 @@ SCHEMA_VERSION = 1
 KIND_META = "meta"
 KIND_SPAN = "span"
 KIND_METRICS = "metrics"
+KIND_COST = "cost"  # compile-time cost observatory rows (obs/cost.py)
+
+
+class ReadStats:
+    """Skip accounting for tolerant JSONL readers.
+
+    A crash can tear the final line and a newer writer can emit versions
+    this reader does not know; both are skipped — but silently losing lines
+    makes a report lie by omission, so readers count what they drop and the
+    CLIs surface the counts as a warning.
+    """
+
+    __slots__ = ("torn", "unknown_version", "total")
+
+    def __init__(self):
+        self.torn = 0
+        self.unknown_version = 0
+        self.total = 0
+
+    @property
+    def skipped(self) -> int:
+        return self.torn + self.unknown_version
+
+    def describe(self) -> str:
+        return (f"{self.torn} torn/corrupt line(s), "
+                f"{self.unknown_version} unknown-schema line(s)")
 
 
 class EventSink:
@@ -92,23 +118,43 @@ def _json_default(obj):
     return repr(obj)
 
 
-def read_events(path: str, *, kinds: Optional[List[str]] = None) -> Iterator[Dict]:
-    """Yield parsed events from a JSONL file.
+def iter_jsonl_rows(path: str, *, version: int,
+                    stats: Optional[ReadStats] = None) -> Iterator[Dict]:
+    """Tolerant schema-versioned JSONL reader (events AND the perf ledger).
 
-    Skips: torn/corrupt lines (a crash can truncate the final line),
-    unknown schema versions, and — when ``kinds`` is given — other kinds.
+    One copy of the crash-tolerance policy: torn/corrupt lines (a crash can
+    truncate the final line) and unknown ``v`` values are skipped — counted
+    into ``stats`` when given, so CLIs can warn instead of losing lines
+    silently.
     """
     with open(path, "r", encoding="utf-8") as f:
         for raw in f:
             raw = raw.strip()
             if not raw:
                 continue
+            if stats is not None:
+                stats.total += 1
             try:
-                ev = json.loads(raw)
+                row = json.loads(raw)
             except ValueError:
+                if stats is not None:
+                    stats.torn += 1
                 continue  # torn line (crash mid-write)
-            if not isinstance(ev, dict) or ev.get("v") != SCHEMA_VERSION:
+            if not isinstance(row, dict) or row.get("v") != version:
+                if stats is not None:
+                    stats.unknown_version += 1
                 continue
-            if kinds is not None and ev.get("kind") not in kinds:
-                continue
-            yield ev
+            yield row
+
+
+def read_events(path: str, *, kinds: Optional[List[str]] = None,
+                stats: Optional[ReadStats] = None) -> Iterator[Dict]:
+    """Yield parsed events from a JSONL file.
+
+    Skips: torn/corrupt lines, unknown schema versions (see
+    ``iter_jsonl_rows``), and — when ``kinds`` is given — other kinds.
+    """
+    for ev in iter_jsonl_rows(path, version=SCHEMA_VERSION, stats=stats):
+        if kinds is not None and ev.get("kind") not in kinds:
+            continue
+        yield ev

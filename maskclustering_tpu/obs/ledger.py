@@ -1,0 +1,198 @@
+"""Perf regression ledger: the bench trajectory as an append-only JSONL.
+
+Three rounds of BENCH_r0*.json are null (chip wedges), so the project has
+no machine-checkable performance trajectory — every "did we regress?"
+question is answered by a human reading markdown. This module gives every
+bench verdict and run-report digest a durable, schema-versioned row in
+``PERF_LEDGER.jsonl``:
+
+- **append-only + crash-safe** like the event sink (one flush per row; a
+  torn final line is skipped-with-a-count by the reader, never fatal);
+- **never the failure source**: an append error logs once and returns
+  False — the bench's one-JSON-line stdout contract and the run's exit
+  code must not depend on ledger disk health;
+- **machine-checkable**: ``python -m maskclustering_tpu.obs.report
+  --history`` renders the trajectory, ``--regress BASELINE`` exits
+  non-zero when the newest headline p50 regresses >15% — a CI gate and
+  the driver's bench-trajectory answer in one.
+
+Rows carry ``v`` (ledger schema version), ``ts``, ``tool`` (bench | run |
+seed), the headline ``value``/``unit``, per-stage medians when known, and
+the git revision when resolvable.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import time
+from typing import Dict, List, Optional, Tuple
+
+from maskclustering_tpu.obs.events import ReadStats
+
+log = logging.getLogger("maskclustering_tpu")
+
+LEDGER_SCHEMA_VERSION = 1
+DEFAULT_REGRESS_THRESHOLD = 0.15  # >15% p50 slowdown fails --regress
+
+
+def default_ledger_path() -> str:
+    """``PERF_LEDGER.jsonl`` in the cwd; overridable via MCT_PERF_LEDGER
+    (tests point it at a tmp dir so default-on appends stay hermetic)."""
+    return os.environ.get("MCT_PERF_LEDGER", "PERF_LEDGER.jsonl")
+
+
+def _git_rev() -> Optional[str]:
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             capture_output=True, timeout=10)
+        rev = out.stdout.decode("utf-8", "replace").strip()
+        return rev if out.returncode == 0 and rev else None
+    except Exception:  # noqa: BLE001 — no git is a fine place to run a bench
+        return None
+
+
+def append_row(path: str, row: Dict) -> bool:
+    """Append one schema-versioned row; one flush, never raises."""
+    line = {"v": LEDGER_SCHEMA_VERSION, "ts": time.time(), "pid": os.getpid()}
+    line.update(row)
+    if "git" not in line:
+        rev = _git_rev()
+        if rev:
+            line["git"] = rev
+    from maskclustering_tpu.obs import metrics as _metrics
+
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(line) + "\n")
+        _metrics.count("ledger.rows_appended")
+        return True
+    except Exception:  # noqa: BLE001 — the ledger must never sink the run
+        log.exception("perf ledger append failed; row dropped (%s)", path)
+        _metrics.count("ledger.rows_dropped")
+        return False
+
+
+def bench_row(verdict: Dict, **extra) -> Dict:
+    """Ledger row from a bench JSON verdict line (bench.py's stdout line)."""
+    row = {"tool": "bench",
+           "metric": verdict.get("metric"),
+           "value": verdict.get("value"),
+           "unit": verdict.get("unit", "s/scene")}
+    for k in ("vs_baseline", "spread_pct", "stages", "attempts",
+              "frame_batch", "error"):
+        if verdict.get(k) is not None:
+            row[k] = verdict[k]
+    row.update(extra)
+    return row
+
+
+def run_row(report: Dict, **extra) -> Dict:
+    """Ledger row from a run-report dict (run.py's run_report.json shape).
+
+    Headline value: median ok-scene seconds (the serving-facing number);
+    stages come from the embedded obs digest when the run was armed.
+    """
+    scenes = report.get("scenes") or []
+    ok = sorted(s.get("seconds", 0.0) for s in scenes
+                if s.get("status") == "ok")
+    value = ok[len(ok) // 2] if ok else None
+    row = {"tool": "run",
+           "metric": "run s/scene (median of ok scenes)",
+           "value": round(value, 3) if value is not None else None,
+           "unit": "s/scene",
+           "scenes_ok": len(ok),
+           "scenes_failed": sum(1 for s in scenes
+                                if s.get("status") == "failed"),
+           "config": report.get("config_name")}
+    digest = report.get("obs") or {}
+    stages = digest.get("stages")
+    if stages:
+        row["stages"] = {k: v.get("p50_s") for k, v in stages.items()}
+    row.update(extra)
+    return row
+
+
+def read_ledger(path: str, *, stats: Optional[ReadStats] = None) -> List[Dict]:
+    """All known-version rows, oldest first; torn/unknown lines are counted
+    into ``stats`` and skipped (one shared policy: events.iter_jsonl_rows)."""
+    from maskclustering_tpu.obs.events import iter_jsonl_rows
+
+    return list(iter_jsonl_rows(path, version=LEDGER_SCHEMA_VERSION,
+                                stats=stats))
+
+
+def latest_value_row(rows: List[Dict], *,
+                     metric: Optional[str] = None) -> Optional[Dict]:
+    """Newest row with a numeric headline value (null verdicts are history,
+    not baselines). ``metric`` restricts the pick to comparable rows — the
+    --regress gate must not compare a run-row median against a bench
+    baseline just because it is newer."""
+    for row in reversed(rows):
+        if not isinstance(row.get("value"), (int, float)):
+            continue
+        if metric is not None and row.get("metric") != metric:
+            continue
+        return row
+    return None
+
+
+def load_baseline(path: str) -> Optional[Dict]:
+    """A baseline for --regress: a ledger JSONL (newest valid row) or a
+    single JSON document with a ``value`` field (a bench verdict / BENCH_*
+    record)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            head = f.read()
+    except OSError:
+        return None
+    try:
+        doc = json.loads(head)
+        if isinstance(doc, dict) and isinstance(doc.get("value"), (int, float)):
+            return doc
+    except ValueError:
+        pass
+    try:
+        return latest_value_row(read_ledger(path))
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def check_regression(current: Optional[Dict], baseline: Optional[Dict], *,
+                     threshold: float = DEFAULT_REGRESS_THRESHOLD
+                     ) -> Tuple[bool, List[str]]:
+    """Headline p50 gate: ok unless current is >threshold slower.
+
+    Lower is better (s/scene). Stage-level drifts are reported as advisory
+    lines but only the headline value gates — stage noise on shared CPUs
+    would otherwise make the gate cry wolf.
+    """
+    lines: List[str] = []
+    if current is None:
+        return False, ["no current row with a numeric value — cannot gate "
+                       "(an empty/null trajectory is itself a failure)"]
+    if baseline is None:
+        return False, ["no usable baseline value"]
+    cur, base = float(current["value"]), float(baseline["value"])
+    if base <= 0:
+        return False, [f"baseline value {base} is not positive"]
+    rel = (cur - base) / base
+    verdict = "REGRESSION" if rel > threshold else "ok"
+    lines.append(f"headline: {cur:.3f} vs baseline {base:.3f} "
+                 f"({rel:+.1%}, threshold +{threshold:.0%}) -> {verdict}")
+    cur_stages = current.get("stages") or {}
+    base_stages = baseline.get("stages") or {}
+    for k in sorted(set(cur_stages) & set(base_stages)):
+        try:
+            c, b = float(cur_stages[k]), float(base_stages[k])
+        except (TypeError, ValueError):
+            continue
+        if b > 0 and (c - b) / b > threshold:
+            lines.append(f"  stage {k}: {c:.3f} vs {b:.3f} "
+                         f"({(c - b) / b:+.1%}) [advisory]")
+    return rel <= threshold, lines

@@ -3,7 +3,8 @@
 Covers the counters the tree previously had no home for: compile-cache
 bucket hits/misses (utils/compile_cache.py), host<->device bytes per stage
 (io/feed.py, models/*), scene/worker retry and failure counts (run.py,
-bench.py), and live-HBM gauges sampled at span ends (obs/tracer.py).
+bench.py), perf-ledger append/drop counts (obs/ledger.py), and live-HBM
+gauges sampled at span ends (obs/tracer.py).
 
 Design constraints, in order:
 

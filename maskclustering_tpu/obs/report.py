@@ -1,23 +1,34 @@
-"""Run-report CLI over obs JSONL event files.
+"""Run-report CLI over obs JSONL event files + cost/ledger sections.
 
     python -m maskclustering_tpu.obs.report events.jsonl
     python -m maskclustering_tpu.obs.report new.jsonl --diff old.jsonl
+    python -m maskclustering_tpu.obs.report --cost            # live CPU AOT
+    python -m maskclustering_tpu.obs.report events.jsonl --cost  # from events
+    python -m maskclustering_tpu.obs.report --history         # PERF_LEDGER
+    python -m maskclustering_tpu.obs.report --regress BASELINE  # CI gate
 
 Renders per-stage span tables — count, p50/p95 wall, device (fenced sync)
 vs host split, per-stage host<->device bytes, HBM high-water — and diffs
-two runs stage by stage. This makes ``BENCH_*.json`` and ``run_report``
-captures self-explaining: the post.claims kernel-vs-transfer split is a
-by-product of any run with obs armed, not a bespoke diagnostic script.
+two runs stage by stage. ``--cost`` renders the compile-time cost
+observatory (obs/cost.py): per-(stage, mesh) collective census, ICI bytes
+vs v5e bandwidth, FLOPs/HBM rooflines and the XLA memory plan, computed
+entirely on CPU virtual devices. ``--history``/``--regress`` read the perf
+regression ledger (obs/ledger.py): the bench trajectory as data, with a
+non-zero exit when the newest headline p50 regresses past the threshold.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import logging
 import sys
 from typing import Dict, List, Optional
 
-from maskclustering_tpu.obs.events import KIND_METRICS, KIND_SPAN, read_events
+from maskclustering_tpu.obs.events import (KIND_COST, KIND_METRICS, KIND_SPAN,
+                                           ReadStats, read_events)
+
+log = logging.getLogger("maskclustering_tpu")
 
 
 class RunData:
@@ -28,11 +39,13 @@ class RunData:
         self.meta: Dict = {}
         self.spans: Dict[str, List[Dict]] = {}  # name -> span events, in order
         self.order: List[str] = []
+        self.cost_rows: List[Dict] = []  # cost-observatory events, in order
         self.hbm_high_water: Optional[float] = None
+        self.read_stats = ReadStats()  # torn/unknown lines: counted, warned
         metrics_by_pid: Dict = {}  # counters are monotonic PER PROCESS:
         # keep each pid's last flush, then sum counters across pids (one
         # file can hold several worker attempts plus the supervisor)
-        for ev in read_events(path):
+        for ev in read_events(path, stats=self.read_stats):
             kind = ev.get("kind")
             if kind == "meta" and not self.meta:
                 self.meta = {k: v for k, v in ev.items()
@@ -50,8 +63,13 @@ class RunData:
                 if in_use is not None and (self.hbm_high_water is None
                                            or in_use > self.hbm_high_water):
                     self.hbm_high_water = float(in_use)
+            elif kind == KIND_COST:
+                self.cost_rows.append(ev)
             elif kind == KIND_METRICS:
                 metrics_by_pid[ev.get("pid")] = ev.get("metrics") or {}
+        if self.read_stats.skipped:
+            log.warning("obs report: skipped %s in %s",
+                        self.read_stats.describe(), path)
         counters: Dict[str, float] = {}
         gauges: Dict[str, float] = {}
         for m in metrics_by_pid.values():
@@ -144,6 +162,8 @@ def render_report(run: RunData) -> str:
              _fmt_bytes(r["d2h_bytes"])]
             for r in run.stage_rows()]
     out = [f"== obs report: {run.path} =="]
+    if run.read_stats.skipped:
+        out.append(f"WARNING: skipped {run.read_stats.describe()}")
     if run.meta:
         out.append("meta: " + json.dumps(run.meta, sort_keys=True))
     out.append(_render(
@@ -186,26 +206,290 @@ def render_diff(run_a: RunData, run_b: RunData) -> str:
                                      rows)])
 
 
+# ---------------------------------------------------------------------------
+# cost observatory section (--cost)
+# ---------------------------------------------------------------------------
+
+# compact per-collective column labels for the census table
+_COLL_SHORT = (("all-gather", "ag"), ("all-reduce", "ar"),
+               ("reduce-scatter", "rs"), ("collective-permute", "cp"),
+               ("all-to-all", "a2a"), ("collective-broadcast", "cb"))
+
+
+def render_cost(rows: List[Dict]) -> str:
+    """Per-mesh tables of the cost-observatory rows (obs/cost.py).
+
+    One table per mesh config: stage rooflines (FLOPs, HBM bytes, XLA
+    memory plan peak), the collective census with payload bytes, fusion /
+    copy / transpose counts, and v5e-context lines — estimated ICI
+    microseconds at v5e link rate so "how much cross-chip talk" has units
+    a bench reader can compare with the 3.21 s/scene headline.
+    """
+    from maskclustering_tpu.obs.cost import V5E_HBM_GBPS, V5E_ICI_GBPS
+
+    if not rows:
+        return "== cost observatory: no cost events =="
+    by_mesh: Dict[tuple, List[Dict]] = {}
+    for r in rows:
+        by_mesh.setdefault(tuple(r.get("mesh") or ()), []).append(r)
+    out: List[str] = []
+    for mesh, mesh_rows in by_mesh.items():
+        fp = mesh_rows[0].get("fingerprint") or {}
+        label = (f"scene={mesh[0]} x frame={mesh[1]}" if len(mesh) == 2
+                 else str(mesh))
+        out.append(f"== cost observatory: mesh {label} "
+                   f"(F={fp.get('frames')} N={fp.get('points')} "
+                   f"k_max={fp.get('k_max')}, {fp.get('backend', '?')} AOT) ==")
+        headers = ["stage", "flops", "hbm", "peak/dev", "ici",
+                   "ag", "ar", "rs", "cp", "a2a", "fus", "copy", "trans",
+                   "out", "comp[s]"]
+        table = []
+        total_ici = 0.0
+        for r in mesh_rows:
+            if "error" in r:
+                # a failed stage stays one renderable row (padded to the
+                # header width) — it must not crash the successful rows
+                table.append(([r["stage"], "ERROR"]
+                              + ["-"] * (len(headers) - 2)))
+                continue
+            colls = r.get("collectives") or {}
+            coll_cells = [str(int(colls[name]["count"])) if name in colls
+                          else "0" for name, _ in _COLL_SHORT[:5]]
+            ici = float(r.get("ici_bytes") or 0.0)
+            total_ici += ici
+            ops = r.get("ops") or {}
+            table.append([
+                r["stage"],
+                _fmt_count(r.get("flops")),
+                _fmt_bytes(r.get("hbm_bytes")),
+                _fmt_bytes(r.get("peak_bytes")),
+                _fmt_bytes(ici), *coll_cells,
+                str(ops.get("fusion", "-")), str(ops.get("copy", "-")),
+                str(ops.get("transpose", "-")),
+                _fmt_bytes(r.get("out_bytes")),
+                f"{r.get('compile_s', 0):.1f}",
+            ])
+        out.append(_render(headers, table))
+        # v5e context: payload bytes over the per-chip ICI rate is a lower
+        # bound on the collective wall time a real slice would pay
+        ici_us = total_ici / (V5E_ICI_GBPS * 1e9) * 1e6
+        hbm_rows = [float(r.get("hbm_bytes") or 0.0) for r in mesh_rows
+                    if "error" not in r]
+        hbm_us = sum(hbm_rows) / (V5E_HBM_GBPS * 1e9) * 1e6
+        out.append(f"ICI total: {_fmt_bytes(total_ici)} "
+                   f"(>= {ici_us:.1f} us at v5e {V5E_ICI_GBPS:.0f} GB/s/chip)"
+                   f" | HBM traffic: >= {hbm_us:.0f} us at v5e "
+                   f"{V5E_HBM_GBPS:.0f} GB/s")
+        out.append("")
+    return "\n".join(out).rstrip()
+
+
+def _fmt_count(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    for unit, div in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.1f}{unit}"
+    return f"{v:.0f}"
+
+
+# ---------------------------------------------------------------------------
+# perf regression ledger sections (--history / --regress)
+# ---------------------------------------------------------------------------
+
+
+def render_history(rows: List[Dict], stats: Optional[ReadStats] = None,
+                   path: str = "") -> str:
+    """The bench trajectory, oldest first, nulls included (a null verdict
+    IS trajectory — it records the chip window that never delivered)."""
+    out = [f"== perf ledger: {path} ({len(rows)} rows) =="]
+    if stats is not None and stats.skipped:
+        out.append(f"WARNING: skipped {stats.describe()}")
+    table = []
+    import time as _time
+
+    for r in rows:
+        ts = r.get("ts")
+        when = (_time.strftime("%Y-%m-%d %H:%M", _time.gmtime(ts))
+                if isinstance(ts, (int, float)) else "-")
+        val = r.get("value")
+        stages = r.get("stages") or {}
+        top = sorted(((v, k) for k, v in stages.items()
+                      if isinstance(v, (int, float))), reverse=True)[:3]
+        table.append([
+            when, str(r.get("tool", "-")), str(r.get("git", "-")),
+            "-" if val is None else f"{val:.3f}",
+            str(r.get("unit", "-")),
+            "-" if r.get("vs_baseline") is None else f"{r['vs_baseline']:.1f}x",
+            (str(r.get("error", ""))[:40] or
+             " ".join(f"{k}={v:.2f}" for v, k in top)),
+        ])
+    out.append(_render(["when (UTC)", "tool", "git", "value", "unit",
+                        "vs_ref", "stages / error"], table))
+    return "\n".join(out)
+
+
+def _regress_eval(ledger_path: str, baseline_path: str,
+                  threshold: float) -> tuple:
+    """(exit_code, message lines, JSON-able gate record) for --regress."""
+    from maskclustering_tpu.obs import ledger as led
+
+    lines: List[str] = []
+    stats = ReadStats()
+    try:
+        rows = led.read_ledger(ledger_path, stats=stats)
+    except OSError as e:
+        msg = f"--regress: cannot read ledger {ledger_path}: {e}"
+        return 2, [msg], {"ok": False, "error": msg}
+    if stats.skipped:
+        lines.append(f"WARNING: ledger skipped {stats.describe()}")
+    baseline = led.load_baseline(baseline_path)
+    # gate comparable rows: a run-row median must not be compared against a
+    # bench baseline just because it is the newest numeric row
+    current = None
+    base_metric = baseline.get("metric") if baseline else None
+    if base_metric:
+        current = led.latest_value_row(rows, metric=base_metric)
+    if current is None:
+        current = led.latest_value_row(rows)
+        if current is not None and base_metric \
+                and current.get("metric") != base_metric:
+            lines.append(f"WARNING: no ledger row matches baseline metric "
+                         f"{base_metric!r}; gating the newest numeric row "
+                         f"({current.get('metric')!r}) — interpret with care")
+    ok, verdict_lines = led.check_regression(current, baseline,
+                                             threshold=threshold)
+    lines.append(f"== perf regress gate: {ledger_path} vs {baseline_path} ==")
+    lines.extend(verdict_lines)
+    record = {"ok": ok, "threshold": threshold,
+              "current": current, "baseline": baseline,
+              "detail": verdict_lines}
+    return (0 if ok else 2), lines, record
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m maskclustering_tpu.obs.report",
-        description="render / diff obs JSONL event captures")
-    p.add_argument("events", help="events.jsonl written by an obs-armed run")
+        description="render / diff obs JSONL event captures; cost "
+                    "observatory and perf-ledger sections")
+    p.add_argument("events", nargs="?", default=None,
+                   help="events.jsonl written by an obs-armed run (optional "
+                        "with --cost/--history/--regress)")
     p.add_argument("--diff", default=None,
                    help="second events.jsonl to diff against (B side)")
     p.add_argument("--json", action="store_true",
                    help="emit the machine-readable summary instead of tables")
+    p.add_argument("--cost", action="store_true",
+                   help="render the compile-time cost observatory: from the "
+                        "events file's cost rows when given, else computed "
+                        "live on CPU virtual devices (tiny shapes)")
+    p.add_argument("--cost-mesh", default="1x8,8x1",
+                   help="mesh configs for a live --cost run, e.g. 1x8,2x4")
+    p.add_argument("--ledger", default=None,
+                   help="perf ledger path (default: PERF_LEDGER.jsonl or "
+                        "$MCT_PERF_LEDGER)")
+    p.add_argument("--history", action="store_true",
+                   help="render the perf ledger trajectory")
+    p.add_argument("--regress", default=None, metavar="BASELINE",
+                   help="gate the ledger's newest value against BASELINE (a "
+                        "ledger JSONL or a JSON doc with a 'value'); exits 2 "
+                        "on a regression past the threshold")
+    p.add_argument("--regress-threshold", type=float, default=None,
+                   help="relative p50 slowdown that fails the gate "
+                        "(default 0.15)")
     args = p.parse_args(argv)
 
-    run = RunData(args.events)
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
+    rc = 0
+    did_something = False
+    # --json must keep stdout one machine-readable document: every
+    # requested section lands in this dict, printed exactly once at the end
+    json_doc: Dict = {}
+    sections: List[str] = []
+
+    if args.events:
+        did_something = True
+        run = RunData(args.events)
+        if args.json:
+            json_doc["summary"] = run.summary()
+        else:
+            sections.append(render_report(run))
+            if args.diff:
+                sections.append(render_diff(run, RunData(args.diff)))
+        if args.cost:
+            if run.cost_rows:
+                if args.json:
+                    json_doc["cost"] = run.cost_rows
+                else:
+                    sections.append(render_cost(run.cost_rows))
+            elif not args.json:
+                sections.append(
+                    "== cost observatory: no cost events in "
+                    f"{args.events} (generate with python -m "
+                    "maskclustering_tpu.obs.cost --events <path>) ==")
+    elif args.cost:
+        # live mode: AOT-lower on CPU virtual devices right here — no chip,
+        # no events file, just the compiled HLO's own accounting
+        did_something = True
+        from maskclustering_tpu.obs import cost as cost_mod
+
+        cost_mod.ensure_cpu_devices()
+        try:
+            meshes = cost_mod.parse_mesh_specs([args.cost_mesh])
+        except ValueError as e:
+            p.error(str(e))
+        rows = cost_mod.observe_costs(meshes)
+        if args.json:
+            json_doc["cost"] = rows
+        else:
+            sections.append(render_cost(rows))
+        if not any("error" not in r for r in rows):
+            rc = 1
+
+    if args.history or args.regress:
+        from maskclustering_tpu.obs import ledger as led
+
+        ledger_path = args.ledger or led.default_ledger_path()
+        if args.history:
+            did_something = True
+            stats = ReadStats()
+            try:
+                rows = led.read_ledger(ledger_path, stats=stats)
+            except OSError as e:
+                print(f"--history: cannot read ledger {ledger_path}: {e}",
+                      file=sys.stderr)
+                return 2
+            if args.json:
+                json_doc["history"] = rows
+            else:
+                sections.append(render_history(rows, stats, ledger_path))
+        if args.regress:
+            did_something = True
+            threshold = (args.regress_threshold
+                         if args.regress_threshold is not None
+                         else led.DEFAULT_REGRESS_THRESHOLD)
+            gate_rc, lines, record = _regress_eval(ledger_path, args.regress,
+                                                   threshold)
+            rc = max(rc, gate_rc)
+            if args.json:
+                json_doc["regress"] = record
+            else:
+                sections.append("\n".join(lines))
+
+    if not did_something:
+        p.error("nothing to do: give an events file or one of "
+                "--cost/--history/--regress")
     if args.json:
-        print(json.dumps(run.summary(), indent=2))
-        return 0
-    print(render_report(run))
-    if args.diff:
-        print()
-        print(render_diff(run, RunData(args.diff)))
-    return 0
+        # one-section --json keeps the historical flat shape (the summary
+        # document test_run and run.py's digest embed); multi-section gets
+        # the keyed document
+        if list(json_doc) == ["summary"]:
+            print(json.dumps(json_doc["summary"], indent=2))
+        else:
+            print(json.dumps(json_doc, indent=2))
+    else:
+        print("\n\n".join(sections))
+    return rc
 
 
 if __name__ == "__main__":

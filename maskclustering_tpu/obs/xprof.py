@@ -1,0 +1,90 @@
+"""Span-triggered jax.profiler trace capture (xprof).
+
+Whole-run profiler traces at bench scale are huge and usually wasted: the
+question is almost always "what does ONE clustering step / ONE post.claims
+dispatch look like on the device timeline". This module arms trace capture
+from the span tracer instead: when a span whose name matches the armed set
+opens, ``jax.profiler.start_trace`` begins; when that same span closes, the
+trace stops and flushes to ``<dir>/<span-name>-<k>``. Rules:
+
+- **bounded**: at most ``limit`` captures per span name (default 1) — a
+  311-scene run must not write 311 traces;
+- **non-reentrant**: a capture owns the profiler until its span closes;
+  nested/overlapping armed spans do not start a second trace (jax has one
+  global profiler session);
+- **best-effort**: start/stop failures log once and disarm — profiling
+  must never sink the run it profiles (same posture as the event sink).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional, Sequence
+
+log = logging.getLogger("maskclustering_tpu")
+
+
+class XprofArm:
+    """Armed capture state; consulted by Span.__enter__/__exit__."""
+
+    def __init__(self, trace_dir: str, spans: Sequence[str], *,
+                 limit: int = 1):
+        self.trace_dir = trace_dir
+        # "*" arms every span — useful for one-shot smoke captures
+        self.spans = frozenset(spans)
+        self.limit = max(int(limit), 1)
+        self.captured: Dict[str, int] = {}
+        self.active_span: Optional[str] = None
+        self.dead = False
+
+    def _matches(self, name: str) -> bool:
+        return "*" in self.spans or name in self.spans
+
+    def maybe_start(self, name: str) -> bool:
+        """Start a trace for this span; True iff this span now owns it."""
+        if self.dead or self.active_span is not None or not self._matches(name):
+            return False
+        if self.captured.get(name, 0) >= self.limit:
+            return False
+        k = self.captured.get(name, 0)
+        out = os.path.join(self.trace_dir, f"{name.replace('/', '_')}-{k}")
+        try:
+            import jax.profiler
+
+            os.makedirs(out, exist_ok=True)
+            jax.profiler.start_trace(out)
+        except Exception:  # noqa: BLE001 — never sink the run being profiled
+            log.exception("xprof: start_trace failed; disarming (%s)", out)
+            self.dead = True
+            return False
+        self.active_span = name
+        self.captured[name] = k + 1
+        log.info("xprof: capturing span %r -> %s", name, out)
+        return True
+
+    def stop(self, name: str) -> None:
+        """Stop the trace this span owns (no-op for non-owners)."""
+        if self.active_span != name:
+            return
+        self.active_span = None
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — a flush failure must not mask
+            # the span body's real exception
+            log.exception("xprof: stop_trace failed; disarming")
+            self.dead = True
+
+    def close(self) -> None:
+        """Disarm; stops a trace left open by a crashed span body."""
+        if self.active_span is not None:
+            self.stop(self.active_span)
+        self.dead = True
+
+
+def parse_spans(spec: str) -> Sequence[str]:
+    """CLI form: comma-joined span names, e.g. ``cluster,post.claims.kernel``
+    (``*`` = every span)."""
+    return tuple(s for s in spec.split(",") if s)

@@ -65,6 +65,71 @@ def _dense_mask_table(num_frames: int, k_max: int) -> Tuple[jnp.ndarray, jnp.nda
     return mask_frame, mask_id
 
 
+def _assoc_stage(cfg, k_max, mesh, scene_points, depths, segs, intrinsics,
+                 cam_to_world, frame_valid):
+    """Backprojection stage of the per-scene program (unbatched).
+
+    Compact-feed decode (io/feed.py): uint16 depth carries
+    FUSED_FEED_DEPTH_SCALE quanta by convention (pad_scene_batch only
+    engages that one scale); f32 passes through untouched. dtype is static,
+    so jit specializes one program per feed encoding.
+    """
+    if depths.dtype == jnp.uint16:
+        depths = decode_depth(depths, FUSED_FEED_DEPTH_SCALE)
+    segs = decode_seg(segs)
+
+    # ---- association: vmap over frames (sequence-parallel) ----
+    vox_size = jnp.maximum(jnp.float32(cfg.distance_threshold),
+                           estimate_spacing(scene_points))
+
+    def one_frame(depth, seg, intr, c2w, fv):
+        fa = associate_frame(
+            scene_points, depth, seg, intr, c2w, fv, vox_size,
+            k_max=k_max, window=cfg.association_window,
+            distance_threshold=cfg.distance_threshold,
+            depth_trunc=cfg.depth_trunc,
+            few_points_threshold=cfg.few_points_threshold,
+            coverage_threshold=cfg.coverage_threshold,
+        )
+        return fa.mask_of_point, fa.first_id, fa.last_id, fa.mask_valid
+
+    mop, first, last, mask_valid = jax.vmap(one_frame)(
+        depths, segs, intrinsics, cam_to_world, frame_valid)
+    mop = _maybe_constrain(mop, mesh, "frame", None)
+    first = _maybe_constrain(first, mesh, "frame", None)
+    last = _maybe_constrain(last, mesh, "frame", None)
+
+    # cross-frame reductions: XLA lowers these to psums over `frame`
+    boundary = jnp.any(first != last, axis=0)
+    return mop, first, last, mask_valid, boundary
+
+
+def _graph_stage(cfg, k_max, mesh, mop, boundary, active0):
+    """Mask-graph statistics over the dense slot table (unbatched)."""
+    f = mop.shape[0]
+    mask_frame, mask_id = _dense_mask_table(f, k_max)
+    stats = compute_graph_stats(
+        mop, boundary, mask_frame, mask_id, active0,
+        k_max=k_max, point_chunk=cfg.point_chunk,
+        mask_visible_threshold=cfg.mask_visible_threshold,
+        contained_threshold=cfg.contained_threshold,
+        undersegment_filter_threshold=cfg.undersegment_filter_threshold,
+        big_mask_point_count=cfg.big_mask_point_count,
+    )
+    visible = _maybe_constrain(stats.visible, mesh, "frame", None)
+    contained = _maybe_constrain(stats.contained, mesh, "frame", None)
+    return stats._replace(visible=visible, contained=contained)
+
+
+def _cluster_stage(cfg, mesh, visible, contained, active, schedule):
+    """Iterative view-consensus clustering (unbatched)."""
+    result = iterative_clustering(
+        visible, contained, active, schedule,
+        view_consensus_threshold=cfg.view_consensus_threshold)
+    assignment = _maybe_constrain(result.assignment, mesh, "frame")
+    return result._replace(assignment=assignment)
+
+
 def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
     """Compile-ready fused pipeline step over `mesh`.
 
@@ -77,65 +142,25 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
     """
 
     def per_scene(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid):
-        # compact-feed decode (io/feed.py): uint16 depth carries
-        # FUSED_FEED_DEPTH_SCALE quanta by convention (pad_scene_batch only
-        # engages that one scale); f32 passes through untouched. dtype is
-        # static, so jit specializes one program per feed encoding.
-        if depths.dtype == jnp.uint16:
-            depths = decode_depth(depths, FUSED_FEED_DEPTH_SCALE)
-        segs = decode_seg(segs)
+        mop, first, last, mask_valid, boundary = _assoc_stage(
+            cfg, k_max, mesh, scene_points, depths, segs, intrinsics,
+            cam_to_world, frame_valid)
         f = depths.shape[0]
-        m_pad = f * k_max
-
-        # ---- association: vmap over frames (sequence-parallel) ----
-        vox_size = jnp.maximum(jnp.float32(cfg.distance_threshold),
-                               estimate_spacing(scene_points))
-
-        def one_frame(depth, seg, intr, c2w, fv):
-            fa = associate_frame(
-                scene_points, depth, seg, intr, c2w, fv, vox_size,
-                k_max=k_max, window=cfg.association_window,
-                distance_threshold=cfg.distance_threshold,
-                depth_trunc=cfg.depth_trunc,
-                few_points_threshold=cfg.few_points_threshold,
-                coverage_threshold=cfg.coverage_threshold,
-            )
-            return fa.mask_of_point, fa.first_id, fa.last_id, fa.mask_valid
-
-        mop, first, last, mask_valid = jax.vmap(one_frame)(
-            depths, segs, intrinsics, cam_to_world, frame_valid)
-        mop = _maybe_constrain(mop, mesh, "frame", None)
-        first = _maybe_constrain(first, mesh, "frame", None)
-        last = _maybe_constrain(last, mesh, "frame", None)
-
-        # cross-frame reductions: XLA lowers these to psums over `frame`
-        boundary = jnp.any(first != last, axis=0)
 
         # ---- dense mask table + graph statistics ----
         mask_frame, mask_id = _dense_mask_table(f, k_max)
         active0 = mask_valid[mask_frame, mask_id]  # (M_pad,) slot validity
-        stats = compute_graph_stats(
-            mop, boundary, mask_frame, mask_id, active0,
-            k_max=k_max, point_chunk=cfg.point_chunk,
-            mask_visible_threshold=cfg.mask_visible_threshold,
-            contained_threshold=cfg.contained_threshold,
-            undersegment_filter_threshold=cfg.undersegment_filter_threshold,
-            big_mask_point_count=cfg.big_mask_point_count,
-        )
-        visible = _maybe_constrain(stats.visible, mesh, "frame", None)
-        contained = _maybe_constrain(stats.contained, mesh, "frame", None)
+        stats = _graph_stage(cfg, k_max, mesh, mop, boundary, active0)
 
         # ---- schedule + clustering, all on device ----
         schedule = observer_schedule_device(
             stats.observer_hist, max_len=cfg.max_cluster_iterations)
         active = active0 & ~stats.undersegment
-        result = iterative_clustering(
-            visible, contained, active, schedule,
-            view_consensus_threshold=cfg.view_consensus_threshold)
-        assignment = _maybe_constrain(result.assignment, mesh, "frame")
+        result = _cluster_stage(cfg, mesh, stats.visible, stats.contained,
+                                active, schedule)
         num_objects = jnp.sum(result.node_active & active).astype(jnp.int32)
         return FusedStepResult(
-            assignment=assignment,
+            assignment=result.assignment,
             node_visible=result.node_visible,
             mask_active=active,
             mask_of_point=mop,
@@ -171,6 +196,100 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
         out_shardings=out_shardings,
         donate_argnums=(1, 2) if donate else (),
     )
+
+
+# ---------------------------------------------------------------------------
+# per-stage AOT hooks (the compile-time cost observatory, obs/cost.py)
+# ---------------------------------------------------------------------------
+
+# the staged stage functions the observatory lowers, in pipeline order;
+# "fused" (the whole step) is handled by build_fused_step directly
+STAGE_NAMES = ("backprojection", "graph", "clustering", "postprocess")
+
+
+def build_stage_step(stage: str, mesh, cfg, *, k_max: int = 15,
+                     r_pad: int = 64):
+    """One pipeline stage as a compile-ready jitted program over ``mesh``.
+
+    The cost observatory (obs/cost.py) AOT-lowers these with abstract
+    shapes (`stage_arg_shapes`) to read per-stage FLOPs, HBM traffic,
+    XLA's memory plan, and the collective census out of the compiled HLO
+    — nothing is ever materialized, so this runs on CPU virtual devices.
+
+    Stages reuse the exact per-scene sections the fused step runs
+    (`_assoc_stage` / `_graph_stage` / `_cluster_stage`), batched with
+    ``spmd_axis_name="scene"`` and the fused step's input shardings, so
+    the census reflects the production program, not a lookalike.
+
+    ``postprocess`` is the `post.claims` node-stats kernel
+    (models/postprocess_device._node_stats_kernel): it runs per-scene on
+    one chip in production, so it compiles unsharded regardless of
+    ``mesh`` (its census answers the kernel-vs-tunnel question — fusion
+    and copy counts — not an ICI question).
+    """
+    if stage not in STAGE_NAMES:
+        raise ValueError(f"unknown stage {stage!r}; valid: {STAGE_NAMES}")
+
+    if stage == "postprocess":
+        from maskclustering_tpu.models.postprocess_device import _node_stats_kernel
+
+        def post(first, last, rep_tab, node_visible, live_slots, live_valid):
+            return _node_stats_kernel(
+                first, last, rep_tab, node_visible, live_slots, live_valid,
+                r_pad=r_pad,
+                point_filter_threshold=float(cfg.point_filter_threshold))
+
+        return jax.jit(post)
+
+    if stage == "backprojection":
+        fn = lambda *args: _assoc_stage(cfg, k_max, mesh, *args)  # noqa: E731
+        specs = (("scene",), ("scene", "frame"), ("scene", "frame"),
+                 ("scene", "frame"), ("scene", "frame"), ("scene", "frame"))
+    elif stage == "graph":
+        fn = lambda *args: _graph_stage(cfg, k_max, mesh, *args)  # noqa: E731
+        specs = (("scene", "frame", None), ("scene",), ("scene", "frame"))
+    else:  # clustering
+        fn = lambda *args: _cluster_stage(cfg, mesh, *args)  # noqa: E731
+        specs = (("scene", "frame", None), ("scene", "frame", None),
+                 ("scene", "frame"), ("scene",))
+
+    if mesh is None:
+        return jax.jit(jax.vmap(fn))
+    return jax.jit(jax.vmap(fn, spmd_axis_name="scene"),
+                   in_shardings=tuple(sharding(mesh, *s) for s in specs))
+
+
+def stage_arg_shapes(stage: str, *, scenes: int = 1, frames: int = 8,
+                     points: int = 4096, image_hw: Tuple[int, int] = (32, 48),
+                     k_max: int = 15, max_iters: int = 20, r_pad: int = 64):
+    """Abstract argument shapes for ``build_stage_step(stage, ...).lower``.
+
+    Shapes follow the fused path's dense slot layout: ``M_pad = F * k_max``;
+    the clustering schedule is the fixed-length observer-threshold vector
+    (cfg.max_cluster_iterations). ``postprocess`` uses the claims kernel's
+    own operands with ``k2 = k_max + 2`` local-id rows and ``r_pad`` live
+    representative slots (floor 64, matching _live_rep_prep).
+    """
+    s, f, n = scenes, frames, points
+    h, w = image_hw
+    m_pad = f * k_max
+    sds = jax.ShapeDtypeStruct
+    if stage == "backprojection":
+        return (sds((s, n, 3), jnp.float32), sds((s, f, h, w), jnp.uint16),
+                sds((s, f, h, w), jnp.uint16), sds((s, f, 3, 3), jnp.float32),
+                sds((s, f, 4, 4), jnp.float32), sds((s, f), jnp.bool_))
+    if stage == "graph":
+        return (sds((s, f, n), jnp.int32), sds((s, n), jnp.bool_),
+                sds((s, m_pad), jnp.bool_))
+    if stage == "clustering":
+        return (sds((s, m_pad, f), jnp.bool_), sds((s, m_pad, m_pad), jnp.bool_),
+                sds((s, m_pad), jnp.bool_), sds((s, max_iters), jnp.float32))
+    if stage == "postprocess":
+        k2 = k_max + 2
+        return (sds((f, n), jnp.int32), sds((f, n), jnp.int32),
+                sds((f, k2), jnp.int32), sds((m_pad, f), jnp.bool_),
+                sds((r_pad,), jnp.int32), sds((r_pad,), jnp.bool_))
+    raise ValueError(f"unknown stage {stage!r}; valid: {STAGE_NAMES}")
 
 
 def fused_step_example_args(num_scenes: int = 2, num_frames: int = 8,

@@ -571,6 +571,10 @@ def run_pipeline(
     profile_dir: Optional[str] = None,
     report_path: Optional[str] = None,
     obs_events: Optional[str] = None,
+    xprof_spans: Optional[Sequence[str]] = None,
+    xprof_dir: Optional[str] = None,
+    ledger_path: Optional[str] = None,
+    ledger: bool = True,
 ) -> RunReport:
     unknown = set(steps) - set(ALL_STEPS)
     if unknown:
@@ -582,14 +586,25 @@ def run_pipeline(
         # truncate: this call owns the path (typically derived from
         # --report, which is itself overwritten); appending to a previous
         # run's capture would silently pool stale spans into the digest
+        if xprof_spans and profile_dir:
+            # jax has ONE profiler session; the whole-run trace owns it
+            log.warning("--xprof ignored: --profile_dir already owns the "
+                        "profiler session")
+            xprof_spans = None
+        if xprof_spans and not xprof_dir:
+            root, _ = os.path.splitext(obs_events)
+            xprof_dir = root + "_xprof"
         obs.configure(obs_events, annotations=bool(profile_dir), truncate=True,
-                      meta={"tool": "run", "config": cfg.config_name})
+                      meta={"tool": "run", "config": cfg.config_name},
+                      xprof_dir=xprof_dir,
+                      xprof_spans=tuple(xprof_spans) if xprof_spans else None)
         try:
             return _run_pipeline_body(
                 cfg, seq_names, steps=steps, workers=workers, resume=resume,
                 encoder_spec=encoder_spec, mask_command=mask_command,
                 mask_predictor=mask_predictor, profile_dir=profile_dir,
-                report_path=report_path, obs_events=obs_events)
+                report_path=report_path, obs_events=obs_events,
+                ledger_path=ledger_path, ledger=ledger)
         finally:
             # a step/encoder exception must not leave the global tracer
             # armed (fences on, sink open) for the rest of the process —
@@ -599,7 +614,8 @@ def run_pipeline(
         cfg, seq_names, steps=steps, workers=workers, resume=resume,
         encoder_spec=encoder_spec, mask_command=mask_command,
         mask_predictor=mask_predictor, profile_dir=profile_dir,
-        report_path=report_path, obs_events=None)
+        report_path=report_path, obs_events=None,
+        ledger_path=ledger_path, ledger=ledger)
 
 
 def _run_pipeline_body(
@@ -615,6 +631,8 @@ def _run_pipeline_body(
     profile_dir: Optional[str],
     report_path: Optional[str],
     obs_events: Optional[str],
+    ledger_path: Optional[str] = None,
+    ledger: bool = True,
 ) -> RunReport:
     from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
 
@@ -713,6 +731,20 @@ def _run_pipeline_body(
         # run_pipeline's finally disarms; nothing more to do here
     if report_path:
         report.save(report_path)
+    if ledger and report_path and report.scenes:
+        # one trajectory row per reported run (schema-versioned, crash-safe
+        # append): `obs.report --history` renders it, `--regress` gates it
+        try:
+            from maskclustering_tpu.obs import ledger as led
+
+            led.append_row(
+                ledger_path or led.default_ledger_path(),
+                led.run_row({"config_name": report.config_name,
+                             "scenes": [dataclasses.asdict(s)
+                                        for s in report.scenes],
+                             "obs": report.obs}))
+        except Exception:  # noqa: BLE001 — the ledger must never fail the run
+            log.exception("perf ledger append failed")
     return report
 
 
@@ -758,6 +790,19 @@ def main(argv=None) -> int:
                              "python -m maskclustering_tpu.obs.report)")
     parser.add_argument("--no-obs", action="store_true",
                         help="disable obs capture even when --report is set")
+    parser.add_argument("--xprof", default=None, metavar="STAGE",
+                        help="comma-joined span names to bracket with a "
+                             "jax.profiler trace (first occurrence each; "
+                             "e.g. cluster or post.claims.kernel; needs obs "
+                             "capture, i.e. --report or --obs_events)")
+    parser.add_argument("--xprof_dir", default=None,
+                        help="trace output dir for --xprof (default: "
+                             "derived from the events path)")
+    parser.add_argument("--ledger", default=None,
+                        help="perf ledger JSONL the run digest appends to "
+                             "(default: PERF_LEDGER.jsonl / $MCT_PERF_LEDGER)")
+    parser.add_argument("--no-ledger", action="store_true",
+                        help="do not append this run to the perf ledger")
     parser.add_argument("--data_root", default=None,
                         help="override the config's data root")
     parser.add_argument("--init_timeout", type=float, default=120.0,
@@ -783,6 +828,16 @@ def main(argv=None) -> int:
     if args.no_obs:
         obs_events = None
 
+    xprof_spans = None
+    if args.xprof:
+        if obs_events is None:
+            log.warning("--xprof needs obs capture (--report or "
+                        "--obs_events); ignored")
+        else:
+            from maskclustering_tpu.obs.xprof import parse_spans
+
+            xprof_spans = parse_spans(args.xprof)
+
     t0 = time.time()
     report = run_pipeline(
         cfg, seq_names,
@@ -794,6 +849,10 @@ def main(argv=None) -> int:
         profile_dir=args.profile_dir,
         report_path=args.report,
         obs_events=obs_events,
+        xprof_spans=xprof_spans,
+        xprof_dir=args.xprof_dir,
+        ledger_path=args.ledger,
+        ledger=not args.no_ledger,
     )
     total = time.time() - t0
     log.info("total time %.1f min (%.1f s/scene)", total / 60,

@@ -20,6 +20,11 @@
 #   4. bench.py --frame-batch 8 (A/B)          -> bench_fb8.out (JSON line)
 #   5. northstar sweep (multi-bucket, ~3 min)  -> northstar.out + NORTHSTAR_live.md
 #   6. obs report render of the bench captures -> obs_report.out
+#   7. cost observatory (CPU AOT; no chip time) -> cost_census.out + cost_events.jsonl
+#   8. perf ledger history + regress gate      -> perf_ledger.out
+#      (bench steps above append rows to PERF_LEDGER.jsonl by default)
+#   MCT_XPROF=SPANS adds a 1-repeat xprof capture bench step (e.g.
+#   MCT_XPROF=cluster,post.claims.kernel) -> xprof_trace.out + $OUT/xprof/
 set -u
 cd "$(dirname "$0")/.."
 # date AND time in the default OUTDIR: same-minute sessions on later days
@@ -65,6 +70,13 @@ run bench_default 900 python bench.py --retry-budget 300 --init-attempts 2 "${OB
 run claims_diag   600 python scripts/claims_diag.py ${PLAT[@]+"${PLAT[@]}"} ${DIAG_QUICK[@]+"${DIAG_QUICK[@]}"}
 run fb_identity   600 python scripts/fb_identity.py --frame-batch 8 ${PLAT[@]+"${PLAT[@]}"}
 run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8 "${OBS_FB8[@]}" ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
+if [ -n "${MCT_XPROF:-}" ] && [ -z "${MCT_NO_OBS:-}" ]; then
+  # span-triggered profiler capture: one repeat, first opening of each
+  # named span is bracketed by start/stop_trace (obs/xprof.py)
+  run xprof_trace 600 python bench.py --retry-budget 200 --init-attempts 2 --repeats 1 \
+    --obs-events "$OUT/xprof_events.jsonl" --xprof "$MCT_XPROF" --xprof-dir "$OUT/xprof" \
+    --no-ledger ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
+fi
 run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md" ${PLAT[@]+"${PLAT[@]}"} ${NS_QUICK[@]+"${NS_QUICK[@]}"}
 if [ -z "${MCT_NO_OBS:-}" ] && [ -f "$OUT/bench_default_events.jsonl" ]; then
   if [ -f "$OUT/bench_fb8_events.jsonl" ]; then
@@ -72,6 +84,19 @@ if [ -z "${MCT_NO_OBS:-}" ] && [ -f "$OUT/bench_default_events.jsonl" ]; then
   else
     run obs_report 120 python -m maskclustering_tpu.obs.report "$OUT/bench_default_events.jsonl"
   fi
+fi
+# cost observatory: CPU AOT — costs no chip time, so it runs even in a
+# dead window (the census is backend-shaped by the mesh, not chip-timed)
+COST_SHAPE=(--frames 64 --points 65536 --image-h 240 --image-w 320 --k-max 63)
+[ -n "${MCT_QUICK:-}" ] && COST_SHAPE=(--frames 8 --points 1024 --image-h 24 --image-w 32 --k-max 7)
+run cost_census 900 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.obs.cost \
+  --events "$OUT/cost_events.jsonl" --mesh 1x8 --mesh 8x1 "${COST_SHAPE[@]}"
+# perf ledger: render the trajectory the bench steps above just appended
+# to, and gate against the last committed good verdict when present
+if [ -f BENCH_builder_r05.json ]; then
+  run perf_ledger 120 python -m maskclustering_tpu.obs.report --history --regress BENCH_builder_r05.json
+else
+  run perf_ledger 120 python -m maskclustering_tpu.obs.report --history
 fi
 echo "[chip_session] done; JSON lines:"
 grep -h '"value"' "$OUT"/bench_*.out 2>/dev/null

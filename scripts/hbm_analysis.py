@@ -91,6 +91,13 @@ def main():
               file=sys.stderr)
         sys.exit(2)
 
+    # the full static cost row (collective + op census, rooflines) comes
+    # from the shared observatory rig — one extraction path for this
+    # script, obs.cost, and the report CLI
+    from maskclustering_tpu.obs.cost import analyze_compiled
+
+    cost = analyze_compiled(compiled, lower_s=t_lower, compile_s=t_compile)
+
     def gb(x):
         return x / (1 << 30)
 
@@ -130,6 +137,20 @@ def main():
         f"(v5e HBM {V5E_HBM_GB:.0f} GB -> headroom {headroom:.1f} GB)",
         f"compile: lower {t_lower:.1f}s + compile {t_compile:.1f}s",
     ]
+    census = cost.get("collectives") or {}
+    if census:
+        lines.append("collectives: " + ", ".join(
+            f"{op} x{int(c['count'])} ({c['bytes']:.0f} B)"
+            for op, c in sorted(census.items()))
+            + f" -> ICI payload {cost['ici_bytes']:.0f} B")
+    else:
+        lines.append("collectives: none (no cross-chip traffic in the plan)")
+    ops = cost.get("ops") or {}
+    lines.append(f"op census: {ops.get('fusion', 0)} fusions, "
+                 f"{ops.get('copy', 0)} copies, "
+                 f"{ops.get('transpose', 0)} transposes; "
+                 f"flops {cost.get('flops')}, "
+                 f"hbm bytes {cost.get('hbm_bytes')}")
     print("\n".join(lines))
     if args.out != "-":
         with open(args.out, "a") as fh:

@@ -23,6 +23,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _hermetic_perf_ledger(tmp_path, monkeypatch):
+    """bench.py / run.py append perf-ledger rows by DEFAULT; tests must not
+    grow the repo's PERF_LEDGER.jsonl, so every test gets a throwaway one
+    (subprocess-based tests inherit it through the environment)."""
+    monkeypatch.setenv("MCT_PERF_LEDGER", str(tmp_path / "perf_ledger.jsonl"))
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,196 @@
+"""Cost-observatory contract tests (obs/cost.py + sharded stage hooks).
+
+Two layers:
+
+- pure HLO-text parsing units (no jax) — shape-byte arithmetic and the
+  collective/op censuses over canned module text;
+- real AOT compiles on the 8-virtual-CPU-device mesh (conftest) across the
+  scene/frame divisor lattice of 8 — pinning the VERDICT Weak #5 claim as
+  a test: frame-sharded configs compile to a non-empty collective census,
+  pure scene-DP compiles to zero DATA collectives (the only cross-scene
+  traffic is O(1)-byte while-loop predicates).
+"""
+
+import json
+
+import pytest
+
+from maskclustering_tpu.obs.cost import (
+    collective_census,
+    ici_bytes,
+    observe_costs,
+    op_census,
+    shape_bytes,
+)
+
+# ---------------------------------------------------------------------------
+# HLO text parsing (no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_shape_bytes_plain_scalar_tuple():
+    assert shape_bytes("f32[64,8]{0,1}") == 64 * 8 * 4
+    assert shape_bytes("pred[]") == 1
+    assert shape_bytes("u16[480,640]{1,0}") == 480 * 640 * 2
+    assert shape_bytes("(f32[8,2]{1,0}, u8[4]{0})") == 8 * 2 * 4 + 4
+    assert shape_bytes("bf16[128]") == 256
+    # unknown primitive types contribute 0, never raise
+    assert shape_bytes("mystery9[10]") == 0
+
+
+_CANNED_HLO = """\
+HloModule canned, is_scheduled=true
+
+%fused_computation (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %t = f32[8]{0} transpose(f32[8]{0} %p0), dimensions={0}
+}
+
+ENTRY %main (a: f32[64,2]) -> f32[8] {
+  %a = f32[64,2]{1,0} parameter(0)
+  %ag = f32[64,8]{0,1} all-gather(f32[64,2]{0,1} %a), channel_id=1
+  %cp = f32[64,8]{1,0} copy(f32[64,8]{0,1} %ag)
+  %ags = f32[64,16]{0,1} all-gather-start(f32[64,2]{0,1} %a), channel_id=3
+  %agd = f32[64,16]{0,1} all-gather-done(f32[64,16]{0,1} %ags)
+  %cps = (f32[1024]{0}, f32[1024]{0}, u32[], u32[]) collective-permute-start(f32[1024]{0} %a), channel_id=4
+  %cpd = f32[1024]{0} collective-permute-done((f32[1024]{0}, f32[1024]{0}, u32[], u32[]) %cps)
+  %f = f32[8]{0} fusion(f32[8]{0} %a2), kind=kLoop, calls=%fused_computation
+  ROOT %ar = pred[] all-reduce(pred[] %x), channel_id=2
+}
+"""
+
+
+def test_collective_census_counts_and_bytes():
+    census = collective_census(_CANNED_HLO)
+    # -start counted once, -done never (that would double-count)
+    assert census["all-gather"]["count"] == 2
+    assert census["all-gather"]["bytes"] == 64 * 8 * 4 + 64 * 16 * 4
+    assert census["all-reduce"] == {"count": 1, "bytes": 1.0}
+    # an async start's tuple aliases operand AND result buffers (plus u32
+    # context scalars): payload is the LARGEST element, never the tuple sum
+    assert census["collective-permute"] == {"count": 1, "bytes": 1024 * 4}
+    assert "reduce-scatter" not in census
+    assert ici_bytes(census) == 64 * 8 * 4 + 64 * 16 * 4 + 1 + 1024 * 4
+
+
+def test_op_census_counts():
+    ops = op_census(_CANNED_HLO)
+    assert ops["fusion"] == 1
+    assert ops["copy"] == 1
+    assert ops["transpose"] == 1
+
+
+# ---------------------------------------------------------------------------
+# real AOT compiles on the 8-virtual-device CPU mesh
+# ---------------------------------------------------------------------------
+
+_TINY = dict(frames=8, points=512, image_hw=(16, 24), k_max=7)
+
+# the full divisor lattice of 8: every (scene, frame) factorization
+_LATTICE = [(1, 8), (2, 4), (4, 2), (8, 1)]
+
+
+@pytest.fixture(scope="module")
+def lattice_rows():
+    """One fused-step census per lattice mesh (module-scoped: compiles are
+    the expensive part, every test below reads the same sweep)."""
+    rows = observe_costs(_LATTICE, stages=("fused",), **_TINY)
+    assert len(rows) == len(_LATTICE), "every mesh must fit the 8 devices"
+    return {tuple(r["mesh"]): r for r in rows}
+
+
+def test_lattice_covers_all_meshes(lattice_rows):
+    assert set(lattice_rows) == set(_LATTICE)
+    for row in lattice_rows.values():
+        assert "error" not in row, row
+
+
+def test_frame_sharded_census_non_empty(lattice_rows):
+    """Any mesh with a frame axis > 1 must show real ICI traffic: the
+    consensus matmuls all-gather their row shards."""
+    for mesh in ((1, 8), (2, 4), (4, 2)):
+        row = lattice_rows[mesh]
+        census = row["collectives"]
+        assert census, f"mesh {mesh}: empty collective census"
+        assert census.get("all-gather", {}).get("count", 0) > 0, \
+            f"mesh {mesh}: no all-gather in a frame-sharded compile"
+        # payload must be real data, not just control scalars
+        assert row["ici_bytes"] > 1024, f"mesh {mesh}: {row['ici_bytes']}"
+
+
+def test_pure_scene_dp_has_no_data_collectives(lattice_rows):
+    """VERDICT Weak #5 as a test: scene data-parallelism compiles to no
+    cross-scene DATA movement. XLA still emits O(1)-byte pred[] all-reduces
+    for while-loop termination agreement — bounded here so a future graph
+    change that introduces real cross-scene traffic fails loudly."""
+    row = lattice_rows[(8, 1)]
+    census = row["collectives"]
+    for op in ("all-gather", "reduce-scatter", "collective-permute",
+               "all-to-all"):
+        assert op not in census, f"scene-DP compile grew a {op}"
+    # while-predicate all-reduces only: a handful of scalar bytes
+    assert row["ici_bytes"] <= 64, row["ici_bytes"]
+
+
+def test_stage_rows_roofline_fields_and_post_claims_census():
+    """tier-1 smoke: every stage row carries rooflines + censuses, and the
+    post.claims kernel (postprocess) has a static fusion census with zero
+    collectives — the kernel-vs-tunnel question's static half."""
+    rows = observe_costs([(1, 8)], **_TINY)
+    assert [r["stage"] for r in rows] == [
+        "backprojection", "graph", "clustering", "postprocess", "fused"]
+    for row in rows:
+        assert "error" not in row, row
+        assert row["flops"] and row["flops"] > 0
+        assert row["hbm_bytes"] and row["hbm_bytes"] > 0
+        assert row["peak_bytes"] is not None
+        assert row["ops"]["fusion"] > 0
+        json.dumps(row)  # every row must be JSON-able (the event contract)
+    post = rows[3]
+    assert post["collectives"] == {}  # per-scene kernel: no ICI story
+    assert post["ops"]["fusion"] > 0
+    # the fused program must see the ICI the stage compiles predict
+    assert rows[4]["ici_bytes"] > 0
+
+
+def test_report_cost_renders_from_events(tmp_path, capsys):
+    """cost events round-trip through the sink into `report --cost`."""
+    from maskclustering_tpu.obs.events import EventSink
+    from maskclustering_tpu.obs.report import main
+
+    path = str(tmp_path / "cost_events.jsonl")
+    sink = EventSink(path)
+    rows = observe_costs([(1, 8)], stages=("graph",), sink=sink, **_TINY)
+    sink.close()
+    assert rows and "error" not in rows[0]
+    assert main([path, "--cost"]) == 0
+    out = capsys.readouterr().out
+    assert "cost observatory" in out
+    assert "mesh scene=1 x frame=8" in out
+    assert "graph" in out and "ici" in out
+    assert "v5e" in out
+
+
+def test_mesh_that_does_not_fit_is_skipped():
+    rows = observe_costs([(3, 5)], stages=("graph",), **_TINY)
+    assert rows == []  # 15 devices never fit the 8-device backend
+
+
+def test_render_cost_survives_error_rows():
+    """A stage that failed to compile renders as one ERROR row — it must
+    not crash the table that carries the successful stages."""
+    from maskclustering_tpu.obs.report import render_cost
+
+    rows = [
+        {"stage": "graph", "mesh": [1, 8], "flops": 1e9, "hbm_bytes": 1e6,
+         "peak_bytes": 2e6, "ici_bytes": 512.0,
+         "collectives": {"all-gather": {"count": 2, "bytes": 512.0}},
+         "ops": {"fusion": 3, "copy": 1, "transpose": 0},
+         "out_bytes": 100.0, "compile_s": 0.1,
+         "fingerprint": {"frames": 8, "points": 512, "k_max": 7}},
+        {"stage": "clustering", "mesh": [1, 8],
+         "error": "XlaRuntimeError: boom",
+         "fingerprint": {"frames": 8, "points": 512, "k_max": 7}},
+    ]
+    out = render_cost(rows)
+    assert "graph" in out and "ERROR" in out and "clustering" in out

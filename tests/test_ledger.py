@@ -1,0 +1,213 @@
+"""Perf-ledger contract tests (obs/ledger.py + report --history/--regress).
+
+Pins: schema-versioned append/read round-trip, crash-tolerant reads with
+counted skips, baseline loading from both ledger JSONL and bench-verdict
+JSON documents, and the CI gate — `report --regress` exits non-zero on an
+injected >15% p50 regression and zero inside the threshold.
+"""
+
+import json
+
+from maskclustering_tpu.obs import ledger as led
+from maskclustering_tpu.obs.events import ReadStats
+from maskclustering_tpu.obs.report import main as report_main
+
+
+def _verdict(value, stages=None, **kw):
+    v = {"metric": "bench s/scene", "value": value, "unit": "s/scene"}
+    if stages:
+        v["stages"] = stages
+    v.update(kw)
+    return v
+
+
+def test_append_read_round_trip(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    assert led.append_row(path, led.bench_row(
+        _verdict(3.2, stages={"associate": 1.1}, vs_baseline=23.4,
+                 attempts=1)))
+    assert led.append_row(path, led.bench_row(_verdict(None, error="wedge")))
+    rows = led.read_ledger(path)
+    assert len(rows) == 2
+    assert rows[0]["v"] == led.LEDGER_SCHEMA_VERSION
+    assert rows[0]["tool"] == "bench"
+    assert rows[0]["value"] == 3.2
+    assert rows[0]["stages"] == {"associate": 1.1}
+    assert rows[0]["vs_baseline"] == 23.4
+    assert "ts" in rows[0] and "pid" in rows[0]
+    assert rows[1]["value"] is None and rows[1]["error"] == "wedge"
+    # newest NUMERIC row wins; a null verdict is history, not a baseline
+    assert led.latest_value_row(rows)["value"] == 3.2
+
+
+def test_run_row_digest(tmp_path):
+    report = {
+        "config_name": "demo",
+        "scenes": [
+            {"status": "ok", "seconds": 2.0},
+            {"status": "ok", "seconds": 4.0},
+            {"status": "ok", "seconds": 3.0},
+            {"status": "failed", "seconds": 9.9},
+        ],
+        "obs": {"stages": {"associate": {"p50_s": 1.2},
+                           "cluster": {"p50_s": 0.3}}},
+    }
+    row = led.run_row(report)
+    assert row["tool"] == "run"
+    assert row["value"] == 3.0  # median of ok scenes; failures excluded
+    assert row["scenes_ok"] == 3 and row["scenes_failed"] == 1
+    assert row["stages"] == {"associate": 1.2, "cluster": 0.3}
+
+
+def test_read_tolerates_torn_and_unknown_lines_with_counts(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    led.append_row(path, led.bench_row(_verdict(1.0)))
+    with open(path, "a") as f:
+        f.write(json.dumps({"v": 999, "value": 0.5}) + "\n")
+        f.write('{"v": 1, "value": 2.0, "tru')  # crash mid-write
+    stats = ReadStats()
+    rows = led.read_ledger(path, stats=stats)
+    assert [r["value"] for r in rows] == [1.0]
+    assert stats.torn == 1 and stats.unknown_version == 1
+    assert stats.skipped == 2
+    assert "1 torn" in stats.describe()
+
+
+def test_check_regression_thresholds():
+    base = {"value": 1.0, "stages": {"associate": 0.5}}
+    ok, _ = led.check_regression({"value": 1.10}, base)
+    assert ok  # +10% is inside the 15% gate
+    ok, lines = led.check_regression(
+        {"value": 1.30, "stages": {"associate": 0.9}}, base)
+    assert not ok
+    assert any("REGRESSION" in ln for ln in lines)
+    assert any("stage associate" in ln for ln in lines)  # advisory drift
+    ok, _ = led.check_regression(None, base)
+    assert not ok  # an empty trajectory must not pass a CI gate
+    ok, _ = led.check_regression({"value": 1.0}, None)
+    assert not ok
+
+
+def test_report_regress_exit_codes(tmp_path, capsys):
+    """The acceptance gate: injected 15%+ regression -> non-zero exit."""
+    baseline = str(tmp_path / "baseline.json")
+    with open(baseline, "w") as f:
+        json.dump(_verdict(1.0), f)
+    ledger = str(tmp_path / "ledger.jsonl")
+
+    led.append_row(ledger, led.bench_row(_verdict(1.2)))  # +20%: regression
+    rc = report_main(["--ledger", ledger, "--regress", baseline])
+    assert rc == 2
+    assert "REGRESSION" in capsys.readouterr().out
+
+    ledger2 = str(tmp_path / "ledger2.jsonl")
+    led.append_row(ledger2, led.bench_row(_verdict(1.05)))  # +5%: fine
+    rc = report_main(["--ledger", ledger2, "--regress", baseline])
+    assert rc == 0
+    # custom threshold flag tightens the gate
+    rc = report_main(["--ledger", ledger2, "--regress", baseline,
+                      "--regress-threshold", "0.01"])
+    assert rc == 2
+    capsys.readouterr()
+
+
+def test_report_regress_baseline_from_ledger(tmp_path, capsys):
+    base_ledger = str(tmp_path / "base.jsonl")
+    led.append_row(base_ledger, led.bench_row(_verdict(2.0)))
+    led.append_row(base_ledger, led.bench_row(_verdict(None, error="x")))
+    cur = str(tmp_path / "cur.jsonl")
+    led.append_row(cur, led.bench_row(_verdict(2.1)))
+    # baseline = newest NUMERIC row of the baseline ledger (2.0); +5% passes
+    assert report_main(["--ledger", cur, "--regress", base_ledger]) == 0
+    capsys.readouterr()
+
+
+def test_regress_gates_comparable_metric_rows(tmp_path, capsys):
+    """A newer run-row (different metric) must not hijack the gate when a
+    comparable bench row exists; with no comparable row the gate falls
+    back to the newest numeric row WITH a printed warning."""
+    baseline = str(tmp_path / "baseline.json")
+    with open(baseline, "w") as f:
+        json.dump(_verdict(1.0), f)  # metric: "bench s/scene"
+    ledger = str(tmp_path / "ledger.jsonl")
+    led.append_row(ledger, led.bench_row(_verdict(1.05)))
+    # a big slow run-row lands AFTER the bench row, with its own metric
+    led.append_row(ledger, {"tool": "run", "metric": "run s/scene",
+                            "value": 9.0, "unit": "s/scene"})
+    rc = report_main(["--ledger", ledger, "--regress", baseline])
+    out = capsys.readouterr().out
+    assert rc == 0, out  # gated 1.05 vs 1.0, not 9.0 vs 1.0
+    assert "1.050" in out
+
+    # only the incomparable row present -> fallback + warning, still gates
+    ledger2 = str(tmp_path / "ledger2.jsonl")
+    led.append_row(ledger2, {"tool": "run", "metric": "run s/scene",
+                             "value": 9.0, "unit": "s/scene"})
+    rc = report_main(["--ledger", ledger2, "--regress", baseline])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "no ledger row matches baseline metric" in out
+
+
+def test_report_json_is_one_document_across_sections(tmp_path, capsys):
+    """--json with --history/--regress must keep stdout one parseable JSON
+    document (no tables after it)."""
+    baseline = str(tmp_path / "baseline.json")
+    with open(baseline, "w") as f:
+        json.dump(_verdict(1.0), f)
+    ledger = str(tmp_path / "ledger.jsonl")
+    led.append_row(ledger, led.bench_row(_verdict(1.3)))
+    rc = report_main(["--ledger", ledger, "--json", "--history",
+                      "--regress", baseline])
+    assert rc == 2  # the gate verdict still drives the exit code
+    doc = json.loads(capsys.readouterr().out)  # parseable => contract holds
+    assert [r["value"] for r in doc["history"]] == [1.3]
+    assert doc["regress"]["ok"] is False
+    assert doc["regress"]["current"]["value"] == 1.3
+
+
+def test_latest_value_row_metric_filter():
+    rows = [{"value": 1.0, "metric": "a"}, {"value": None, "metric": "a"},
+            {"value": 2.0, "metric": "b"}]
+    assert led.latest_value_row(rows)["value"] == 2.0
+    assert led.latest_value_row(rows, metric="a")["value"] == 1.0
+    assert led.latest_value_row(rows, metric="zzz") is None
+
+
+def test_report_history_renders(tmp_path, capsys):
+    ledger = str(tmp_path / "ledger.jsonl")
+    led.append_row(ledger, led.bench_row(
+        _verdict(3.206, stages={"associate": 1.091}, vs_baseline=23.39)))
+    led.append_row(ledger, led.bench_row(_verdict(None, error="backend init "
+                                                  "timed out")))
+    assert report_main(["--ledger", ledger, "--history"]) == 0
+    out = capsys.readouterr().out
+    assert "perf ledger" in out and "2 rows" in out
+    assert "3.206" in out and "23.4x" in out
+    assert "backend init" in out  # null verdicts stay on the record
+
+
+def test_bench_appends_ledger_row_by_default(tmp_path, monkeypatch):
+    """bench.py --worker on CPU: the verdict line lands in the ledger
+    (MCT_PERF_LEDGER routes it; conftest sets a per-test default)."""
+    import os
+    import subprocess
+    import sys
+
+    ledger = str(tmp_path / "bench_ledger.jsonl")
+    env = dict(os.environ, MCT_PERF_LEDGER=ledger, JAX_PLATFORMS="cpu")
+    env.pop("MCT_BENCH_SUPERVISED", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--worker", "--platform", "cpu",
+         "--frames", "4", "--points", "1024", "--boxes", "2",
+         "--image-h", "32", "--image-w", "48", "--repeats", "1",
+         "--spacing", "0.1", "--k-max", "7"],
+        capture_output=True, timeout=600, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    rows = led.read_ledger(ledger)
+    assert len(rows) == 1
+    assert rows[0]["value"] == verdict["value"]
+    assert rows[0]["tool"] == "bench"
+    assert rows[0]["v"] == led.LEDGER_SCHEMA_VERSION

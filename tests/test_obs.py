@@ -178,6 +178,21 @@ def test_configure_truncate_starts_fresh(tmp_path):
     assert names == ["new", "appended"]
 
 
+def test_configure_truncate_resets_metrics_registry(tmp_path):
+    """A truncating owner starts a fresh capture: counters from an earlier
+    run in this process must not pool into the new digest."""
+    obs.count("run.scenes_ok", 7)
+    obs.configure(str(tmp_path / "a.jsonl"), sample_memory=False,
+                  truncate=True)
+    assert obs.registry().snapshot()["counters"] == {}
+    obs.count("run.scenes_ok", 1)
+    obs.disable()
+    # append mode (bench multi-process contract) keeps accumulating
+    obs.configure(str(tmp_path / "a.jsonl"), sample_memory=False)
+    assert obs.registry().snapshot()["counters"]["run.scenes_ok"] == 1
+    obs.disable()
+
+
 def test_sink_failure_disables_not_raises(tmp_path):
     path = str(tmp_path / "events.jsonl")
     tracer = obs.configure(path, sample_memory=False)
@@ -305,6 +320,85 @@ def test_report_diff(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "obs diff" in out
     assert "-50.0%" in out  # every A stage is half of B's p50
+
+
+def test_read_events_counts_skipped_lines(tmp_path):
+    """Satellite robustness contract: torn + unknown-version lines are
+    skipped WITH A COUNT (silent loss made a report lie by omission)."""
+    path = str(tmp_path / "events.jsonl")
+    obs.configure(path, sample_memory=False)
+    with obs.span("ok"):
+        pass
+    obs.disable()
+    with open(path, "a") as f:
+        f.write(json.dumps({"v": 99, "kind": "span", "name": "future"}) + "\n")
+        f.write('{"v": 1, "kind": "span", "na')  # torn final line
+    stats = obs.ReadStats()
+    names = [e.get("name") for e in obs.read_events(path, stats=stats)
+             if e["kind"] == "span"]
+    assert names == ["ok"]
+    assert stats.torn == 1 and stats.unknown_version == 1
+    assert stats.skipped == 2
+
+
+def test_report_render_warns_on_skipped_lines(tmp_path):
+    from maskclustering_tpu.obs.report import RunData, render_report
+
+    path = _canned_events(tmp_path)
+    with open(path, "a") as f:
+        f.write('{"v": 1, "kind": "span"')  # crash cut
+    run = RunData(path)
+    assert run.read_stats.torn == 1
+    out = render_report(run)
+    assert "WARNING: skipped" in out and "1 torn" in out
+
+
+def test_xprof_span_triggered_capture(tmp_path):
+    """xprof_dir + xprof_spans: the named span's first opening brackets a
+    real jax.profiler trace; later openings respect the capture limit."""
+    events = str(tmp_path / "events.jsonl")
+    xdir = str(tmp_path / "xprof")
+    obs.configure(events, sample_memory=False, xprof_dir=xdir,
+                  xprof_spans=("cluster",), xprof_limit=1)
+    tracer = obs.get_tracer()
+    assert tracer.xprof is not None
+    with obs.span("associate"):
+        pass  # unarmed span: no capture
+    with obs.span("cluster"):
+        pass
+    with obs.span("cluster"):
+        pass  # second opening: over the limit, no second trace
+    obs.disable()
+    assert tracer.xprof.captured == {"cluster": 1}
+    assert os.path.isdir(os.path.join(xdir, "cluster-0"))
+    assert not os.path.isdir(os.path.join(xdir, "cluster-1"))
+
+
+def test_xprof_arm_is_bounded_and_non_reentrant(tmp_path, monkeypatch):
+    import jax.profiler
+
+    from maskclustering_tpu.obs.xprof import XprofArm, parse_spans
+
+    assert parse_spans("cluster,post.claims.kernel") == (
+        "cluster", "post.claims.kernel")
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop", None)))
+    arm = XprofArm(str(tmp_path), ["a", "b"], limit=1)
+    assert arm.maybe_start("a")
+    # non-reentrant: a second armed span cannot steal the session
+    assert not arm.maybe_start("b")
+    arm.stop("b")  # non-owner stop is a no-op
+    assert arm.active_span == "a"
+    arm.stop("a")
+    assert arm.active_span is None
+    assert not arm.maybe_start("a")  # limit reached
+    assert arm.maybe_start("b")
+    arm.close()  # closes the open trace and disarms
+    assert arm.dead and arm.active_span is None
+    assert [c[0] for c in calls] == ["start", "stop", "start", "stop"]
 
 
 def test_report_merges_counters_across_pids(tmp_path):

@@ -70,6 +70,17 @@ def test_full_pipeline(scene_root):
     for key in report.scenes[0].timings:
         assert key in table
 
+    # perf-ledger wiring: a reported run appends one schema-versioned
+    # trajectory row (routed to a per-test tmp ledger via MCT_PERF_LEDGER)
+    from maskclustering_tpu.obs import ledger as led
+
+    rows = led.read_ledger(led.default_ledger_path())
+    assert len(rows) == 1
+    assert rows[0]["tool"] == "run" and rows[0]["config"] == "testrun"
+    assert rows[0]["v"] == led.LEDGER_SCHEMA_VERSION
+    assert rows[0]["value"] is not None and rows[0]["scenes_ok"] == 1
+    assert rows[0]["stages"]  # obs digest stages rode along
+
     pred_dir = os.path.join(scene_root, "prediction")
     ca = np.load(os.path.join(pred_dir, "testrun_class_agnostic", "scene0001_00.npz"))
     assert ca["pred_masks"].shape[1] == 3
