@@ -83,6 +83,25 @@ class PipelineConfig:
     # (parallel/batch.py); empty = single-device host pipeline
     mesh_shape: Tuple[int, ...] = ()
 
+    # --- scene executor (run.py, single-chip scene queue) ---
+    # overlap scene N's host tail (DBSCAN split, merge, export) on a worker
+    # thread with scene N+1's device phase; artifacts are byte-identical to
+    # the sequential order (tests/test_executor.py)
+    scene_overlap: bool = True
+    # disk-load lookahead depth of the scene prefetcher (0 = load inline,
+    # 1 = the classic one-scene lookahead); each prefetched scene holds its
+    # decoded tensors resident, so depth bounds host memory
+    prefetch_depth: int = 1
+    # donate dead device buffers back to the allocator: the uploaded
+    # depth/seg frames into the association jit, and the (F, N) claim
+    # tensors into the post-process group-counts kernel — scene N's padded
+    # buffers free in time for scene N+1's dispatch at the same shape bucket
+    donate_buffers: bool = True
+    # rows per chunked bit-plane device->host pull in the post-process
+    # claims drain (0 = one blocking pull); chunks stream via
+    # copy_to_host_async so unpack overlaps the next chunk's DMA
+    claims_pull_chunk: int = 64
+
     # --- paths ---
     data_root: str = "./data"
     cropformer_path: str = ""
@@ -109,6 +128,12 @@ class PipelineConfig:
         if self.mesh_shape and len(self.mesh_shape) != 2:
             raise ValueError(
                 f"mesh_shape must be (scene, frame), got {self.mesh_shape}")
+        if self.prefetch_depth < 0:
+            raise ValueError(
+                f"prefetch_depth must be >= 0, got {self.prefetch_depth}")
+        if self.claims_pull_chunk < 0:
+            raise ValueError(
+                f"claims_pull_chunk must be >= 0, got {self.claims_pull_chunk}")
 
     def replace(self, **kw) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)

@@ -94,24 +94,36 @@ def decode_seg(device_arr: jnp.ndarray) -> jnp.ndarray:
     return device_arr.astype(jnp.int32)
 
 
+def device_resident(arr) -> bool:
+    """Is ``arr`` already a device array (vs host numpy)?
+
+    The ownership predicate of the feed: frames that arrive HOST-side are
+    uploaded by this codec into fresh buffers nobody else holds — callers
+    may donate those into their consuming program. Device-resident frames
+    (the synthetic bench renders directly in HBM) belong to the caller and
+    must never be donated.
+    """
+    return isinstance(arr, jnp.ndarray) and not isinstance(arr, np.ndarray)
+
+
 def to_device_frames(
     depths: Union[np.ndarray, jnp.ndarray],
     segs: Union[np.ndarray, jnp.ndarray],
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Upload (depths, segs) compactly; returns decoded device arrays.
 
-    Arrays already on device (the synthetic bench renders frames directly
-    in HBM) pass through untouched.
+    Arrays already on device (see ``device_resident``) pass through
+    untouched.
     """
     from maskclustering_tpu import obs
 
-    if isinstance(depths, jnp.ndarray) and not isinstance(depths, np.ndarray):
+    if device_resident(depths):
         d_dev = jnp.asarray(depths, jnp.float32)
     else:
         enc, scale = encode_depth(np.asarray(depths))
         obs.count_transfer("h2d", enc.nbytes, "associate.feed")
         d_dev = decode_depth(jnp.asarray(enc), scale)
-    if isinstance(segs, jnp.ndarray) and not isinstance(segs, np.ndarray):
+    if device_resident(segs):
         s_dev = jnp.asarray(segs, jnp.int32)
     else:
         enc_s = encode_seg(np.asarray(segs))

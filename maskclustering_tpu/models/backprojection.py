@@ -53,6 +53,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from maskclustering_tpu.ops.geometry import invert_se3, unproject_depth
+from maskclustering_tpu.utils.donation import suppress_unusable_donation_warning
+
+# this module donates the fed frame stacks (associate_scene_tensors); see
+# the helper's docstring for why the filter is global and why it is safe
+suppress_unusable_donation_warning()
 
 
 @functools.partial(jax.jit, static_argnames=("sample", "chunk"))
@@ -405,7 +410,7 @@ def _associate_scene_impl(
 @functools.lru_cache(maxsize=None)
 def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
                          few_points_threshold, coverage_threshold,
-                         frame_batch=1):
+                         frame_batch=1, donate=False):
     """One cached top-level jit per static config.
 
     Calling lax.map eagerly re-traces AND re-compiles the whole frame scan
@@ -414,12 +419,19 @@ def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
     persistent jit makes the first scene pay compilation and every later
     scene (and repeat run) reuse it. (Steady-state execution cost is
     gather/bandwidth-bound, not dispatch-bound — see PROFILE.md.)
+
+    ``donate=True`` donates the depth/seg frame stacks (args 1 and 2) —
+    the scene's dominant HBM tenants, dead after this program — so their
+    buffers recycle into the next same-bucket dispatch instead of
+    coexisting with it. Only safe when the caller owns the uploaded
+    buffers exclusively (associate_scene_tensors checks this).
     """
     return jax.jit(functools.partial(
         _associate_scene_impl, k_max=k_max, window=window,
         distance_threshold=distance_threshold, depth_trunc=depth_trunc,
         few_points_threshold=few_points_threshold,
-        coverage_threshold=coverage_threshold, frame_batch=frame_batch))
+        coverage_threshold=coverage_threshold, frame_batch=frame_batch),
+        donate_argnums=(1, 2) if donate else ())
 
 
 def associate_scene(
@@ -428,18 +440,21 @@ def associate_scene(
     k_max: int = 127, window: int = 1, distance_threshold: float = 0.01,
     depth_trunc: float = 20.0, few_points_threshold: int = 25,
     coverage_threshold: float = 0.3, frame_batch: int = 1,
+    donate: bool = False,
 ) -> SceneAssociation:
     """Run projective association over all frames (jit-cached).
 
     ``vox_size`` (a traced scalar) calibrates the coverage voxel grid; when
     None it is estimated as max(distance_threshold, median scene spacing).
+    ``donate=True`` invalidates the passed depths/segs device arrays.
     """
     if vox_size is None:
         vox_size = jnp.maximum(jnp.float32(distance_threshold),
                                estimate_spacing(scene_points))
     fn = _associate_scene_jit(k_max, window, float(distance_threshold),
                               float(depth_trunc), few_points_threshold,
-                              float(coverage_threshold), int(frame_batch))
+                              float(coverage_threshold), int(frame_batch),
+                              bool(donate))
     return fn(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid,
               jnp.asarray(vox_size, jnp.float32))
 
@@ -453,8 +468,15 @@ def associate_scene_tensors(tensors, cfg, k_max: int = 127) -> SceneAssociation:
     dominant per-scene transfer at identical results.
     """
     from maskclustering_tpu import obs
-    from maskclustering_tpu.io.feed import to_device_frames
+    from maskclustering_tpu.io.feed import device_resident, to_device_frames
 
+    # ownership: frames arriving as HOST arrays are uploaded by the codec
+    # into fresh device buffers no one else holds — those may be donated
+    # into the association program (their last and only consumer). Frames
+    # already device-resident (the bench renders directly in HBM) belong
+    # to the caller and must survive the call.
+    owned = not (device_resident(tensors.depths)
+                 or device_resident(tensors.segmentations))
     depths_dev, segs_dev = to_device_frames(tensors.depths, tensors.segmentations)
     # the codec accounts depth/seg bytes itself (it sees the encoded size);
     # the remaining per-scene uploads are the cloud + the small pose tables
@@ -476,4 +498,5 @@ def associate_scene_tensors(tensors, cfg, k_max: int = 127) -> SceneAssociation:
         few_points_threshold=cfg.few_points_threshold,
         coverage_threshold=cfg.coverage_threshold,
         frame_batch=cfg.association_frame_batch,
+        donate=bool(cfg.donate_buffers) and owned,
     )

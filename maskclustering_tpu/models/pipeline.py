@@ -1,17 +1,37 @@
 """Per-scene end-to-end pipeline: association -> graph -> clustering -> export.
 
 The TPU analog of the reference's per-scene entry (main.py:9-21). Device
-stages run under jit with static, bucket-padded shapes; the two host sync
-points are (a) the mask table (compact indices of valid masks) and (b) the
-observer schedule (a 20-float transfer), mirroring where the reference
-crosses to numpy.
+stages run under jit with static, bucket-padded shapes. The per-scene
+pipeline crosses to host exactly TWICE:
+
+1. the mask table — compact indices of valid masks materialize at the top
+   of the graph stage (the pull drains the associate dispatch; the table's
+   M_pad bucket is data-dependent, so this crossing is irreducible);
+2. the final cluster assignment — the host prep of the post-process
+   (live-rep routing tables) needs it.
+
+The observer-percentile schedule, historically a third mid-pipeline host
+round-trip (a 20-float pull + float64 interpolation), is computed on
+device (`observer_schedule_device`, same formulation the fused mesh path
+has always used) so graph -> schedule -> clustering dispatches as one
+uninterrupted device program chain. Each host crossing is marked with a
+``host_pull`` span attr and counted on ``pipeline.host_sync`` — the
+fence-count budget is pinned by tests/test_executor.py.
+
+The pipeline is split into a **device phase** (`run_scene_device`) and a
+**host phase** (`run_scene_host`) joined by an explicit `DeviceHandoff`,
+so the overlapped scene executor (run.py) can dispatch scene N+1's device
+phase while scene N's host tail (DBSCAN split, overlap merge, artifact
+export) drains on a worker thread. `run_scene` remains the sequential
+composition of the two and is byte-identical to the overlapped execution.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, NamedTuple, Optional
+from typing import Dict, NamedTuple, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -25,7 +45,7 @@ from maskclustering_tpu.models.graph import (
     MaskTable,
     build_mask_table,
     compute_graph_stats,
-    observer_schedule,
+    observer_schedule_device,
 )
 from maskclustering_tpu.models.postprocess import SceneObjects, export_artifacts
 
@@ -37,6 +57,38 @@ class SceneResult(NamedTuple):
     table: MaskTable
     assignment: np.ndarray
     timings: Dict[str, float]
+
+
+class DeviceHandoff(NamedTuple):
+    """Everything the host phase needs from the device phase of one scene.
+
+    The contract: ``assignment`` is HOST-resident (the second and last
+    pipeline host sync produced it); ``first_id``/``last_id``/
+    ``node_visible``/``active`` stay DEVICE-resident — the post-process
+    claim kernels consume them in HBM, and only bit-packed planes cross
+    back. A handoff therefore pins ~2 x (F, N) int32 of HBM until its host
+    phase finishes; the overlapped executor bounds the number of live
+    handoffs to one (double buffering) for exactly that reason.
+    """
+
+    table: MaskTable
+    assignment: np.ndarray  # (M_pad,) int32, host
+    active: jnp.ndarray  # (M_pad,) bool, device — valid & not undersegmented
+    node_visible: jnp.ndarray  # (M_pad, F) bool, device
+    first_id: jnp.ndarray  # (F, N) int32, device
+    last_id: jnp.ndarray  # (F, N) int32, device
+    scene_points: np.ndarray  # (N_pad, 3) f32, host (padded)
+    frame_ids: Sequence  # padded frame identifiers
+    k_max: int
+    n_real: int  # true (pre-pad) point count
+    seq_name: Optional[str]
+    timings: Dict[str, float]  # associate/graph/cluster stage walls
+
+
+# the fused mesh path's f32 schedule formulation, jitted once per max_len so
+# the eager per-scene call doesn't re-dispatch its ~15 tiny ops one by one
+_observer_schedule_jit = jax.jit(observer_schedule_device,
+                                 static_argnames=("max_len",))
 
 
 K_MAX_CEILING = 1023
@@ -107,11 +159,10 @@ def bucket_k_max(max_id: int, minimum: int = 63, ceiling: int = K_MAX_CEILING) -
     return k
 
 
-def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int] = None,
-              seq_name: Optional[str] = None, export: bool = False,
-              object_dict_dir: Optional[str] = None,
-              prediction_root: str = "data/prediction") -> SceneResult:
-    """Cluster one scene. Returns objects + artifacts (optionally written).
+def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
+                     k_max: Optional[int] = None,
+                     seq_name: Optional[str] = None) -> DeviceHandoff:
+    """Device phase of one scene: associate -> graph -> cluster.
 
     ``k_max`` (max mask id per frame) defaults to a power-of-two bucket of the
     scene's true max segmentation id, so crowded frames (CropFormer id-maps
@@ -120,9 +171,16 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
     Stage timing comes from obs spans (obs.scene_tracer()): with obs armed
     every stage is sync-fenced at its boundary (``sp.sync``), so device
     work is attributed to the stage that dispatched it instead of the
-    stage that first pulls a result; disarmed, the spans are timing-only
-    and add no syncs — identical behavior to the legacy perf_counter
-    timings. The ``timings`` keys are unchanged either way.
+    stage that first pulls a result. Disarmed, the spans are timing-only
+    and the ONLY blocking points are the pipeline's own two host pulls —
+    the associate span then measures dispatch and the graph span absorbs
+    the associate drain (arm obs for exact attribution).
+
+    Exactly two host syncs per scene, both marked with a ``host_pull``
+    span attr and counted on ``pipeline.host_sync``:
+
+    - graph start: the mask-valid table materializes (drains associate);
+    - cluster end: the final assignment vector.
     """
     timings: Dict[str, float] = {}
     tracer = obs.scene_tracer()
@@ -154,10 +212,15 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
             sp.set(f_pad=f_pad, n_pad=n_pad)
             assoc = associate_scene_tensors(tensors, cfg, k_max=k_max)
             sp.sync(assoc.mask_valid)
-        mask_valid_host = np.asarray(assoc.mask_valid)
     timings["associate"] = sp.duration
 
     with tracer.span("graph", scene=seq_name) as sp:
+        # host sync 1/2: the compact mask table's M_pad bucket is
+        # data-dependent, so the valid table must materialize before the
+        # graph program can be dispatched
+        mask_valid_host = np.asarray(assoc.mask_valid)
+        obs.count("pipeline.host_sync")
+        sp.set(host_pull="mask_valid")
         table = build_mask_table(mask_valid_host, pad_multiple=cfg.mask_pad_multiple)
         sp.set(m_pad=table.m_pad)
         stats = compute_graph_stats(
@@ -173,29 +236,60 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
             undersegment_filter_threshold=cfg.undersegment_filter_threshold,
             big_mask_point_count=cfg.big_mask_point_count,
         )
-        schedule = observer_schedule(stats.observer_hist,
-                                     max_len=cfg.max_cluster_iterations)
-        sp.sync(stats)
+        # the schedule stays on device (f32 exact-integer-rank formulation,
+        # shared with the fused mesh path): graph -> schedule -> clustering
+        # is one uninterrupted dispatch chain, no 20-float round-trip
+        schedule = _observer_schedule_jit(stats.observer_hist,
+                                          max_len=cfg.max_cluster_iterations)
+        sp.sync((stats, schedule))
     timings["graph"] = sp.duration
 
     with tracer.span("cluster", scene=seq_name) as sp:
         active = jnp.asarray(table.valid) & ~stats.undersegment
         result = iterative_clustering(
-            stats.visible, stats.contained, active, jnp.asarray(schedule),
+            stats.visible, stats.contained, active, schedule,
             view_consensus_threshold=cfg.view_consensus_threshold,
         )
+        # host sync 2/2: the assignment vector feeds the host-side live-rep
+        # prep of the post-process
         assignment = np.asarray(sp.sync(result.assignment))
+        obs.count("pipeline.host_sync")
+        sp.set(host_pull="assignment")
         obs.count_transfer("d2h", assignment.nbytes, "cluster")
     timings["cluster"] = sp.duration
+
+    return DeviceHandoff(
+        table=table, assignment=assignment, active=active,
+        node_visible=result.node_visible, first_id=assoc.first_id,
+        last_id=assoc.last_id, scene_points=np.asarray(tensors.scene_points),
+        frame_ids=tensors.frame_ids, k_max=k_max, n_real=n_real,
+        seq_name=seq_name, timings=timings)
+
+
+def run_scene_host(handoff: DeviceHandoff, cfg: PipelineConfig, *,
+                   export: bool = False, object_dict_dir: Optional[str] = None,
+                   prediction_root: str = "data/prediction") -> SceneResult:
+    """Host phase of one scene: post-process + artifact export.
+
+    Safe to run on a worker thread concurrently with the NEXT scene's
+    device phase (jax dispatch is thread-safe; the claim kernels here
+    interleave with the next scene's stage programs on the device queue,
+    while DBSCAN/merge/export are pure host work). Consumes the handoff's
+    device arrays — they are released when this returns.
+    """
+    timings = dict(handoff.timings)
+    tracer = obs.scene_tracer()
+    seq_name = handoff.seq_name
 
     with tracer.span("postprocess", scene=seq_name) as sp:
         post_timings: Dict[str, float] = {}
         from maskclustering_tpu.models.postprocess_device import run_postprocess
 
         objects = run_postprocess(
-            cfg, tensors.scene_points, assoc.first_id, assoc.last_id,
-            table.frame, table.mask_id, active, assignment, result.node_visible,
-            tensors.frame_ids, k_max=k_max, timings=post_timings, n_real=n_real)
+            cfg, handoff.scene_points, handoff.first_id, handoff.last_id,
+            handoff.table.frame, handoff.table.mask_id, handoff.active,
+            handoff.assignment, handoff.node_visible, handoff.frame_ids,
+            k_max=handoff.k_max, timings=post_timings, n_real=handoff.n_real)
     timings["postprocess"] = sp.duration
     for k, v in post_timings.items():
         # phase wall times measured by the postprocess _PhaseTimer become
@@ -212,4 +306,22 @@ def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int
 
     log.info("scene %s: %d objects, timings %s", seq_name, len(objects.point_ids_list),
              {k: round(v, 3) for k, v in timings.items()})
-    return SceneResult(objects=objects, table=table, assignment=assignment, timings=timings)
+    return SceneResult(objects=objects, table=handoff.table,
+                       assignment=handoff.assignment, timings=timings)
+
+
+def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int] = None,
+              seq_name: Optional[str] = None, export: bool = False,
+              object_dict_dir: Optional[str] = None,
+              prediction_root: str = "data/prediction") -> SceneResult:
+    """Cluster one scene. Returns objects + artifacts (optionally written).
+
+    The sequential composition of the device and host phases — what the
+    overlapped executor (run.py) pipelines across scenes. Identical
+    results either way (pinned by tests/test_executor.py); the ``timings``
+    keys are unchanged from the pre-split pipeline.
+    """
+    handoff = run_scene_device(tensors, cfg, k_max=k_max, seq_name=seq_name)
+    return run_scene_host(handoff, cfg, export=export,
+                          object_dict_dir=object_dict_dir,
+                          prediction_root=prediction_root)

@@ -313,18 +313,27 @@ def _export_artifacts(objects: SceneObjects, seq_name: str, config_name: str,
             "repre_mask_list": representative_masks(mlist, top_k_repre),
         }
 
+    # tmp + rename: artifact files must appear ATOMICALLY. The resume check
+    # is a bare exists() (run._load_for_cluster), and the overlapped
+    # executor writes from a worker thread a process exit can kill
+    # mid-write — a truncated npz left at the final path would make the
+    # scene "done" forever with a corrupt artifact.
     ca_dir = os.path.join(prediction_root, config_name + "_class_agnostic")
     os.makedirs(ca_dir, exist_ok=True)
     npz_path = os.path.join(ca_dir, f"{seq_name}.npz")
+    tmp = npz_path + ".tmp.npz"  # np.savez appends .npz to unknown suffixes
     np.savez(
-        npz_path,
+        tmp,
         pred_masks=masks,
         pred_score=np.ones(num_instance),
         pred_classes=np.zeros(num_instance, dtype=np.int32),
     )
+    os.replace(tmp, npz_path)
 
     od_dir = os.path.join(object_dict_dir, config_name)
     os.makedirs(od_dir, exist_ok=True)
     od_path = os.path.join(od_dir, "object_dict.npy")
-    np.save(od_path, object_dict, allow_pickle=True)
+    tmp = od_path + ".tmp.npy"  # np.save likewise appends .npy
+    np.save(tmp, object_dict, allow_pickle=True)
+    os.replace(tmp, od_path)
     return {"npz": npz_path, "object_dict": od_path}

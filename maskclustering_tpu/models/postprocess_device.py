@@ -41,6 +41,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from maskclustering_tpu.utils.donation import suppress_unusable_donation_warning
+
+# this module donates the (F, N) claim tensors into the group-counts
+# kernel; see the helper's docstring for why the filter is global
+suppress_unusable_donation_warning()
+
 from maskclustering_tpu import obs
 from maskclustering_tpu.models.postprocess import (
     SceneObjects,
@@ -82,7 +88,8 @@ def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
         objects = postprocess_scene_device(
             scene_points, jnp.asarray(first), jnp.asarray(last), mask_frame,
             mask_id, mask_active, assignment, jnp.asarray(node_visible),
-            frame_ids, **kwargs)
+            frame_ids, pull_chunk=cfg.claims_pull_chunk,
+            donate=cfg.donate_buffers, **kwargs)
     else:
         with obs.span("post.host_pull") as sp:
             # the host path pulls the full (F, N) claim tensors — the very
@@ -265,8 +272,27 @@ def _unpack_bits(packed: np.ndarray, n: int) -> np.ndarray:
     return np.unpackbits(np.asarray(packed), axis=1)[:, :n].astype(bool)
 
 
-@functools.partial(jax.jit, static_argnames=("k2", "s_pad"))
-def _mask_group_counts_kernel(
+def _row_chunks(arr, rows: int, chunk: int) -> List:
+    """``arr[:rows]`` as a list of row slices of at most ``chunk`` rows.
+
+    ``chunk <= 0`` (or a chunk covering everything) degenerates to the
+    single-slice pull. Slicing is lazy on device; concatenating the
+    materialized chunks in order reproduces the single pull byte-for-byte.
+    """
+    if chunk <= 0 or rows <= chunk:
+        return [arr[:rows]]
+    return [arr[i:min(i + chunk, rows)] for i in range(0, rows, chunk)]
+
+
+def _start_host_copy(arr) -> None:
+    """Kick off the device->host DMA without blocking (no-op off-backend)."""
+    try:
+        arr.copy_to_host_async()
+    except AttributeError:  # backend without async host copies
+        pass
+
+
+def _mask_group_counts_impl(
     first: jnp.ndarray,  # (F, N) int32
     last: jnp.ndarray,  # (F, N) int32
     pt_ids: jnp.ndarray,  # (C_pad,) int32 node point ids (pad: N — dropped)
@@ -310,6 +336,17 @@ def _mask_group_counts_kernel(
     return best_group, best_count
 
 
+_mask_group_counts_kernel = functools.partial(
+    jax.jit, static_argnames=("k2", "s_pad"))(_mask_group_counts_impl)
+# donating variant: this kernel is the LAST consumer of the (F, N)
+# first/last claim tensors — donating them releases ~2 x F x N x 4 bytes of
+# HBM mid-postprocess, in time for the NEXT scene's association dispatch at
+# the same shape bucket (the overlapped executor runs the two concurrently)
+_mask_group_counts_kernel_donating = functools.partial(
+    jax.jit, static_argnames=("k2", "s_pad"),
+    donate_argnums=(0, 1))(_mask_group_counts_impl)
+
+
 def postprocess_scene_device(
     scene_points: np.ndarray,  # (N, 3) float32, host
     first: jnp.ndarray,  # (F, N) int32, device
@@ -328,6 +365,8 @@ def postprocess_scene_device(
     overlap_merge_ratio: float = 0.8,
     min_masks_per_object: int = 2,
     timings: Optional[Dict[str, float]] = None,
+    pull_chunk: int = 0,
+    donate: bool = False,
 ) -> SceneObjects:
     """Same contract and outputs as postprocess_scene, minus the (F, N) pulls.
 
@@ -335,6 +374,17 @@ def postprocess_scene_device(
     and O(M_pad) scalars cross the host boundary. The DBSCAN split and the
     final merge/emit run on host exactly as in the host path, so artifacts
     are byte-identical (asserted by tests/test_postprocess_device.py).
+
+    ``pull_chunk`` > 0 drains the claimed bit-planes in row chunks of that
+    size: every chunk's ``copy_to_host_async`` is issued up front, then
+    chunks materialize and unpack in order — the unpack of chunk i rides
+    under chunk i+1's DMA, splitting ``post.claims`` into overlapping
+    kernel/transfer/unpack slices (the structural answer to the
+    kernel-vs-tunnel attribution question; identical bytes either way).
+
+    ``donate=True`` donates the (F, N) first/last tensors into the final
+    group-counts kernel — their HBM frees mid-postprocess instead of at
+    scene teardown. The caller must not touch them afterwards.
     """
     t = _PhaseTimer(timings)
     f, n = first.shape
@@ -365,8 +415,10 @@ def postprocess_scene_device(
             r_pad=r_pad, point_filter_threshold=float(point_filter_threshold)))
     # device->host transfers dominate this phase on a narrow link (the
     # driver rig's tunnel moves ~2-3 MB/s; a TPU-VM's PCIe makes them
-    # ~free). Two cuts: pull only the len(reps) live rows of the
-    # (r_pad, N/8) planes, and start the ratio plane's DMA now — it isn't
+    # ~free). Three cuts: pull only the len(reps) live rows of the
+    # (r_pad, N/8) planes; drain them in double-buffered row chunks (all
+    # asyncs issued up front, so the unpack of chunk i overlaps chunk
+    # i+1's DMA); and start the ratio plane's DMA after them — it isn't
     # consumed until the emit phase, so the copy rides the link while
     # dbscan/mask_assign run on the host. copy_to_host_async (not a helper
     # thread calling np.asarray: the blocking device_get holds the GIL on
@@ -374,17 +426,22 @@ def postprocess_scene_device(
     # Python loops — post.dbscan 0.11 -> 2.0 s measured on the driver rig).
     r_live = len(reps)
     with obs.span("post.claims.pull", r_pull=r_pull) as sp:
-        claimed_host = np.asarray(claimed_p[:r_pull])
-        claimed = _unpack_bits(claimed_host, n)
+        chunks = _row_chunks(claimed_p, r_pull, pull_chunk)
+        for c in chunks:
+            _start_host_copy(c)
         ratio_sliced = ratio_p[:r_pull]
-        try:
-            ratio_sliced.copy_to_host_async()
-        except AttributeError:  # backend without async host copies
-            pass
+        _start_host_copy(ratio_sliced)
+        pulled = 0
+        parts = []
+        for c in chunks:
+            h = np.asarray(c)  # already landed (or blocks on the DMA)
+            pulled += h.nbytes
+            parts.append(_unpack_bits(h, n))
+        claimed = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
         nv_host = np.asarray(nv_rep_d[:r_pull])
         nv_any = nv_host[:r_live].any(axis=1)
-        obs.count_transfer(
-            "d2h", claimed_host.nbytes + nv_host.nbytes, "post.claims")
+        sp.set(chunks=len(chunks))
+        obs.count_transfer("d2h", pulled + nv_host.nbytes, "post.claims")
     t.mark("claims")
 
     # ---- DBSCAN split per live rep (host, native C++/sklearn) ----
@@ -450,7 +507,11 @@ def postprocess_scene_device(
     mask_flat[~alive] = 0
 
     with obs.span("post.mask_assign.kernel", s_pad=s_pad, c_pad=c_pad) as sp:
-        best_group_d, best_count_d = sp.sync(_mask_group_counts_kernel(
+        # last consumer of first/last: the donating variant hands their HBM
+        # back to the allocator for the next scene's same-bucket dispatch
+        kernel = (_mask_group_counts_kernel_donating if donate
+                  else _mask_group_counts_kernel)
+        best_group_d, best_count_d = sp.sync(kernel(
             first, last, jnp.asarray(pt_ids), jnp.asarray(pt_grp),
             jnp.asarray(mask_flat), jnp.asarray(glo), jnp.asarray(ghi),
             k2=k2, s_pad=s_pad))

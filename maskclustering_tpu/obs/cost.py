@@ -300,7 +300,11 @@ def observe_costs(
             t0 = time.perf_counter()
             try:
                 if stage == "fused":
-                    step = build_fused_step(mesh, cfg, k_max=k_max)
+                    # lower the program production runs: the batch path
+                    # compiles the fused step with donation (batch.py
+                    # _cached_step), which changes the memory plan's peak
+                    step = build_fused_step(mesh, cfg, k_max=k_max,
+                                            donate=bool(cfg.donate_buffers))
                     shapes = stage_arg_shapes(
                         "backprojection", scenes=scenes, frames=frames,
                         points=points, image_hw=image_hw, k_max=k_max)

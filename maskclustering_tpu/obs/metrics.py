@@ -8,11 +8,14 @@ gauges sampled at span ends (obs/tracer.py).
 
 Design constraints, in order:
 
-1. **zero-cost when idle** — a counter bump is one dict lookup + add; no
-   locks on the hot path beyond a plain dict (CPython dict ops are atomic
-   enough for monotonic counters; the registry is process-local, and the
-   only concurrent writers are the prefetch daemon threads whose bumps
-   are independent keys).
+1. **near-zero cost** — a counter bump is one uncontended lock + dict add.
+   The lock became load-bearing with the overlapped scene executor
+   (run.py): the host-tail worker, the prefetch daemons, and the main
+   dispatch thread all bump SHARED aggregate keys (``d2h.bytes``, span
+   histograms) concurrently, and an unlocked read-modify-write would
+   silently drop increments from exactly the numbers the perf ledger
+   regresses against. An uncontended CPython lock costs ~100 ns — noise
+   against the device work these counters meter.
 2. **flat names** — ``h2d.bytes.feed`` not nested objects, so a snapshot
    is one JSON-able dict and a diff is set arithmetic.
 3. **bounded memory** — histograms keep a capped reservoir (deterministic
@@ -77,27 +80,30 @@ class Registry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
-        self._lock = threading.Lock()  # structure mutations only
+        self._lock = threading.Lock()
 
     # -- write paths (hot) --------------------------------------------------
     def count(self, name: str, delta: float = 1.0) -> None:
-        self._counters[name] = self._counters.get(name, 0.0) + delta
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + delta
 
     def gauge(self, name: str, value: float) -> None:
-        self._gauges[name] = float(value)
+        with self._lock:
+            self._gauges[name] = float(value)
 
     def gauge_max(self, name: str, value: float) -> None:
         """High-water gauge: keeps the max ever seen (HBM high-water)."""
-        cur = self._gauges.get(name)
-        if cur is None or value > cur:
-            self._gauges[name] = float(value)
+        with self._lock:
+            cur = self._gauges.get(name)
+            if cur is None or value > cur:
+                self._gauges[name] = float(value)
 
     def observe(self, name: str, value: float) -> None:
-        h = self._hists.get(name)
-        if h is None:
-            with self._lock:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
                 h = self._hists.setdefault(name, Histogram())
-        h.observe(float(value))
+            h.observe(float(value))
 
     # -- read paths ---------------------------------------------------------
     def histogram(self, name: str) -> Optional[Histogram]:
@@ -105,11 +111,12 @@ class Registry:
 
     def snapshot(self) -> Dict:
         """One JSON-able dict of everything; cheap enough to flush per scene."""
-        return {
-            "counters": dict(self._counters),
-            "gauges": dict(self._gauges),
-            "histograms": {k: h.summary() for k, h in self._hists.items()},
-        }
+        with self._lock:  # a concurrent insert would break dict iteration
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.summary() for k, h in self._hists.items()},
+            }
 
     def reset(self) -> None:
         with self._lock:

@@ -31,6 +31,15 @@ from maskclustering_tpu.obs.events import (KIND_COST, KIND_METRICS, KIND_SPAN,
 log = logging.getLogger("maskclustering_tpu")
 
 
+# the disjoint per-stage spans whose total duration is the overlap-ratio
+# numerator: the IO loads (daemon threads), the device-phase stages (main
+# thread) and the host-tail post-process (worker thread). Parent container
+# spans (exec.device / exec.host_tail) and nested post.* children are
+# deliberately excluded — they would double-count their contents.
+OVERLAP_STAGE_SPANS = ("exec.load", "associate", "graph", "cluster",
+                       "postprocess")
+
+
 class RunData:
     """Parsed event file: ordered span series + final metrics snapshot."""
 
@@ -107,9 +116,39 @@ class RunData:
             })
         return rows
 
+    def overlap(self) -> Optional[Dict]:
+        """Scene-loop overlap accounting, or None without an executor span.
+
+        ``ratio`` = sum of per-stage span time / scene-loop wall time. A
+        fully serialized loop sits at <= 1.0 (stages plus orchestration
+        overhead fill the wall exactly once); every point above 1.0 is
+        stage work that ran CONCURRENTLY — loads under device dispatch,
+        host tails under the next scene's device phase. The denominator is
+        the ``exec.scene_loop`` span the executor wraps around the whole
+        queue (summed, for multi-step runs)."""
+        loops = self.spans.get("exec.scene_loop")
+        if not loops:
+            return None
+        wall = sum(float(e.get("dur_s", 0.0)) for e in loops)
+        stages: Dict[str, float] = {}
+        busy = 0.0
+        for name in OVERLAP_STAGE_SPANS:
+            tot = sum(float(e.get("dur_s", 0.0))
+                      for e in self.spans.get(name, ()))
+            if tot:
+                stages[name] = round(tot, 4)
+            busy += tot
+        return {
+            "mode": (loops[-1].get("attrs") or {}).get("mode"),
+            "scene_loop_s": round(wall, 4),
+            "busy_s": round(busy, 4),
+            "ratio": round(busy / wall, 4) if wall > 0 else None,
+            "stages": stages,
+        }
+
     def summary(self) -> Dict:
         """JSON-able digest for embedding (run_report.json / bench line)."""
-        return {
+        out = {
             "events": self.path,
             "stages": {r["stage"]: {"count": r["count"],
                                     "p50_s": round(r["p50_s"], 4),
@@ -120,8 +159,13 @@ class RunData:
             "h2d_bytes": self._counters.get("h2d.bytes"),
             "d2h_bytes": self._counters.get("d2h.bytes"),
             "counters": {k: v for k, v in sorted(self._counters.items())
-                         if k.startswith(("run.", "bench.", "compile_cache."))},
+                         if k.startswith(("run.", "bench.", "compile_cache.",
+                                          "pipeline."))},
         }
+        ov = self.overlap()
+        if ov is not None:
+            out["overlap"] = ov
+        return out
 
 
 def _pct(sorted_vals: List[float], q: float) -> float:
@@ -169,6 +213,12 @@ def render_report(run: RunData) -> str:
     out.append(_render(
         ["stage", "n", "p50[s]", "p95[s]", "dev.p50[s]", "host.p50[s]",
          "total[s]", "h2d", "d2h"], rows))
+    ov = run.overlap()
+    if ov is not None and ov.get("ratio") is not None:
+        parts = " | ".join(f"{k} {v:.2f}s" for k, v in ov["stages"].items())
+        out.append(f"scene overlap [{ov.get('mode') or '?'}]: "
+                   f"ratio {ov['ratio']:.2f}x = stage time {ov['busy_s']:.2f}s"
+                   f" / loop wall {ov['scene_loop_s']:.2f}s  ({parts})")
     tail = []
     if run.hbm_high_water is not None:
         tail.append(f"HBM high-water: {_fmt_bytes(run.hbm_high_water)}")

@@ -125,8 +125,17 @@ def out_scene_points(tensors: SceneTensors, n_pad: int) -> np.ndarray:
 
 @functools.lru_cache(maxsize=None)
 def _cached_step(mesh, cfg: PipelineConfig, k_max: int):
-    """One jitted fused step per (mesh, cfg, k_max) — reuse across batches."""
-    return build_fused_step(mesh, cfg, k_max=k_max)
+    """One jitted fused step per (mesh, cfg, k_max) — reuse across batches.
+
+    The depth/seg batch operands are built fresh per flush by
+    ``pad_scene_batch`` (host-side stacking + feed encode) and are dead
+    after the step, so they are donated when ``cfg.donate_buffers`` is on:
+    one batch's frame buffers — the dominant HBM tenants — recycle into
+    the next same-bucket dispatch (contract pinned by
+    tests/test_parallel.py::test_fused_step_donate_path_identity).
+    """
+    return build_fused_step(mesh, cfg, k_max=k_max,
+                            donate=bool(cfg.donate_buffers))
 
 
 def cluster_scene_batch(

@@ -139,6 +139,16 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
     producing a `FusedStepResult`. All shapes static; S must equal the
     ``scene`` axis size times any per-device scene batch. ``mesh=None``
     gives the same program with no sharding (single-chip compile checks).
+
+    ``donate=True`` donates the depth/seg frame stacks — the batch's
+    dominant HBM tenants, dead after the step — so their buffers recycle
+    into the next same-bucket dispatch. The caller must not touch the
+    passed arrays afterwards, and device-array operands must already be
+    placed with this step's in_shardings (else the resharding copy, not
+    the caller's buffer, is what donation consumes). Results are
+    byte-identical to the non-donating step; backends without sharded
+    donation leave the operands intact (both pinned by
+    tests/test_parallel.py::test_fused_step_donate_path_identity).
     """
 
     def per_scene(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid):

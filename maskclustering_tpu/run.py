@@ -252,41 +252,149 @@ def _spawn_load(cfg: PipelineConfig, seq_name: str, resume: bool,
     A daemon thread — unlike a ThreadPoolExecutor worker, which the
     interpreter joins at exit — can never stall process shutdown on an
     abandoned multi-second load (Ctrl-C mid-scene). resolve() re-raises
-    load errors in the caller so they attribute to the right scene.
+    load errors in the caller so they attribute to the right scene. The
+    load itself runs under an ``exec.load`` span (thread-local span stacks
+    keep it off the caller's stack), so the IO timeline is on the books
+    for the overlap-ratio metric.
     """
     from maskclustering_tpu.utils.daemon_future import DaemonFuture
 
-    fut = DaemonFuture(
-        lambda: _load_for_cluster(cfg, seq_name, resume, prediction_root),
-        name=f"prefetch-{seq_name}")
+    def load():
+        with obs.span("exec.load", scene=seq_name):
+            return _load_for_cluster(cfg, seq_name, resume, prediction_root)
+
+    fut = DaemonFuture(load, name=f"prefetch-{seq_name}")
     return fut.result
 
 
 def _prefetched_loads(cfg: PipelineConfig, seq_names: Sequence[str], resume: bool,
-                      prediction_root: Optional[str] = None):
-    """Yield (seq_name, resolve) with one-scene-lookahead disk prefetch.
+                      prediction_root: Optional[str] = None, depth: int = 1):
+    """Yield (seq_name, resolve) with a ``depth``-scene disk-prefetch lookahead.
 
     Loading a scene (hundreds of depth/seg PNG pairs + the PLY cloud) is
-    seconds of pure host IO; the lookahead thread loads scene i+1 while
-    scene i runs on the device, hiding it entirely (the reference gets the
-    same overlap for free from its per-GPU process pool, reference
-    run.py:33-50). Lookahead is capped at one scene to bound the extra
-    resident tensors.
+    seconds of pure host IO; lookahead threads load scenes i+1..i+depth
+    while scene i runs on the device, hiding the IO entirely (the
+    reference gets the same overlap for free from its per-GPU process
+    pool, reference run.py:33-50). ``depth`` bounds the extra resident
+    decoded tensors; ``depth == 0`` loads inline (no prefetch thread).
+    Scenes always yield in list order, and a failed load re-raises at its
+    OWN scene's resolve() so the failure attributes correctly.
     """
-    nxt = (_spawn_load(cfg, seq_names[0], resume, prediction_root)
-           if seq_names else None)
+    if depth <= 0:
+        for seq in seq_names:
+            def load_inline(seq=seq):
+                with obs.span("exec.load", scene=seq):
+                    return _load_for_cluster(cfg, seq, resume, prediction_root)
+
+            yield seq, load_inline
+        return
+    from collections import deque
+
+    pending = deque(_spawn_load(cfg, seq_names[i], resume, prediction_root)
+                    for i in range(min(depth, len(seq_names))))
     for i, seq in enumerate(seq_names):
-        cur = nxt
-        nxt = (_spawn_load(cfg, seq_names[i + 1], resume, prediction_root)
-               if i + 1 < len(seq_names) else None)
-        yield seq, cur
+        if i + depth < len(seq_names):
+            pending.append(_spawn_load(cfg, seq_names[i + depth], resume,
+                                       prediction_root))
+        yield seq, pending.popleft()
 
 
 def _cluster_scenes_sequential(cfg: PipelineConfig, seq_names: Sequence[str], *,
                                resume: bool = True) -> List[SceneStatus]:
-    """The in-process scene loop with one-scene-lookahead disk prefetch."""
-    return [cluster_scene(cfg, seq, resume=resume, _preloaded=resolve)
-            for seq, resolve in _prefetched_loads(cfg, seq_names, resume)]
+    """The serialized in-process scene loop (disk prefetch is the only
+    overlap). Kept as the bit-for-bit reference order the overlapped
+    executor is tested against, and as the ``scene_overlap=false`` path."""
+    with obs.span("exec.scene_loop", scenes=len(seq_names), mode="sequential"):
+        return [cluster_scene(cfg, seq, resume=resume, _preloaded=resolve)
+                for seq, resolve in _prefetched_loads(
+                    cfg, seq_names, resume, depth=cfg.prefetch_depth)]
+
+
+def _cluster_scenes_overlapped(cfg: PipelineConfig, seq_names: Sequence[str], *,
+                               resume: bool = True,
+                               prediction_root: Optional[str] = None
+                               ) -> List[SceneStatus]:
+    """Step 2, software-pipelined: three overlapped per-scene timelines.
+
+    - **load** (daemon threads): disk IO for scenes i+1..i+depth;
+    - **device** (this thread): H2D feed + associate/graph/cluster dispatch
+      of scene i (``run_scene_device``);
+    - **host tail** (one worker thread): scene i-1's bit-plane drain,
+      DBSCAN split, overlap merge and artifact export (``run_scene_host``).
+
+    The device phase of scene i runs while scene i-1's host tail drains —
+    the handoff count is bounded to one in flight (double buffering), so
+    at most two scenes' (F, N) claim tensors coexist in HBM. Results,
+    artifacts and failure attribution are identical to the sequential
+    loop; only the wall clock differs (pinned by tests/test_executor.py).
+    """
+    from maskclustering_tpu.models.pipeline import run_scene_device, run_scene_host
+    from maskclustering_tpu.utils.daemon_future import DaemonFuture
+
+    pred_root = prediction_root or os.path.join(cfg.data_root, "prediction")
+    statuses: Dict[str, SceneStatus] = {}
+    in_flight = None  # (seq_name, t0, DaemonFuture of the host tail)
+
+    def finish(entry) -> None:
+        # (result, error, t_end) were produced INSIDE the worker when the
+        # tail finished: this join may happen a whole device-phase later
+        # (the backpressure point), and charging that wait to the scene —
+        # ok or failed — would roughly double its reported wall vs the
+        # sequential path
+        seq, t0, fut = entry
+        result, err, t_end = fut.result()
+        if err is not None:
+            log.error("scene %s failed\n%s", seq, err)
+            obs.count("run.scenes_failed")
+            statuses[seq] = SceneStatus(seq, "failed", t_end - t0, error=err)
+            return
+        obs.count("run.scenes_ok")
+        statuses[seq] = SceneStatus(
+            seq, "ok", t_end - t0,
+            num_objects=len(result.objects.point_ids_list),
+            timings={k: round(v, 4) for k, v in result.timings.items()})
+
+    with obs.span("exec.scene_loop", scenes=len(seq_names), mode="overlapped"):
+        for seq, resolve in _prefetched_loads(cfg, seq_names, resume,
+                                              depth=cfg.prefetch_depth):
+            t0 = time.perf_counter()
+            try:
+                ds, tensors = resolve()
+                if tensors is None:
+                    obs.count("run.scenes_skipped")
+                    statuses[seq] = SceneStatus(seq, "skipped")
+                    continue
+                with obs.span("exec.device", scene=seq):
+                    handoff = run_scene_device(tensors, cfg, seq_name=seq)
+            except Exception:
+                log.exception("scene %s failed", seq)
+                obs.count("run.scenes_failed")
+                statuses[seq] = SceneStatus(seq, "failed",
+                                            time.perf_counter() - t0,
+                                            error=traceback.format_exc(limit=20))
+                continue
+            # backpressure OUTSIDE the exec spans: the previous host tail
+            # must retire before another handoff goes live, bounding HBM
+            # to two scenes' claim tensors (current dispatch + one drain)
+            if in_flight is not None:
+                finish(in_flight)
+
+            def host_tail(handoff=handoff, seq=seq, ds=ds):
+                try:
+                    with obs.span("exec.host_tail", scene=seq):
+                        result = run_scene_host(
+                            handoff, cfg, export=True,
+                            object_dict_dir=ds.object_dict_dir,
+                            prediction_root=pred_root)
+                    return result, None, time.perf_counter()
+                except Exception:
+                    return None, traceback.format_exc(limit=20), time.perf_counter()
+
+            in_flight = (seq, t0, DaemonFuture(host_tail,
+                                               name=f"host-tail-{seq}"))
+        if in_flight is not None:
+            finish(in_flight)
+    return [statuses[s] for s in seq_names if s in statuses]
 
 
 def _cluster_worker(payload):
@@ -350,9 +458,10 @@ def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
                 statuses[seq] = SceneStatus(seq, "failed", per_scene,
                                             error=traceback.format_exc(limit=20))
 
-    # one-scene-lookahead prefetch: the next scene's disk load overlaps the
-    # current batch's device compute in flush() (_prefetched_loads)
-    for seq, resolve in _prefetched_loads(cfg, seq_names, resume, prediction_root):
+    # lookahead prefetch: the next scenes' disk loads overlap the current
+    # batch's device compute in flush() (_prefetched_loads)
+    for seq, resolve in _prefetched_loads(cfg, seq_names, resume, prediction_root,
+                                          depth=cfg.prefetch_depth):
         try:
             ds, tensors = resolve()
         except Exception:
@@ -376,13 +485,17 @@ def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
 
     ``cfg.mesh_shape`` set routes through the fused multi-chip path
     (cluster_scenes_mesh). Otherwise ``workers == 1`` runs in-process (the
-    single-chip TPU path: intra-scene device parallelism) and ``workers > 1``
-    spawns processes with round-robin scene shards — the CPU / multi-host
-    shape, mirroring run.py:33-45 without os.system.
+    single-chip TPU path: intra-scene device parallelism) — overlapped
+    across scenes by default (``cfg.scene_overlap``; byte-identical
+    artifacts to the sequential order) — and ``workers > 1`` spawns
+    processes with round-robin scene shards — the CPU / multi-host shape,
+    mirroring run.py:33-45 without os.system.
     """
     if cfg.mesh_shape:
         return cluster_scenes_mesh(cfg, seq_names, resume=resume)
     if workers <= 1:
+        if cfg.scene_overlap and len(seq_names) > 1:
+            return _cluster_scenes_overlapped(cfg, seq_names, resume=resume)
         return _cluster_scenes_sequential(cfg, seq_names, resume=resume)
     import multiprocessing as mp
 
@@ -775,6 +888,14 @@ def main(argv=None) -> int:
                         help=f"comma-separated subset of {ALL_STEPS}")
     parser.add_argument("--workers", type=int, default=1,
                         help="scene-queue worker processes (1 = in-process)")
+    parser.add_argument("--prefetch-depth", type=int, default=None,
+                        help="disk-load lookahead depth of the scene "
+                             "prefetcher (0 = load inline; default: config "
+                             "prefetch_depth, normally 1)")
+    parser.add_argument("--no-overlap", action="store_true",
+                        help="serialize the scene loop (disable the "
+                             "overlapped executor; artifacts are identical "
+                             "either way)")
     parser.add_argument("--no-resume", action="store_true",
                         help="recompute even when artifacts exist")
     parser.add_argument("--encoder", default="hash",
@@ -813,6 +934,10 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.DEBUG if args.debug else logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     overrides = {"data_root": args.data_root} if args.data_root else {}
+    if args.prefetch_depth is not None:
+        overrides["prefetch_depth"] = args.prefetch_depth
+    if args.no_overlap:
+        overrides["scene_overlap"] = False
     cfg = load_config(args.config, **overrides)
     init_backend_or_die(args.init_timeout,
                         platform="cpu" if cfg.backend == "cpu" else None)

@@ -111,12 +111,227 @@ class HashEncoder:
         return l2_normalize(np.stack(feats))
 
 
+# ---------------------------------------------------------------------------
+# open_clip -> HF transformers CLIP state-dict conversion
+# ---------------------------------------------------------------------------
+#
+# The reference's exact checkpoint (ViT-H-14 laion2b_s32b_b79k) downloads
+# into the open_clip cache layout: ``open_clip_config.json`` +
+# ``open_clip_pytorch_model.bin``. ``find_local_clip_checkpoint`` has always
+# DETECTED that layout; this converter makes it LOADABLE by HFCLIPEncoder —
+# if the reference's weights ever land on disk, the pipeline uses them with
+# zero new code (VERDICT r5 Next #5).
+
+# per-resblock submodule map, shared by the vision and text towers
+_OC_BLOCK_MAP = (
+    ("ln_1.weight", "layer_norm1.weight"),
+    ("ln_1.bias", "layer_norm1.bias"),
+    ("attn.out_proj.weight", "self_attn.out_proj.weight"),
+    ("attn.out_proj.bias", "self_attn.out_proj.bias"),
+    ("ln_2.weight", "layer_norm2.weight"),
+    ("ln_2.bias", "layer_norm2.bias"),
+    ("mlp.c_fc.weight", "mlp.fc1.weight"),
+    ("mlp.c_fc.bias", "mlp.fc1.bias"),
+    ("mlp.c_proj.weight", "mlp.fc2.weight"),
+    ("mlp.c_proj.bias", "mlp.fc2.bias"),
+)
+
+
+def _oc_to_np(v) -> np.ndarray:
+    """torch tensor / numpy array -> float32-preserving numpy array."""
+    if hasattr(v, "detach"):  # torch without importing torch
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def _oc_convert_block(out: dict, src: dict, oc_prefix: str, hf_prefix: str) -> None:
+    """One transformer resblock: torch MultiheadAttention's fused in_proj
+    splits row-wise into the HF q/k/v projections; everything else renames."""
+    for oc_name, hf_name in _OC_BLOCK_MAP:
+        out[hf_prefix + hf_name] = _oc_to_np(src.pop(oc_prefix + oc_name))
+    w = _oc_to_np(src.pop(oc_prefix + "attn.in_proj_weight"))
+    b = _oc_to_np(src.pop(oc_prefix + "attn.in_proj_bias"))
+    d = w.shape[0] // 3
+    for i, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+        out[f"{hf_prefix}self_attn.{proj}.weight"] = w[i * d:(i + 1) * d]
+        out[f"{hf_prefix}self_attn.{proj}.bias"] = b[i * d:(i + 1) * d]
+
+
+def _strip_text_prefix(state_dict: dict) -> dict:
+    """Normalize the CustomTextCLIP layout (text tower nested under
+    ``text.``) to the classic flat key names. Returns a shallow copy."""
+    out = {}
+    for k, v in state_dict.items():
+        out[k[len("text."):] if k.startswith("text.") else k] = v
+    return out
+
+
+def convert_open_clip_state_dict(state_dict: dict) -> dict:
+    """open_clip CLIP-ViT state dict -> HF ``transformers`` CLIPModel layout.
+
+    Pure array renaming/reshaping (numpy in, numpy out; torch tensors are
+    accepted and detached): the fused attention ``in_proj`` splits into
+    q/k/v rows, the ``visual.proj``/``text_projection`` matrices transpose
+    into ``Linear`` weight convention, and the class/position embeddings
+    map 1:1. Unknown keys raise — a silently dropped weight would load a
+    subtly wrong encoder. Covers the classic open_clip layout the
+    reference checkpoint (ViT-H-14) uses, including the ``text.``-prefixed
+    CustomTextCLIP variant.
+    """
+    src = _strip_text_prefix(state_dict)
+    out: dict = {}
+
+    # --- vision tower ---
+    out["vision_model.embeddings.class_embedding"] = \
+        _oc_to_np(src.pop("visual.class_embedding")).reshape(-1)
+    out["vision_model.embeddings.position_embedding.weight"] = \
+        _oc_to_np(src.pop("visual.positional_embedding"))
+    out["vision_model.embeddings.patch_embedding.weight"] = \
+        _oc_to_np(src.pop("visual.conv1.weight"))
+    out["vision_model.pre_layrnorm.weight"] = _oc_to_np(src.pop("visual.ln_pre.weight"))
+    out["vision_model.pre_layrnorm.bias"] = _oc_to_np(src.pop("visual.ln_pre.bias"))
+    out["vision_model.post_layernorm.weight"] = _oc_to_np(src.pop("visual.ln_post.weight"))
+    out["vision_model.post_layernorm.bias"] = _oc_to_np(src.pop("visual.ln_post.bias"))
+    out["visual_projection.weight"] = _oc_to_np(src.pop("visual.proj")).T
+
+    # --- text tower ---
+    out["text_model.embeddings.token_embedding.weight"] = \
+        _oc_to_np(src.pop("token_embedding.weight"))
+    out["text_model.embeddings.position_embedding.weight"] = \
+        _oc_to_np(src.pop("positional_embedding"))
+    out["text_model.final_layer_norm.weight"] = _oc_to_np(src.pop("ln_final.weight"))
+    out["text_model.final_layer_norm.bias"] = _oc_to_np(src.pop("ln_final.bias"))
+    out["text_projection.weight"] = _oc_to_np(src.pop("text_projection")).T
+    out["logit_scale"] = _oc_to_np(src.pop("logit_scale")).reshape(())
+
+    # --- transformer blocks of both towers ---
+    blocks = {}
+    for key in list(src):
+        for oc_root, hf_root in (("visual.transformer.resblocks.",
+                                  "vision_model.encoder.layers."),
+                                 ("transformer.resblocks.",
+                                  "text_model.encoder.layers.")):
+            if key.startswith(oc_root):
+                idx = key[len(oc_root):].split(".", 1)[0]
+                blocks[(oc_root, hf_root, int(idx))] = True
+    for oc_root, hf_root, idx in sorted(blocks):
+        _oc_convert_block(out, src, f"{oc_root}{idx}.", f"{hf_root}{idx}.")
+
+    # attn_mask buffers et al. are derived, not weights; anything else is a
+    # layout this converter does not understand
+    leftovers = [k for k in src if not k.endswith("attn_mask")]
+    if leftovers:
+        raise ValueError(
+            f"unrecognized open_clip keys (not a classic CLIP-ViT layout?): "
+            f"{sorted(leftovers)[:8]}")
+    return out
+
+
+def hf_clip_config_from_open_clip(oc_config: dict, state_dict: dict):
+    """transformers CLIPConfig equivalent to an ``open_clip_config.json``.
+
+    Shape facts (widths, depths, vocab) come from the weights themselves
+    where possible — the config only contributes what weights cannot carry
+    (head counts, context length). open_clip ViT heads default to width/64
+    when the config does not name them (open_clip's ``head_width`` knob).
+    """
+    from transformers import CLIPConfig, CLIPTextConfig, CLIPVisionConfig
+
+    model_cfg = oc_config.get("model_cfg", oc_config)
+    vis, txt = model_cfg.get("vision_cfg", {}), model_cfg.get("text_cfg", {})
+    conv = state_dict["visual.conv1.weight"]
+    v_width, _, patch, _ = (int(x) for x in _oc_to_np(conv).shape)
+    t_width = int(_oc_to_np(state_dict["token_embedding.weight"]).shape[1])
+    embed_dim = int(model_cfg.get(
+        "embed_dim", _oc_to_np(state_dict["text_projection"]).shape[1]))
+    v_layers = len({k.split(".")[3] for k in state_dict
+                    if k.startswith("visual.transformer.resblocks.")})
+    t_layers = len({k.split(".")[2] for k in state_dict
+                    if k.startswith("transformer.resblocks.")})
+
+    def inter(prefix: str, width: int) -> int:
+        key = f"{prefix}.resblocks.0.mlp.c_fc.weight"
+        return (int(_oc_to_np(state_dict[key]).shape[0])
+                if key in state_dict else 4 * width)
+
+    # open_clip models use EXACT GeLU unless the config opts into the
+    # OpenAI quick_gelu approximation; HF's CLIPConfig defaults to
+    # quick_gelu (the OpenAI checkpoints' act), so laion checkpoints like
+    # the reference's ViT-H-14 must override it or every MLP is subtly off
+    act = "quick_gelu" if model_cfg.get("quick_gelu") else "gelu"
+    image_size = int(vis.get("image_size", 224))
+    return CLIPConfig.from_text_vision_configs(
+        CLIPTextConfig(
+            vocab_size=int(_oc_to_np(state_dict["token_embedding.weight"]).shape[0]),
+            hidden_size=t_width,
+            intermediate_size=inter("transformer", t_width),
+            num_hidden_layers=t_layers,
+            num_attention_heads=int(txt.get("heads", t_width // 64)),
+            max_position_embeddings=int(txt.get("context_length", 77)),
+            hidden_act=act,
+            projection_dim=embed_dim),
+        CLIPVisionConfig(
+            hidden_size=v_width,
+            intermediate_size=inter("visual.transformer", v_width),
+            num_hidden_layers=v_layers,
+            num_attention_heads=v_width // int(vis.get("head_width", 64)),
+            image_size=image_size,
+            patch_size=patch,
+            hidden_act=act,
+            projection_dim=embed_dim),
+        projection_dim=embed_dim)
+
+
+def load_open_clip_checkpoint(path: str):
+    """torch ``transformers.CLIPModel`` from an open_clip cache directory.
+
+    ``path`` must hold ``open_clip_config.json`` plus
+    ``open_clip_pytorch_model.bin`` (the layout the reference's ViT-H-14
+    checkpoint downloads into). Returns the model with converted weights
+    loaded strictly — a missing or unexpected key raises.
+    """
+    import json
+
+    import torch
+    from transformers import CLIPModel
+
+    with open(os.path.join(path, "open_clip_config.json")) as f:
+        oc_config = json.load(f)
+    sd = torch.load(os.path.join(path, "open_clip_pytorch_model.bin"),
+                    map_location="cpu", weights_only=True)
+    # normalize the CustomTextCLIP nesting BEFORE config derivation too —
+    # hf_clip_config_from_open_clip reads text-tower shapes by flat name
+    sd = _strip_text_prefix(sd)
+    converted = convert_open_clip_state_dict(sd)
+    model = CLIPModel(hf_clip_config_from_open_clip(oc_config, sd))
+    missing, unexpected = model.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v))
+         for k, v in converted.items()}, strict=False)
+    # position_ids buffers are derived (absent from checkpoints by design);
+    # anything else missing means the conversion is incomplete — fail loudly
+    real_missing = [k for k in missing if not k.endswith("position_ids")]
+    if real_missing or unexpected:
+        raise ValueError(f"open_clip conversion mismatch: missing={real_missing} "
+                         f"unexpected={list(unexpected)}")
+    return model
+
+
+def is_open_clip_layout(path: str) -> bool:
+    """Does ``path`` hold an open_clip cache checkpoint (vs HF layout)?"""
+    return (os.path.isfile(os.path.join(path, "open_clip_config.json"))
+            and os.path.isfile(os.path.join(path, "open_clip_pytorch_model.bin"))
+            and not os.path.isfile(os.path.join(path, "config.json")))
+
+
 class HFCLIPEncoder:
     """CLIP via HuggingFace transformers from a local checkpoint.
 
     Prefers the Flax model (runs on the TPU through jax); falls back to torch
-    CPU. Raises a clear error when the checkpoint is unavailable — this
-    environment has no network egress, so weights must already be on disk.
+    CPU. An open_clip cache layout (the reference checkpoint's on-disk
+    shape) is converted in memory via ``convert_open_clip_state_dict`` and
+    served through the torch path. Raises a clear error when the checkpoint
+    is unavailable — this environment has no network egress, so weights
+    must already be on disk.
     """
 
     def __init__(self, model_name_or_path: str, image_size: int = 224):
@@ -125,6 +340,26 @@ class HFCLIPEncoder:
         self.image_size = image_size
         self._flax = None
         self._torch = None
+        if is_open_clip_layout(model_name_or_path):
+            from transformers import CLIPProcessor
+
+            self._model = load_open_clip_checkpoint(model_name_or_path)
+            # the open_clip cache carries no HF tokenizer/processor files;
+            # they are weight-independent, so accept them from the same dir
+            # when present (our fixture layout) and fail with a actionable
+            # message otherwise
+            try:
+                self._processor = CLIPProcessor.from_pretrained(
+                    model_name_or_path, local_files_only=True)
+            except (OSError, EnvironmentError, ValueError) as e:
+                raise ValueError(
+                    f"open_clip checkpoint {model_name_or_path} converted, "
+                    "but no tokenizer/preprocessor files found beside it; "
+                    "copy a CLIP tokenizer (vocab.json/merges.txt) and "
+                    "preprocessor_config.json into the directory") from e
+            self._torch = True
+            self.feature_dim = int(self._model.config.projection_dim)
+            return
         try:
             from transformers import CLIPProcessor, FlaxCLIPModel
 

@@ -1,0 +1,26 @@
+"""Shared bits for buffer-donating jit programs."""
+
+from __future__ import annotations
+
+import warnings
+
+
+def suppress_unusable_donation_warning() -> None:
+    """Silence jax's once-per-compile "donated buffers were not usable".
+
+    The donating programs in this tree (association frame feed, the
+    postprocess group-counts kernel, the fused batch step) donate inputs
+    whose shapes rarely match any output, so XLA cannot alias them — the
+    donation's value is the EARLY HBM RELEASE at last use, which happens
+    either way, and the warning would read as a bug on every first scene.
+
+    Deliberately process-global: the targeted alternative
+    (``warnings.catch_warnings`` around each donating dispatch) mutates
+    the same interpreter-global filter list and is NOT thread-safe, and
+    the overlapped scene executor (run.py) dispatches donating programs
+    from two threads concurrently. The filter matches only this exact
+    jax message; embedding applications that want the warning back can
+    re-enable it after importing this package.
+    """
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable")

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# CI gate: tier-1 tests + perf-ledger regression check, one command.
+#
+#   scripts/ci.sh [BASELINE] [LEDGER]
+#
+# 1. runs the tier-1 suite (ROADMAP.md "Tier-1 verify": CPU backend, not
+#    slow-marked, collection errors tolerated but failures are not);
+# 2. gates the perf ledger's newest headline p50 against BASELINE via
+#    `python -m maskclustering_tpu.obs.report --regress` (exit 2 on a >15%
+#    regression — override the threshold with MCT_REGRESS_THRESHOLD).
+#
+# BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
+# verdict with a numeric headline; any JSON doc with a `value` or a ledger
+# JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
+# Exits non-zero on test failures OR a perf regression, so it gates both
+# correctness and the trajectory.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+BASELINE="${1:-BENCH_builder_r05.json}"
+LEDGER="${2:-${MCT_PERF_LEDGER:-PERF_LEDGER.jsonl}}"
+THRESHOLD="${MCT_REGRESS_THRESHOLD:-0.15}"
+rc=0
+
+echo "== ci: tier-1 tests =="
+if ! timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "ci: tier-1 tests FAILED" >&2
+    rc=1
+fi
+
+echo "== ci: perf regression gate ($LEDGER vs $BASELINE, >$THRESHOLD p50) =="
+if [ ! -f "$LEDGER" ]; then
+    echo "ci: no ledger at $LEDGER; skipping the perf gate" >&2
+elif ! python -m maskclustering_tpu.obs.report --ledger "$LEDGER" \
+        --regress "$BASELINE" --regress-threshold "$THRESHOLD"; then
+    echo "ci: perf regression gate FAILED" >&2
+    rc=2
+fi
+
+exit $rc

@@ -57,7 +57,10 @@ def main():
                          distance_threshold=0.01, few_points_threshold=25,
                          point_chunk=8192)
     mesh = make_mesh(tuple(args.mesh))
-    step = build_fused_step(mesh, cfg, k_max=args.k_max)
+    # same donation setting as the production batch path (batch._cached_step)
+    # so the memory plan read here is the deployed program's
+    step = build_fused_step(mesh, cfg, k_max=args.k_max,
+                            donate=bool(cfg.donate_buffers))
 
     s, f = args.scenes, args.frames
     if f % args.mesh[1]:

@@ -265,3 +265,40 @@ def test_frame_batch_matches_sequential(frame_batch):
         np.testing.assert_array_equal(np.asarray(getattr(bat, field)),
                                       np.asarray(getattr(seq, field)),
                                       err_msg=field)
+
+
+def test_associate_donation_gating_and_identity():
+    """cfg.donate_buffers donates the codec-uploaded frame buffers into the
+    association jit: results are identical to the non-donating path, and
+    DEVICE-RESIDENT caller frames (the bench's HBM-rendered scenes) are
+    never donated — they survive the call readable."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.config import PipelineConfig
+    from maskclustering_tpu.models.backprojection import associate_scene_tensors
+    from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+    # the module's standard scene + DT: the non-donating reference call
+    # reuses the associate program other tests here already compiled
+    scene = make_scene(num_boxes=4, num_frames=8, seed=3)
+    t = to_scene_tensors(scene)
+    cfg = PipelineConfig(config_name="don", dataset="demo", backend="cpu",
+                         distance_threshold=DT)
+    a_don = associate_scene_tensors(t, cfg, k_max=15)
+    a_ref = associate_scene_tensors(t, cfg.replace(donate_buffers=False), k_max=15)
+    np.testing.assert_array_equal(np.asarray(a_don.mask_of_point),
+                                  np.asarray(a_ref.mask_of_point))
+    np.testing.assert_array_equal(np.asarray(a_don.first_id),
+                                  np.asarray(a_ref.first_id))
+    np.testing.assert_array_equal(np.asarray(a_don.mask_valid),
+                                  np.asarray(a_ref.mask_valid))
+
+    t_dev = dataclasses.replace(t, depths=jnp.asarray(t.depths),
+                                segmentations=jnp.asarray(t.segmentations))
+    a_dev = associate_scene_tensors(t_dev, cfg, k_max=15)
+    assert not t_dev.depths.is_deleted()
+    assert not t_dev.segmentations.is_deleted()
+    np.testing.assert_array_equal(np.asarray(a_dev.mask_of_point),
+                                  np.asarray(a_ref.mask_of_point))

@@ -213,11 +213,25 @@ np.testing.assert_array_equal(got, want)
     import os
 
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # cheap probe first: a WEDGED chip hangs inside backend init with no
+    # exception, and this skip used to cost the full 300 s kernel budget —
+    # a third of the tier-1 wall — every time the chip was down. A healthy
+    # backend inits in seconds (init_backend watchdog experience), so 60 s
+    # cleanly separates "no usable TPU" from "kernel still running".
+    probe = ("import sys, jax; "
+             "sys.exit(42 if jax.default_backend() != 'tpu' else 0)")
+    try:
+        p = subprocess.run([sys.executable, "-c", probe], env=env,
+                           capture_output=True, text=True, timeout=60)
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU backend init timed out (chip busy or held elsewhere)")
+    if p.returncode == 42:
+        pytest.skip("non-interpret Pallas needs a real TPU (Mosaic lowering)")
     try:
         proc = subprocess.run([sys.executable, "-c", child], env=env,
                               capture_output=True, text=True, timeout=300)
     except subprocess.TimeoutExpired:
         pytest.skip("TPU backend init timed out (chip busy or held elsewhere)")
-    if proc.returncode == 42:
+    if proc.returncode == 42:  # chip grabbed between the probe and the run
         pytest.skip("non-interpret Pallas needs a real TPU (Mosaic lowering)")
     assert proc.returncode == 0, proc.stderr[-2000:]

@@ -1,0 +1,275 @@
+"""open_clip -> HF CLIP state-dict conversion (VERDICT r5 Next #5).
+
+The reference's exact checkpoint (ViT-H-14 laion2b_s32b_b79k) lands on disk
+in the open_clip cache layout; ``find_local_clip_checkpoint`` detects it but
+HFCLIPEncoder could not load it. These tests pin the converter on a tiny
+RANDOM open_clip-layout fixture built by inverse-mapping a known HF CLIP
+model: the round trip must reproduce the HF layout key-for-key and the
+converted model's forward pass must match the original bitwise-close.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu.semantics.encoder import (
+    convert_open_clip_state_dict,
+    is_open_clip_layout,
+)
+
+VOCAB = ["l", "o", "w", "e", "r", "s", "t", "i", "d", "n",
+         "lo", "l</w>", "w</w>", "r</w>", "t</w>",
+         "low</w>", "er</w>", "lowest</w>", "newer</w>", "wider",
+         "<unk>", "<|startoftext|>", "<|endoftext|>"]
+MERGES = ["#version: 0.2", "l o", "lo w</w>", "e r</w>"]
+
+# tiny geometry shared by every fixture in this module
+WIDTH, LAYERS, HEADS, PATCH, IMAGE, PROJ, INTER = 32, 2, 4, 8, 32, 16, 64
+
+
+def _tiny_hf_config():
+    from transformers import CLIPConfig, CLIPTextConfig, CLIPVisionConfig
+
+    return CLIPConfig.from_text_vision_configs(
+        CLIPTextConfig(vocab_size=len(VOCAB), hidden_size=WIDTH,
+                       intermediate_size=INTER, num_hidden_layers=LAYERS,
+                       num_attention_heads=HEADS, max_position_embeddings=77,
+                       projection_dim=PROJ),
+        CLIPVisionConfig(hidden_size=WIDTH, intermediate_size=INTER,
+                         num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+                         image_size=IMAGE, patch_size=PATCH,
+                         projection_dim=PROJ),
+        projection_dim=PROJ)
+
+
+# inverse of the converter's per-block map — used to BUILD the open_clip
+# fixture from a known HF model, so the test pins semantics, not just names
+_BLOCK_INV = (
+    ("layer_norm1.weight", "ln_1.weight"),
+    ("layer_norm1.bias", "ln_1.bias"),
+    ("self_attn.out_proj.weight", "attn.out_proj.weight"),
+    ("self_attn.out_proj.bias", "attn.out_proj.bias"),
+    ("layer_norm2.weight", "ln_2.weight"),
+    ("layer_norm2.bias", "ln_2.bias"),
+    ("mlp.fc1.weight", "mlp.c_fc.weight"),
+    ("mlp.fc1.bias", "mlp.c_fc.bias"),
+    ("mlp.fc2.weight", "mlp.c_proj.weight"),
+    ("mlp.fc2.bias", "mlp.c_proj.bias"),
+)
+
+
+def _hf_to_open_clip(sd):
+    """HF CLIPModel state dict (torch tensors) -> open_clip layout."""
+    import torch
+
+    out = {
+        "visual.class_embedding": sd["vision_model.embeddings.class_embedding"],
+        "visual.positional_embedding":
+            sd["vision_model.embeddings.position_embedding.weight"],
+        "visual.conv1.weight": sd["vision_model.embeddings.patch_embedding.weight"],
+        "visual.ln_pre.weight": sd["vision_model.pre_layrnorm.weight"],
+        "visual.ln_pre.bias": sd["vision_model.pre_layrnorm.bias"],
+        "visual.ln_post.weight": sd["vision_model.post_layernorm.weight"],
+        "visual.ln_post.bias": sd["vision_model.post_layernorm.bias"],
+        "visual.proj": sd["visual_projection.weight"].t().contiguous(),
+        "token_embedding.weight": sd["text_model.embeddings.token_embedding.weight"],
+        "positional_embedding":
+            sd["text_model.embeddings.position_embedding.weight"],
+        "ln_final.weight": sd["text_model.final_layer_norm.weight"],
+        "ln_final.bias": sd["text_model.final_layer_norm.bias"],
+        "text_projection": sd["text_projection.weight"].t().contiguous(),
+        "logit_scale": sd["logit_scale"],
+        "attn_mask": torch.zeros(2, 2),  # derived buffer: must be ignored
+    }
+    for tower, oc_root in (("vision_model", "visual.transformer"),
+                           ("text_model", "transformer")):
+        for i in range(LAYERS):
+            hf = f"{tower}.encoder.layers.{i}."
+            oc = f"{oc_root}.resblocks.{i}."
+            for hf_name, oc_name in _BLOCK_INV:
+                out[oc + oc_name] = sd[hf + hf_name]
+            out[oc + "attn.in_proj_weight"] = torch.cat(
+                [sd[hf + f"self_attn.{p}.weight"] for p in ("q_proj", "k_proj", "v_proj")])
+            out[oc + "attn.in_proj_bias"] = torch.cat(
+                [sd[hf + f"self_attn.{p}.bias"] for p in ("q_proj", "k_proj", "v_proj")])
+    return out
+
+
+@pytest.fixture(scope="module")
+def open_clip_dir(tmp_path_factory):
+    """Tiny random open_clip-layout checkpoint dir + the HF original.
+
+    Built from a seeded HF CLIPModel so the expected outputs are known;
+    tokenizer/processor files ride along (the fixture mirrors what a user
+    must place beside the reference's downloaded weights).
+    """
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    d = tmp_path_factory.mktemp("open_clip_ckpt")
+    torch.manual_seed(0)
+    model = transformers.CLIPModel(_tiny_hf_config())
+    torch.save(_hf_to_open_clip(model.state_dict()),
+               os.path.join(d, "open_clip_pytorch_model.bin"))
+    with open(os.path.join(d, "open_clip_config.json"), "w") as f:
+        json.dump({"model_cfg": {
+            "embed_dim": PROJ,
+            # the HF fixture model uses CLIPConfig's default quick_gelu,
+            # so the open_clip config must declare it (laion checkpoints
+            # omit it and get exact GeLU — covered by the converter test)
+            "quick_gelu": True,
+            "vision_cfg": {"image_size": IMAGE, "patch_size": PATCH,
+                           "layers": LAYERS, "width": WIDTH,
+                           "head_width": WIDTH // HEADS},
+            "text_cfg": {"context_length": 77, "vocab_size": len(VOCAB),
+                         "width": WIDTH, "heads": HEADS, "layers": LAYERS},
+        }}, f)
+    # tokenizer + image processor (weight-independent companion files)
+    vocab_file = d / "vocab.json"
+    merges_file = d / "merges.txt"
+    vocab_file.write_text(json.dumps({tok: i for i, tok in enumerate(VOCAB)}))
+    merges_file.write_text("\n".join(MERGES))
+    transformers.CLIPTokenizer(str(vocab_file), str(merges_file)).save_pretrained(str(d))
+    transformers.CLIPImageProcessor(
+        size={"shortest_edge": IMAGE},
+        crop_size={"height": IMAGE, "width": IMAGE}).save_pretrained(str(d))
+    return str(d), model
+
+
+def test_convert_pure_numpy_key_mapping():
+    """The converter itself is torch-free: a numpy open_clip-layout dict
+    maps to the exact HF key set with the q/k/v split and transposes."""
+    rng = np.random.default_rng(3)
+    sd = {
+        "visual.class_embedding": rng.standard_normal((WIDTH,)).astype(np.float32),
+        "visual.positional_embedding":
+            rng.standard_normal(((IMAGE // PATCH) ** 2 + 1, WIDTH)).astype(np.float32),
+        "visual.conv1.weight":
+            rng.standard_normal((WIDTH, 3, PATCH, PATCH)).astype(np.float32),
+        "visual.ln_pre.weight": np.ones(WIDTH, np.float32),
+        "visual.ln_pre.bias": np.zeros(WIDTH, np.float32),
+        "visual.ln_post.weight": np.ones(WIDTH, np.float32),
+        "visual.ln_post.bias": np.zeros(WIDTH, np.float32),
+        "visual.proj": rng.standard_normal((WIDTH, PROJ)).astype(np.float32),
+        "token_embedding.weight":
+            rng.standard_normal((len(VOCAB), WIDTH)).astype(np.float32),
+        "positional_embedding": rng.standard_normal((77, WIDTH)).astype(np.float32),
+        "ln_final.weight": np.ones(WIDTH, np.float32),
+        "ln_final.bias": np.zeros(WIDTH, np.float32),
+        "text_projection": rng.standard_normal((WIDTH, PROJ)).astype(np.float32),
+        "logit_scale": np.float32(2.6593),
+    }
+    for oc_root in ("visual.transformer", "transformer"):
+        for i in range(LAYERS):
+            p = f"{oc_root}.resblocks.{i}."
+            sd[p + "attn.in_proj_weight"] = \
+                rng.standard_normal((3 * WIDTH, WIDTH)).astype(np.float32)
+            sd[p + "attn.in_proj_bias"] = \
+                rng.standard_normal((3 * WIDTH,)).astype(np.float32)
+            for _, oc_name in _BLOCK_INV:
+                shape = {"mlp.c_fc.weight": (INTER, WIDTH),
+                         "mlp.c_fc.bias": (INTER,),
+                         "mlp.c_proj.weight": (WIDTH, INTER)}.get(
+                             oc_name, (WIDTH, WIDTH) if oc_name.endswith("weight")
+                             and "ln" not in oc_name else (WIDTH,))
+                sd[p + oc_name] = rng.standard_normal(shape).astype(np.float32)
+
+    out = convert_open_clip_state_dict(sd)
+    # q/k/v split: rows of in_proj in order
+    inp = sd["visual.transformer.resblocks.0.attn.in_proj_weight"]
+    np.testing.assert_array_equal(
+        out["vision_model.encoder.layers.0.self_attn.q_proj.weight"], inp[:WIDTH])
+    np.testing.assert_array_equal(
+        out["vision_model.encoder.layers.0.self_attn.v_proj.weight"], inp[2 * WIDTH:])
+    # projections transpose into Linear convention
+    np.testing.assert_array_equal(out["visual_projection.weight"],
+                                  sd["visual.proj"].T)
+    np.testing.assert_array_equal(out["text_projection.weight"],
+                                  sd["text_projection"].T)
+    # the full HF key set and nothing else (position_ids are derived buffers)
+    transformers = pytest.importorskip("transformers")
+    want = {k for k in transformers.CLIPModel(_tiny_hf_config()).state_dict()
+            if not k.endswith("position_ids")}
+    assert set(out) == want
+
+    # config derivation: widths/depths/intermediates come from the weights;
+    # activation follows open_clip semantics (exact GeLU unless the config
+    # opts into OpenAI's quick_gelu — laion checkpoints omit the flag)
+    from maskclustering_tpu.semantics.encoder import hf_clip_config_from_open_clip
+
+    cfg = hf_clip_config_from_open_clip(
+        {"model_cfg": {"embed_dim": PROJ,
+                       "vision_cfg": {"head_width": WIDTH // HEADS},
+                       "text_cfg": {"heads": HEADS}}}, sd)
+    assert cfg.vision_config.hidden_act == "gelu"
+    assert cfg.text_config.hidden_act == "gelu"
+    assert cfg.vision_config.hidden_size == WIDTH
+    assert cfg.vision_config.intermediate_size == INTER
+    assert cfg.vision_config.num_attention_heads == HEADS
+    assert cfg.text_config.num_hidden_layers == LAYERS
+    cfg_q = hf_clip_config_from_open_clip(
+        {"model_cfg": {"embed_dim": PROJ, "quick_gelu": True}}, sd)
+    assert cfg_q.vision_config.hidden_act == "quick_gelu"
+
+
+def test_unknown_keys_raise():
+    with pytest.raises((ValueError, KeyError)):
+        convert_open_clip_state_dict({"visual.unknown_thing": np.zeros(3)})
+
+
+def test_custom_text_clip_prefix_normalizes():
+    """The CustomTextCLIP variant nests the text tower under 'text.'; both
+    the converter and the config deriver must see through it."""
+    from maskclustering_tpu.semantics.encoder import _strip_text_prefix
+
+    sd = {"text.token_embedding.weight": np.zeros((5, 4)),
+          "text.transformer.resblocks.0.ln_1.weight": np.ones(4),
+          "visual.conv1.weight": np.zeros((4, 3, 2, 2)),
+          "logit_scale": np.float32(1.0)}
+    out = _strip_text_prefix(sd)
+    assert set(out) == {"token_embedding.weight",
+                        "transformer.resblocks.0.ln_1.weight",
+                        "visual.conv1.weight", "logit_scale"}
+
+
+def test_loaded_checkpoint_matches_original_forward(open_clip_dir):
+    """load_open_clip_checkpoint reproduces the original model's features
+    exactly — the conversion is semantic, not just a renaming."""
+    torch = pytest.importorskip("torch")
+    from maskclustering_tpu.semantics.encoder import load_open_clip_checkpoint
+
+    path, original = open_clip_dir
+    assert is_open_clip_layout(path)
+    model = load_open_clip_checkpoint(path)
+
+    torch.manual_seed(1)
+    pixels = torch.randn(2, 3, IMAGE, IMAGE)
+    ids = torch.tensor([[22, 15, 16, 21], [22, 17, 13, 21]])
+    with torch.no_grad():
+        a_img = original.get_image_features(pixel_values=pixels)
+        b_img = model.get_image_features(pixel_values=pixels)
+        a_txt = original.get_text_features(input_ids=ids)
+        b_txt = model.get_text_features(input_ids=ids)
+    np.testing.assert_allclose(a_img.numpy(), b_img.numpy(), atol=1e-6)
+    np.testing.assert_allclose(a_txt.numpy(), b_txt.numpy(), atol=1e-6)
+
+
+def test_hfclip_encoder_serves_open_clip_layout(open_clip_dir):
+    """HFCLIPEncoder transparently loads the open_clip cache layout — the
+    exact deployment shape of the reference's ViT-H-14 checkpoint."""
+    pytest.importorskip("torch")
+    from maskclustering_tpu.semantics import HFCLIPEncoder
+
+    path, _ = open_clip_dir
+    enc = HFCLIPEncoder(path)
+    assert enc.feature_dim == PROJ
+    rng = np.random.default_rng(0)
+    imgs = [rng.integers(0, 255, (40, 52, 3), dtype=np.uint8) for _ in range(2)]
+    feats = enc.encode_images(imgs)
+    assert feats.shape == (2, PROJ)
+    np.testing.assert_allclose(np.linalg.norm(feats, axis=1), 1.0, rtol=1e-5)
+    tfeats = enc.encode_texts(["lower", "wider"])
+    assert tfeats.shape == (2, PROJ)
+    np.testing.assert_allclose(np.linalg.norm(tfeats, axis=1), 1.0, rtol=1e-5)

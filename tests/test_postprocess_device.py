@@ -153,3 +153,33 @@ def test_node_stats_kernel_dedupes_same_rep_claims():
         jnp.asarray(node_visible), jnp.asarray(live_slots),
         jnp.asarray(live_valid), r_pad=r_pad, point_filter_threshold=1.25)
     assert not _unpack_bits(np.asarray(ratio_hi_p), n)[0, 0]
+
+
+def test_chunked_claims_pull_identity():
+    """The chunked double-buffered bit-plane drain (claims_pull_chunk)
+    reproduces the single blocking pull byte-for-byte — 1-row chunks are
+    the adversarial maximum (every live rep drains as its own slice)."""
+    scene = make_scene(num_boxes=4, num_frames=10, seed=21)
+    tensors = to_scene_tensors(scene)
+    res_one = run_scene(tensors, _config(claims_pull_chunk=0), k_max=15)
+    res_many = run_scene(tensors, _config(claims_pull_chunk=1), k_max=15)
+    assert len(res_one.objects.point_ids_list) == len(res_many.objects.point_ids_list)
+    for a, b in zip(res_one.objects.point_ids_list, res_many.objects.point_ids_list):
+        np.testing.assert_array_equal(a, b)
+    assert res_one.objects.mask_list == res_many.objects.mask_list
+
+
+def test_row_chunks_cover_exactly():
+    """_row_chunks slices [0, rows) with no gap/overlap at any chunk size."""
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.models.postprocess_device import _row_chunks
+
+    arr = jnp.arange(44 * 3).reshape(44, 3)
+    for rows in (1, 7, 44):
+        for chunk in (0, 1, 5, 44, 100):
+            chunks = _row_chunks(arr, rows, chunk)
+            got = np.concatenate([np.asarray(c) for c in chunks], axis=0)
+            np.testing.assert_array_equal(got, np.asarray(arr[:rows]))
+            if chunk > 0:
+                assert all(c.shape[0] <= chunk for c in chunks)

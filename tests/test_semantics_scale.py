@@ -131,4 +131,7 @@ def test_scale_pooling_at_vit_h_dim():
     feats = l2_normalize(rng.standard_normal((8 * 3, VIT_H_DIM)).astype(np.float32))
     pooled = pool_scale_features(feats)
     assert pooled.shape == (8, VIT_H_DIM)
-    np.testing.assert_allclose(pooled[0], feats[:3].mean(axis=0), rtol=1e-6)
+    # f32 mean reduction order differs between the pooled path and the
+    # oracle (BLAS/threading dependent); observed deltas are ~1e-9 absolute
+    np.testing.assert_allclose(pooled[0], feats[:3].mean(axis=0),
+                               rtol=1e-5, atol=1e-8)

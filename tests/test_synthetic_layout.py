@@ -1,0 +1,101 @@
+"""Separated synthetic clutter (VERDICT r5 Weak #3).
+
+The historical generator packed grid centers so tightly at >= ~10 boxes
+that neighboring boxes interpenetrated — full-depth scenes no segmenter
+could solve, which made full-depth parity numbers meaningless. The new
+placement guarantees a minimum inter-box gap (expanding the room and the
+camera orbit together when needed) while reproducing the historical
+geometry bit-for-bit for the small scenes every other test pins.
+"""
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu.utils.synthetic import _place_boxes, make_scene
+
+
+def _pairwise_gaps(boxes_arr):
+    gaps = []
+    for i in range(len(boxes_arr)):
+        for j in range(i + 1, len(boxes_arr)):
+            dx = max(boxes_arr[i, 0, 0] - boxes_arr[j, 1, 0],
+                     boxes_arr[j, 0, 0] - boxes_arr[i, 1, 0])
+            dy = max(boxes_arr[i, 0, 1] - boxes_arr[j, 1, 1],
+                     boxes_arr[j, 0, 1] - boxes_arr[i, 1, 1])
+            gaps.append(max(dx, dy))
+    return gaps
+
+
+@pytest.mark.parametrize("k", [9, 16, 36])
+def test_separated_placement_at_any_box_count(k):
+    """Every pair of boxes keeps a positive gap — the interpenetrating
+    regime (>= ~10 boxes in the default room) is gone."""
+    boxes, room_half_eff, scale = _place_boxes(k, 2.0, np.random.default_rng(1))
+    arr = np.array([[b[0], b[1]] for b in boxes])
+    assert min(_pairwise_gaps(arr)) >= 0.15
+    if k > 9:
+        assert scale > 1.0  # the room actually expanded
+        assert room_half_eff == pytest.approx(2.0 * scale)
+
+
+def test_small_scene_geometry_unchanged():
+    """Bit-compat pin: scenes small enough to satisfy the gap in the
+    requested room reproduce the pre-fix layout exactly (every seeded
+    test scene in this suite depends on that)."""
+    scene = make_scene(num_boxes=4, num_frames=10, seed=21)
+    # checksum of the historical generator's cloud for this exact call
+    assert float(scene.scene_points.sum()) == pytest.approx(8057.688, abs=1e-2)
+    _, _, scale = _place_boxes(5, 2.0, np.random.default_rng(0))
+    assert scale == 1.0
+
+
+def test_expanded_room_stays_in_frustum():
+    """When the room scales up, the camera orbit scales with it: every box
+    is still observed (its mask id appears in some frame's id map)."""
+    scene = make_scene(num_boxes=16, num_frames=12, image_hw=(96, 128),
+                       spacing=0.05, seed=9)
+    seen = set(np.unique(scene.segmentations)) - {0}
+    assert len(seen) == 16
+    # and every box contributes visible GEOMETRY, not just a sliver: each
+    # object id claims a meaningful pixel share somewhere
+    for perm_id in sorted(seen):
+        assert (scene.segmentations == perm_id).sum() >= 50
+
+
+def test_exact_path_solves_separated_deep_scene(tmp_path):
+    """The acceptance pin for Weak #3: at full depth (12 objects, 24
+    frames, the percentile ladder walking deep), the EXACT reference path
+    reaches AP50 >= 0.7 on the separated layout — full-depth parity now
+    runs on scenes that can actually be solved. Depth carries sensor-like
+    noise (as scripts/parity_ab.py applies): the reference pipeline's bbox
+    crop assumes non-degenerate view clouds, which analytic depth does not
+    produce."""
+    import os
+
+    from maskclustering_tpu.config import PipelineConfig
+    from maskclustering_tpu.evaluation.ap import evaluate_scans
+    from maskclustering_tpu.models.pipeline import run_scene
+    from maskclustering_tpu.models.postprocess import export_artifacts
+    from maskclustering_tpu.utils.synthetic import to_scene_tensors
+
+    scene = make_scene(num_boxes=12, num_frames=24, image_hw=(96, 128),
+                       spacing=0.035, seed=77)
+    assert min(_pairwise_gaps(scene.boxes)) >= 0.15
+    rng = np.random.default_rng(7)
+    noisy = scene.depths + rng.normal(
+        scale=0.004, size=scene.depths.shape).astype(np.float32)
+    scene.depths[:] = np.where(scene.depths > 0, np.maximum(noisy, 1e-3), 0.0)
+
+    cfg = PipelineConfig(config_name="deepexact", dataset="demo", backend="cpu",
+                         distance_threshold=0.05, step=1, mask_pad_multiple=64,
+                         point_chunk=4096, use_exact_ball_query=True)
+    res = run_scene(to_scene_tensors(scene), cfg, k_max=15)
+    paths = export_artifacts(res.objects, "scene0000_00", "deepexact",
+                             object_dict_dir=str(tmp_path / "od"),
+                             prediction_root=str(tmp_path / "pred"))
+    gt = np.where(scene.gt_instance > 0, 3000 + scene.gt_instance + 1, 1)
+    gt_path = str(tmp_path / "scene0000_00.txt")
+    np.savetxt(gt_path, gt, fmt="%d")
+    avgs = evaluate_scans([paths["npz"]], [gt_path], "scannet",
+                          no_class=True, verbose=False)
+    assert avgs["all_ap_50%"] >= 0.7, avgs
