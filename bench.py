@@ -93,6 +93,11 @@ def _emit(args, times, error=None, stage_timings=None):
         # attribute A/B records to their knob setting; the default record's
         # shape stays unchanged for the driver
         line["frame_batch"] = args.frame_batch
+    # dtype attribution (always recorded): perf deltas across rows must be
+    # assignable to a count_dtype flip vs code drift, and plane_dtype marks
+    # the int16 claim-plane layout era in the trajectory
+    line["count_dtype"] = getattr(args, "count_dtype", "bf16")
+    line["plane_dtype"] = "int16"
     if getattr(args, "obs_events", None) and not getattr(args, "no_obs", False):
         # point the record at its own span stream (report CLI renders it)
         line["obs_events"] = args.obs_events
@@ -247,6 +252,17 @@ def _build_parser():
     # validated at parse time: a bad value must fail BEFORE backend init
     # burns minutes of a chip recovery window (PipelineConfig would only
     # reject it after init + scene render, outside the JSON-line guard)
+    # choices mirror ops/counting.COUNT_DTYPES as a LITERAL: the parser is
+    # built before backend init, and importing the counting module here
+    # would pull jax into the supervisor process pre-watchdog (the one
+    # import this file defers everywhere). config.py still validates the
+    # value against the canonical tuple, so drift fails loudly there.
+    p.add_argument("--count-dtype", default="bf16", choices=("bf16", "int8"),
+                   help="operand encoding of the counting contractions "
+                        "(ops/counting.py): int8 rides the MXU's s8 path "
+                        "with half the operand bytes; artifacts are byte-"
+                        "identical either way (the chip A/B decides the "
+                        "default)")
     p.add_argument("--frame-batch", type=_positive_int, default=1,
                    help="association_frame_batch (frames vectorized per "
                         "association-scan step; A/B knob. Results are "
@@ -323,6 +339,10 @@ def _supervise(args):
         if args.frame_batch != 1 and "frame_batch" not in line:
             # the fallback record must stay attributable to its A/B setting
             line["frame_batch"] = args.frame_batch
+        # same for the dtype knobs: a synthetic fallback line must carry
+        # the A/B attribution the worker would have stamped
+        line.setdefault("count_dtype", args.count_dtype)
+        line.setdefault("plane_dtype", "int16")
         return line
 
     def _on_term(signum, frame):
@@ -546,7 +566,8 @@ def main():
     cfg = PipelineConfig(config_name="bench", dataset="demo",
                          distance_threshold=args.distance_threshold,
                          few_points_threshold=25, point_chunk=8192,
-                         association_frame_batch=args.frame_batch)
+                         association_frame_batch=args.frame_batch,
+                         count_dtype=args.count_dtype)
 
     times = []
     stage_timings = []

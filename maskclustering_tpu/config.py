@@ -66,8 +66,21 @@ class PipelineConfig:
     # 480x640/192k pts) for B-wide utilization per step. Default stays 1
     # until a live-chip measurement shows a win (CPU backend measures a
     # slight loss; byte-identity at any B is pinned by
-    # tests/test_backprojection.py)
+    # tests/test_backprojection.py).
+    # DECISION PENDING (VERDICT Weak #4): scripts/chip_session.sh runs a
+    # dedicated bench_fb8 on/off A/B every session — the first healthy
+    # window's capture decides whether this default flips to 8 or the
+    # knob is deleted. Until that record exists this is dead config
+    # surface kept only for the A/B itself.
     association_frame_batch: int = 1
+    # operand encoding of the boolean/one-hot counting contractions
+    # (ops/counting.py): "bf16" = bf16 operands + f32 accumulation (exact
+    # to 2^24), "int8" = s8 operands + s32 accumulation (exact to 2^31; on
+    # v5e the MXU runs s8 at 2x bf16 throughput with half the operand HBM
+    # traffic). Both produce byte-identical artifacts (tests/
+    # test_counting.py); default stays bf16 until the on-chip A/B in
+    # scripts/chip_session.sh (bench_int8) captures the wall-clock win.
+    count_dtype: str = "bf16"
     point_chunk: int = 8192  # point-chunk size for the affinity matmul
     mask_pad_multiple: int = 256  # pad N_masks to a multiple of this (bucketed recompiles)
     frame_pad_multiple: int = 32  # pad N_frames likewise (mesh batch path)
@@ -125,6 +138,11 @@ class PipelineConfig:
                              f"got {self.association_frame_batch}")
         if self.backend not in ("tpu", "cpu", "gpu"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        from maskclustering_tpu.ops.counting import COUNT_DTYPES
+
+        if self.count_dtype not in COUNT_DTYPES:
+            raise ValueError(f"count_dtype must be one of {COUNT_DTYPES}, "
+                             f"got {self.count_dtype!r}")
         if self.mesh_shape and len(self.mesh_shape) != 2:
             raise ValueError(
                 f"mesh_shape must be (scene, frame), got {self.mesh_shape}")

@@ -11,6 +11,7 @@ import os
 import sys
 
 from maskclustering_tpu.evaluation.ap import evaluate_scans
+from maskclustering_tpu.ops.counting import COUNT_DTYPES
 
 
 def main(argv=None) -> int:
@@ -27,6 +28,10 @@ def main(argv=None) -> int:
                         help="result txt path (default: data/evaluation/<dataset>/<pred dirname>.txt)")
     parser.add_argument("--no_class", action="store_true",
                         help="class-agnostic evaluation")
+    parser.add_argument("--count_dtype", default="bf16",
+                        choices=COUNT_DTYPES,
+                        help="operand encoding of the intersection matmuls "
+                             "(ops/counting.py; identical counts either way)")
     args = parser.parse_args(argv)
 
     output_file = args.output_file
@@ -52,7 +57,8 @@ def main(argv=None) -> int:
         gt_files.append(gt_file)
 
     evaluate_scans(pred_files, gt_files, args.dataset,
-                   no_class=args.no_class, output_file=output_file)
+                   no_class=args.no_class, output_file=output_file,
+                   count_dtype=args.count_dtype)
     print(f"saved results to {output_file}")
     return 0
 

@@ -8,9 +8,11 @@ the same convolution-based precision-recall integration (evaluate.py:192-198).
 
 TPU-first difference: the reference computes one GPU matmul per prediction
 mask against the same-label GT tensor (evaluate.py:313-314). Here ALL
-pred x gt intersections for a scan are one jitted (N_pts, P)^T @ (N_pts, G)
-matmul on the MXU, plus a matvec for void intersections; only the small
-(P, G) count matrix crosses back to host for the greedy pass.
+pred x gt intersections for a scan are one counting matmul
+(ops/counting.py — bf16+f32 or, under ``count_dtype="int8"``, the MXU's
+double-rate s8+s32 path; both exact for the 0/1 mask operands) of
+(N_pts, P)^T @ (N_pts, G), plus a matvec for void intersections; only the
+small (P, G) count matrix crosses back to host for the greedy pass.
 """
 
 from __future__ import annotations
@@ -22,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from maskclustering_tpu.evaluation.instances import GTInstance, group_instances, load_gt_ids
+from maskclustering_tpu.ops import counting
 from maskclustering_tpu.semantics.vocab import get_vocab
 
 # IoU thresholds: 0.50..0.90 step 0.05, then 0.25 (reference evaluate.py:44).
@@ -31,18 +34,25 @@ MIN_REGION_SIZE: int = 100
 
 
 def _intersection_counts(pred_masks: jnp.ndarray, gt_onehot: jnp.ndarray,
-                         void_mask: jnp.ndarray):
+                         void_mask: jnp.ndarray, count_dtype: str = "bf16"):
     """(P, G) intersection counts + (P,) void intersections, one MXU pass.
 
-    Bool masks are cast to f32 for the matmul; counts are exact for any
-    realistic vertex count (< 2^24). Deliberately NOT jitted: every scan has
-    a unique (N_pts, P, G) shape, so a jit wrapper would recompile per scan
-    and cost more than the two matmuls it wraps.
+    A counting contraction of 0/1 masks (ops/counting.py), kept in the
+    encoding's RAW accumulator (``out_dtype=None``): the int8 path's s32
+    counts convert to int32 losslessly and are exact to 2^31 vertices,
+    the bf16 path's f32 counts round-trip through rint exactly below 2^24
+    — identical int32 counts wherever both are exact. Deliberately NOT
+    jitted: every scan has a unique (N_pts, P, G) shape, so a jit wrapper
+    would recompile per scan and cost more than the two matmuls it wraps.
     """
-    p = pred_masks.astype(jnp.float32)
-    g = gt_onehot.astype(jnp.float32)
-    inter = jnp.rint(p.T @ g).astype(jnp.int32)
-    void = jnp.rint(p.T @ void_mask.astype(jnp.float32)).astype(jnp.int32)
+    def to_i32(x):
+        return (x.astype(jnp.int32) if jnp.issubdtype(x.dtype, jnp.integer)
+                else jnp.rint(x).astype(jnp.int32))
+
+    inter = to_i32(counting.count_dot(
+        pred_masks.T, gt_onehot, count_dtype=count_dtype, out_dtype=None))
+    void = to_i32(counting.count_dot(
+        pred_masks.T, void_mask, count_dtype=count_dtype, out_dtype=None))
     return inter, void
 
 
@@ -82,6 +92,7 @@ def assign_instances_for_scan(
     no_class: bool = False,
     scan_key: str = "scan",
     min_region_size: int = MIN_REGION_SIZE,
+    count_dtype: str = "bf16",
 ) -> Tuple[Dict[str, List[_GTRecord]], Dict[str, List[_Pred]]]:
     """Match one scan's predictions to GT (reference evaluate.py:254-329).
 
@@ -119,7 +130,8 @@ def assign_instances_for_scan(
             f"{scan_key}: prediction has {pred_masks.shape[0]} vertices "
             f"but GT has {len(gt_ids)}")
     inter, void_inter = _intersection_counts(
-        jnp.asarray(masks_bool), jnp.asarray(gt_onehot), jnp.asarray(void))
+        jnp.asarray(masks_bool), jnp.asarray(gt_onehot), jnp.asarray(void),
+        count_dtype=count_dtype)
     inter = np.asarray(inter)
     void_inter = np.asarray(void_inter)
     vert_counts = masks_bool.sum(axis=0)
@@ -339,6 +351,7 @@ def evaluate_scans(
     no_class: bool = False,
     output_file: Optional[str] = None,
     verbose: bool = True,
+    count_dtype: str = "bf16",
 ) -> Dict:
     """Evaluate npz predictions against GT txt files (evaluate.py:383-400)."""
     labels, valid_ids = get_vocab(dataset)
@@ -348,7 +361,8 @@ def evaluate_scans(
         gt_ids = load_gt_ids(gt_file)
         gt2pred, pred2gt = assign_instances_for_scan(
             masks, scores, classes, gt_ids, labels, valid_ids,
-            no_class=no_class, scan_key=os.path.basename(pred_file))
+            no_class=no_class, scan_key=os.path.basename(pred_file),
+            count_dtype=count_dtype)
         matches[os.path.abspath(gt_file)] = {"gt": gt2pred, "pred": pred2gt}
     aps = evaluate_matches(matches, labels)
     avgs = compute_averages(aps, labels)

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from maskclustering_tpu.ops import counting
 from maskclustering_tpu.ops.geometry import invert_se3, unproject_depth
 from maskclustering_tpu.utils.donation import suppress_unusable_donation_warning
 
@@ -106,11 +107,19 @@ def estimate_spacing(points: jnp.ndarray, *, sample: int = 2048,
 
 
 class FrameAssociation(NamedTuple):
-    """Per-frame association results, stacked over frames by the caller."""
+    """Per-frame association results, stacked over frames by the caller.
+
+    first/last are int16: mask ids are bounded by k_max (ceiling 1023,
+    pipeline.K_MAX_CEILING) so the claim extremes fit with headroom, and
+    the stacked (F, N) planes — the scene's largest long-lived HBM
+    residents, alive from association emit through the end of postprocess
+    — halve vs int32, as do their host pulls on the non-device postprocess
+    path.
+    """
 
     mask_of_point: jnp.ndarray  # (N,) int32: unique claiming mask id, 0 = none/boundary
-    first_id: jnp.ndarray  # (N,) int32: smallest valid claiming mask id (0 = none)
-    last_id: jnp.ndarray  # (N,) int32: largest valid claiming mask id
+    first_id: jnp.ndarray  # (N,) int16: smallest valid claiming mask id (0 = none)
+    last_id: jnp.ndarray  # (N,) int16: largest valid claiming mask id
     mask_valid: jnp.ndarray  # (K_max+1,) bool: per-mask-id validity (index 0 unused)
     n_pixels: jnp.ndarray  # (K_max+1,) int32: valid-depth pixel count per mask
     n_voxels: jnp.ndarray  # (K_max+1,) int32: occupied voxel count per mask
@@ -121,8 +130,8 @@ class SceneAssociation(NamedTuple):
     """Stacked (F, ...) association tensors for a scene."""
 
     mask_of_point: jnp.ndarray  # (F, N) int32 — the reference's point_in_mask_matrix
-    first_id: jnp.ndarray  # (F, N) int32
-    last_id: jnp.ndarray  # (F, N) int32
+    first_id: jnp.ndarray  # (F, N) int16
+    last_id: jnp.ndarray  # (F, N) int16
     point_visible: jnp.ndarray  # (F, N) bool — the reference's point_frame_matrix
     boundary: jnp.ndarray  # (N,) bool — global boundary points
     mask_valid: jnp.ndarray  # (F, K_max+1) bool
@@ -139,21 +148,24 @@ def _hash_voxel(keys: jnp.ndarray, bits: int) -> jnp.ndarray:
     return jnp.abs(h) & ((1 << bits) - 1)
 
 
-def _counts_by_id(weights: jnp.ndarray, ids: jnp.ndarray, num_ids: int) -> jnp.ndarray:
+def _counts_by_id(weights: jnp.ndarray, ids: jnp.ndarray, num_ids: int,
+                  count_dtype: str = "bf16") -> jnp.ndarray:
     """Per-id weighted counts as a one-hot matvec (MXU), not a scatter.
 
     TPU scatters cost ~6.6 ns/element (scripts/micro_tpu.py) — at N x window
     candidates per frame that is ~10 ms/frame; the (E, num_ids) one-hot
-    contraction is bandwidth-bound and ~100x cheaper. Exact: 0/1 bf16
-    operands with f32 accumulation.
+    contraction is bandwidth-bound and ~100x cheaper. Exact under either
+    counting encoding: every ``weights`` this module passes is 0/1 (ones,
+    window-dedupe flags, distinct-key flags — audited, see ARCHITECTURE.md
+    "Integer counting dtype policy"), so int8 operands lose nothing.
     """
-    oh = jax.nn.one_hot(ids, num_ids, dtype=jnp.bfloat16)
-    return jnp.dot(weights.astype(jnp.bfloat16), oh,
-                   preferred_element_type=jnp.float32)
+    oh = counting.count_onehot(ids, num_ids, count_dtype=count_dtype)
+    return counting.count_dot(weights, oh, count_dtype=count_dtype)
 
 
 def _count_distinct_per_mask(ids: jnp.ndarray, vox_hash: jnp.ndarray, valid: jnp.ndarray,
-                             num_ids: int, bits: int) -> jnp.ndarray:
+                             num_ids: int, bits: int,
+                             count_dtype: str = "bf16") -> jnp.ndarray:
     """Count distinct (id, voxel-hash) pairs per id via one sort (no scatter).
 
     Invalid entries collapse into slot 0 (background), which callers ignore.
@@ -167,14 +179,14 @@ def _count_distinct_per_mask(ids: jnp.ndarray, vox_hash: jnp.ndarray, valid: jnp
     skey = jnp.sort(key)
     new = jnp.concatenate([jnp.array([True]), skey[1:] != skey[:-1]])
     sid = skey >> bits
-    return _counts_by_id(new, sid, num_ids)
+    return _counts_by_id(new, sid, num_ids, count_dtype)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k_max", "window", "distance_threshold", "depth_trunc",
                      "few_points_threshold", "coverage_threshold",
-                     "full_tile_table"),
+                     "full_tile_table", "count_dtype"),
 )
 def associate_frame(
     scene_points: jnp.ndarray,  # (N, 3) float32
@@ -192,6 +204,7 @@ def associate_frame(
     few_points_threshold: int = 25,
     coverage_threshold: float = 0.3,
     full_tile_table: Optional[bool] = None,
+    count_dtype: str = "bf16",
 ) -> FrameAssociation:
     """Associate every scene point with the masks of one frame.
 
@@ -290,7 +303,8 @@ def associate_frame(
     seg_flat = seg.reshape(-1)
     dok_flat = depth_ok.reshape(-1)
     pix_ids = jnp.where(dok_flat, seg_flat, 0)
-    n_pixels = _counts_by_id(jnp.ones_like(pix_ids), pix_ids, k_max + 1)
+    n_pixels = _counts_by_id(jnp.ones_like(pix_ids), pix_ids, k_max + 1,
+                             count_dtype)
 
     # occupied voxels of the mask's backprojected pixels (coverage denominator)
     if vox_size is None:
@@ -299,7 +313,8 @@ def associate_frame(
     vox = jnp.floor(world_pix.reshape(-1, 3) / vox_size).astype(jnp.int32)
     bits = _hash_bits(k_max + 1)
     n_voxels = _count_distinct_per_mask(pix_ids, _hash_voxel(vox, bits),
-                                        dok_flat & (seg_flat > 0), k_max + 1, bits)
+                                        dok_flat & (seg_flat > 0), k_max + 1,
+                                        bits, count_dtype)
 
     # scene points claimed per mask (numerator): each (point, mask) pair
     # counts once — dedupe candidate ids within each point's window row.
@@ -313,11 +328,11 @@ def associate_frame(
     # fused path's vmap over frames, where per-frame temporaries stack)
     def claimed_col(acc, col):
         w, ids = col
-        return acc + _counts_by_id(w, ids, k_max + 1), None
+        return acc + _counts_by_id(w, ids, k_max + 1, count_dtype), None
 
     n_claimed, _ = jax.lax.scan(
         claimed_col, jnp.zeros(k_max + 1, jnp.float32),
-        (row_new.T.astype(jnp.float32), cand_sorted.T))
+        (row_new.T, cand_sorted.T))
 
     coverage = n_claimed / jnp.maximum(n_voxels, 1)
     mask_valid = (
@@ -337,10 +352,16 @@ def associate_frame(
     unique_claim = claimed_any & (first == last)
     mask_of_point = jnp.where(unique_claim, first, 0)
 
+    # the claim extremes narrow to int16 at emit: values are mask ids
+    # <= k_max + 1 <= 1024, and the stacked (F, N) planes outlive every
+    # other association output (they feed postprocess at scene end).
+    # mask_of_point stays int32: it dies inside the graph stage (the
+    # co-occurrence gather consumes it immediately), so narrowing it buys
+    # no steady-state HBM — residency, not representability, decides.
     return FrameAssociation(
         mask_of_point=mask_of_point,
-        first_id=first,
-        last_id=last,
+        first_id=first.astype(jnp.int16),
+        last_id=last.astype(jnp.int16),
         mask_valid=mask_valid,
         n_pixels=n_pixels.astype(jnp.int32),
         n_voxels=n_voxels.astype(jnp.int32),
@@ -364,6 +385,7 @@ def _associate_scene_impl(
     few_points_threshold: int = 25,
     coverage_threshold: float = 0.3,
     frame_batch: int = 1,
+    count_dtype: str = "bf16",
 ) -> SceneAssociation:
     """Projective association over all frames with lax.map (trace-time body).
 
@@ -388,6 +410,7 @@ def _associate_scene_impl(
             # the window-gated default (strip table when window > 1),
             # matching the fused path's frame-vmap policy
             full_tile_table=True if frame_batch == 1 else None,
+            count_dtype=count_dtype,
         )
         return fa.mask_of_point, fa.first_id, fa.last_id, fa.mask_valid
 
@@ -410,7 +433,7 @@ def _associate_scene_impl(
 @functools.lru_cache(maxsize=None)
 def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
                          few_points_threshold, coverage_threshold,
-                         frame_batch=1, donate=False):
+                         frame_batch=1, donate=False, count_dtype="bf16"):
     """One cached top-level jit per static config.
 
     Calling lax.map eagerly re-traces AND re-compiles the whole frame scan
@@ -430,7 +453,8 @@ def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
         _associate_scene_impl, k_max=k_max, window=window,
         distance_threshold=distance_threshold, depth_trunc=depth_trunc,
         few_points_threshold=few_points_threshold,
-        coverage_threshold=coverage_threshold, frame_batch=frame_batch),
+        coverage_threshold=coverage_threshold, frame_batch=frame_batch,
+        count_dtype=count_dtype),
         donate_argnums=(1, 2) if donate else ())
 
 
@@ -440,7 +464,7 @@ def associate_scene(
     k_max: int = 127, window: int = 1, distance_threshold: float = 0.01,
     depth_trunc: float = 20.0, few_points_threshold: int = 25,
     coverage_threshold: float = 0.3, frame_batch: int = 1,
-    donate: bool = False,
+    donate: bool = False, count_dtype: str = "bf16",
 ) -> SceneAssociation:
     """Run projective association over all frames (jit-cached).
 
@@ -454,7 +478,7 @@ def associate_scene(
     fn = _associate_scene_jit(k_max, window, float(distance_threshold),
                               float(depth_trunc), few_points_threshold,
                               float(coverage_threshold), int(frame_batch),
-                              bool(donate))
+                              bool(donate), str(count_dtype))
     return fn(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid,
               jnp.asarray(vox_size, jnp.float32))
 
@@ -499,4 +523,5 @@ def associate_scene_tensors(tensors, cfg, k_max: int = 127) -> SceneAssociation:
         coverage_threshold=cfg.coverage_threshold,
         frame_batch=cfg.association_frame_batch,
         donate=bool(cfg.donate_buffers) and owned,
+        count_dtype=cfg.count_dtype,
     )

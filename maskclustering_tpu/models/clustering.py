@@ -12,8 +12,9 @@ lax.scan with no host sync:
   features by a one-hot matmul (segment-OR on the MXU), replacing
   Node.create_node_from_list;
 - the observer/supporter affinities are V V^T and C C^T exactly as in the
-  reference (iterative_clustering.py:20-23) — bf16 operands, f32
-  accumulation, exact for 0/1 data;
+  reference (iterative_clustering.py:20-23) — counting contractions
+  (ops/counting.py: bf16+f32 or, under ``count_dtype="int8"``, s8+s32
+  on the MXU's double-rate integer path), exact for 0/1 data either way;
 - connected components is min-label propagation run to fixpoint inside a
   lax.while_loop, replacing networkx (iterative_clustering.py:32);
 - the dynamic-length threshold schedule is padded with +inf: an inf
@@ -27,6 +28,8 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+from maskclustering_tpu.ops import counting
 
 
 class ClusterResult(NamedTuple):
@@ -64,6 +67,7 @@ def iterative_clustering(
     schedule: jnp.ndarray,
     *,
     view_consensus_threshold: float = 0.9,
+    count_dtype: str = "bf16",
 ) -> ClusterResult:
     """Dispatch wrapper: one obs span (and, when armed with annotations,
     one ``jax.profiler.TraceAnnotation``) around the jitted solve so the
@@ -75,17 +79,20 @@ def iterative_clustering(
         # execution — a bogus row; the enclosing stage span owns the timing
         return _iterative_clustering_jit(
             visible, contained, active, schedule,
-            view_consensus_threshold=view_consensus_threshold)
+            view_consensus_threshold=view_consensus_threshold,
+            count_dtype=count_dtype)
     from maskclustering_tpu import obs
 
     with obs.span("cluster.solve", m_pad=int(visible.shape[0]),
                   schedule_len=int(schedule.shape[0])):
         return _iterative_clustering_jit(
             visible, contained, active, schedule,
-            view_consensus_threshold=view_consensus_threshold)
+            view_consensus_threshold=view_consensus_threshold,
+            count_dtype=count_dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("view_consensus_threshold",))
+@functools.partial(jax.jit, static_argnames=("view_consensus_threshold",
+                                             "count_dtype"))
 def _iterative_clustering_jit(
     visible: jnp.ndarray,  # (M_pad, F) bool mask-level visible_frame
     contained: jnp.ndarray,  # (M_pad, M_pad) bool mask-level contained_mask
@@ -93,6 +100,7 @@ def _iterative_clustering_jit(
     schedule: jnp.ndarray,  # (T,) f32 observer thresholds, +inf padded
     *,
     view_consensus_threshold: float = 0.9,
+    count_dtype: str = "bf16",
 ) -> ClusterResult:
     m_pad = visible.shape[0]
     arange = jnp.arange(m_pad, dtype=jnp.int32)
@@ -103,18 +111,17 @@ def _iterative_clustering_jit(
     def aggregate(assign):
         """Segment-OR of mask features into representative slots (MXU)."""
         onehot = (assign[None, :] == arange[:, None]) & active[None, :]  # (rep, member)
-        oh = onehot.astype(jnp.bfloat16)
-        v = jnp.dot(oh, vis_m.astype(jnp.bfloat16), preferred_element_type=jnp.float32) > 0
-        c = jnp.dot(oh, con_m.astype(jnp.bfloat16), preferred_element_type=jnp.float32) > 0
+        v = counting.count_dot(onehot, vis_m, count_dtype=count_dtype,
+                               out_dtype=None) > 0
+        c = counting.count_dot(onehot, con_m, count_dtype=count_dtype,
+                               out_dtype=None) > 0
         rep_active = jnp.any(onehot, axis=1)
         return v, c, rep_active
 
     def step(assign, threshold):
         v, c, rep_active = aggregate(assign)
-        vb = v.astype(jnp.bfloat16)
-        cb = c.astype(jnp.bfloat16)
-        observers = jnp.dot(vb, vb.T, preferred_element_type=jnp.float32)
-        supporters = jnp.dot(cb, cb.T, preferred_element_type=jnp.float32)
+        observers = counting.count_dot(v, v.T, count_dtype=count_dtype)
+        supporters = counting.count_dot(c, c.T, count_dtype=count_dtype)
         rate = supporters / (observers + 1e-7)
         adj = (rate >= view_consensus_threshold) & (observers >= threshold)
         adj = adj & ~eye & rep_active[:, None] & rep_active[None, :]

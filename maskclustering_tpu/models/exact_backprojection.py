@@ -290,10 +290,13 @@ def associate_scene_exact(tensors, cfg, k_max: int = 127) -> SceneAssociation:
         mop[fi, frame_boundary] = 0
         boundary |= frame_boundary
 
+    # the dense path emits int16 claim planes (mask ids <= k_max + 1 fit
+    # with headroom); the parity path matches so downstream consumers see
+    # one contract
     return SceneAssociation(
         mask_of_point=jnp.asarray(mop),
-        first_id=jnp.asarray(first),
-        last_id=jnp.asarray(last),
+        first_id=jnp.asarray(first.astype(np.int16)),
+        last_id=jnp.asarray(last.astype(np.int16)),
         point_visible=jnp.asarray(point_visible),
         boundary=jnp.asarray(boundary),
         mask_valid=jnp.asarray(mask_valid),

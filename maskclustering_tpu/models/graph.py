@@ -11,12 +11,17 @@ matrix:
 
 which is exactly ``c = A_tilde^T @ W`` for two {0,1} matrices over points:
 A_tilde[p, m] = "p is a non-boundary point of m", W[p, m'] = "p carries id
-of m' in frame(m')". On TPU this is a bf16 matmul with f32 accumulation —
-bit-exact for 0/1 operands up to 2^24 — so the entire mask-statistics pass
-rides the systolic array. From c:
+of m' in frame(m')". On TPU this is a counting matmul (ops/counting.py:
+bf16 operands + f32 accumulation, or int8 + s32 under
+``count_dtype="int8"`` — both bit-exact for 0/1 operands) so the entire
+mask-statistics pass rides the systolic array. From c:
 
-- visible-count per (mask, frame):   n_vis = c @ onehot(frame-of-mask)
-  (masks within a frame are disjoint, construction.py:24)
+- visible-count per (mask, frame):   n_vis[m, j] = sum of c[m, :] over
+  frame j's contiguous column range (masks within a frame are disjoint,
+  construction.py:24; the ranges are the same slices the segmented argmax
+  walks, so n_vis falls out of that pass as a VPU reduction — no f32
+  matmul of the count matrix, whose entries exceed every narrow operand
+  encoding)
 - total valid points per mask:       n_tot = diag(c)
 - "contained-by" top mask per frame: segmented argmax of c over each
   frame's masks (construction.py:122-128)
@@ -39,6 +44,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from maskclustering_tpu.ops import counting
 
 
 class MaskTable(NamedTuple):
@@ -102,10 +109,14 @@ class GraphStats(NamedTuple):
 
 
 def _cooccurrence(mask_of_point: jnp.ndarray, boundary: jnp.ndarray,
-                  mask_frame: jnp.ndarray, mask_id: jnp.ndarray, point_chunk: int):
-    """c[m, m'] via chunked bf16 matmuls with f32 accumulation.
+                  mask_frame: jnp.ndarray, mask_id: jnp.ndarray, point_chunk: int,
+                  count_dtype: str = "bf16"):
+    """c[m, m'] via chunked counting matmuls (ops/counting.count_dot).
 
-    mask_of_point: (F, N) int32; boundary: (N,) bool.
+    mask_of_point: (F, N) int32; boundary: (N,) bool. The chunk results
+    accumulate in the encoding's exact accumulator dtype (f32 or s32) and
+    the final c converts to f32 — exact for any count below 2^24, so both
+    encodings return identical arrays.
     """
     f, n = mask_of_point.shape
     m_pad = mask_frame.shape[0]
@@ -115,6 +126,7 @@ def _cooccurrence(mask_of_point: jnp.ndarray, boundary: jnp.ndarray,
     bnd = jnp.pad(boundary, (0, n_padded - n), constant_values=True)
     # guard the frame gather for padding entries (frame == F)
     safe_frame = jnp.minimum(mask_frame, f - 1)
+    acc_dtype = counting.accumulator_dtype(count_dtype)
 
     def body(carry, pchunk_start):
         c_acc, ntot_acc = carry
@@ -124,18 +136,18 @@ def _cooccurrence(mask_of_point: jnp.ndarray, boundary: jnp.ndarray,
         ids = mc[safe_frame, :].T  # (Nc, M_pad)
         w_right = (ids == mask_id[None, :])
         w_left = w_right & ~bc[:, None]
-        cw = jnp.dot(w_left.astype(jnp.bfloat16).T, w_right.astype(jnp.bfloat16),
-                     preferred_element_type=jnp.float32)
+        cw = counting.count_dot(w_left.T, w_right, count_dtype=count_dtype,
+                                out_dtype=None)
         return (c_acc + cw, ntot_acc + jnp.sum(w_left, axis=0).astype(jnp.float32)), None
 
-    init = (jnp.zeros((m_pad, m_pad), jnp.float32), jnp.zeros((m_pad,), jnp.float32))
+    init = (jnp.zeros((m_pad, m_pad), acc_dtype), jnp.zeros((m_pad,), jnp.float32))
     (c, n_tot), _ = jax.lax.scan(body, init, jnp.arange(n_chunks) * point_chunk)
-    return c, n_tot
+    return c.astype(jnp.float32), n_tot
 
 
 @functools.partial(jax.jit, static_argnames=("k_max", "point_chunk", "mask_visible_threshold",
                                              "contained_threshold", "undersegment_filter_threshold",
-                                             "big_mask_point_count"))
+                                             "big_mask_point_count", "count_dtype"))
 def compute_graph_stats(
     mask_of_point: jnp.ndarray,  # (F, N) int32, boundary-zeroed
     boundary: jnp.ndarray,  # (N,) bool global boundary points
@@ -149,23 +161,32 @@ def compute_graph_stats(
     contained_threshold: float = 0.8,
     undersegment_filter_threshold: float = 0.3,
     big_mask_point_count: int = 500,
+    count_dtype: str = "bf16",
 ) -> GraphStats:
     f, n = mask_of_point.shape
     m_pad = mask_frame.shape[0]
 
-    c, n_tot = _cooccurrence(mask_of_point, boundary, mask_frame, mask_id, point_chunk)
+    c, n_tot = _cooccurrence(mask_of_point, boundary, mask_frame, mask_id,
+                             point_chunk, count_dtype)
 
-    # ---- per-(mask, frame) visible counts: masks of a frame are disjoint ----
-    frame_onehot = (mask_frame[:, None] == jnp.arange(f)[None, :]).astype(jnp.float32)
-    n_vis = jnp.dot(c, frame_onehot)  # f32 matmul of exact integer counts
+    # frame one-hot of each mask slot, in the counting operand dtype (it
+    # only feeds counting contractions below; padding has frame == F so
+    # its row is all-zero)
+    frame_onehot = (mask_frame[:, None] == jnp.arange(f)[None, :]).astype(
+        counting.operand_dtype(count_dtype))
 
-    # ---- segmented max over each frame's masks: who contains me? ----
+    # ---- segmented max + sum over each frame's masks ----
     # Table columns are sorted by (frame, id), so each frame's masks occupy
     # a CONTIGUOUS column range [starts[j], starts[j+1]): the segmented max
     # is F dynamic slices of width k_max — sequential reads at HBM speed —
     # instead of an (M_pad * F * k_max)-element random gather (~1 s/scene
     # at ScanNet shape, see PROFILE.md's gather cost). Ties resolve to the
-    # lowest mask id in both formulations (columns ascend by id).
+    # lowest mask id in both formulations (columns ascend by id). The same
+    # slices yield n_vis (per-(mask, frame) visible counts — masks of a
+    # frame are disjoint) as a zero-masked row sum, replacing the old
+    # ``c @ frame_onehot`` f32 matmul: c's entries are counts up to N, too
+    # wide for any narrow MXU operand encoding, and the slice reduction is
+    # O(M_pad^2) reads instead of O(M_pad^2 * F) MACs.
     starts = jnp.searchsorted(mask_frame, jnp.arange(f + 1, dtype=jnp.int32)
                               ).astype(jnp.int32)  # padding has frame == F
     c_ext = jnp.concatenate(
@@ -174,12 +195,15 @@ def compute_graph_stats(
     def frame_max(j):
         sl = jax.lax.dynamic_slice(c_ext, (0, starts[j]), (m_pad, k_max))
         valid_col = jnp.arange(k_max) < (starts[j + 1] - starts[j])
-        sl = jnp.where(valid_col[None, :], sl, -1.0)
-        return jnp.max(sl, axis=1), starts[j] + jnp.argmax(sl, axis=1).astype(jnp.int32)
+        slm = jnp.where(valid_col[None, :], sl, -1.0)
+        return (jnp.max(slm, axis=1),
+                starts[j] + jnp.argmax(slm, axis=1).astype(jnp.int32),
+                jnp.sum(jnp.where(valid_col[None, :], sl, 0.0), axis=1))
 
-    cmax, top_global = jax.lax.map(frame_max, jnp.arange(f))  # (F, M_pad) x2
+    cmax, top_global, n_vis = jax.lax.map(frame_max, jnp.arange(f))  # (F, M_pad) x3
     cmax = cmax.T  # (M_pad, F)
     top_global = top_global.T
+    n_vis = n_vis.T
 
     # ---- visibility / containment / undersegmentation logic ----
     safe_tot = jnp.maximum(n_tot, 1.0)[:, None]
@@ -205,7 +229,7 @@ def compute_graph_stats(
 
     # ---- undo undersegmented observers (construction.py:163-169) ----
     u_cols = undersegment[None, :] & contained  # supporters of undersegmented masks
-    zap = jnp.dot(u_cols.astype(jnp.float32), frame_onehot.astype(jnp.float32)) > 0
+    zap = counting.count_dot(u_cols, frame_onehot, count_dtype=count_dtype) > 0
     visible = visible & ~zap
     contained = contained & ~undersegment[None, :]
 
@@ -218,8 +242,7 @@ def compute_graph_stats(
     # (observer_schedule) so thresholds match np.percentile exactly — an
     # f32 lerp can land epsilon above an integer count and flip an
     # `observers >= threshold` decision.
-    vis_f = visible.astype(jnp.bfloat16)
-    observers = jnp.dot(vis_f, vis_f.T, preferred_element_type=jnp.float32)
+    observers = counting.count_dot(visible, visible.T, count_dtype=count_dtype)
     obs_flat = observers.reshape(-1)
     nbins = f + 1
     pad_bins = -(-nbins // 8) * 8

@@ -66,17 +66,18 @@ class DeviceHandoff(NamedTuple):
     pipeline host sync produced it); ``first_id``/``last_id``/
     ``node_visible``/``active`` stay DEVICE-resident — the post-process
     claim kernels consume them in HBM, and only bit-packed planes cross
-    back. A handoff therefore pins ~2 x (F, N) int32 of HBM until its host
-    phase finishes; the overlapped executor bounds the number of live
-    handoffs to one (double buffering) for exactly that reason.
+    back. A handoff therefore pins ~2 x (F, N) int16 of HBM (halved from
+    the historical int32 planes) until its host phase finishes; the
+    overlapped executor bounds the number of live handoffs to one (double
+    buffering) for exactly that reason.
     """
 
     table: MaskTable
     assignment: np.ndarray  # (M_pad,) int32, host
     active: jnp.ndarray  # (M_pad,) bool, device — valid & not undersegmented
     node_visible: jnp.ndarray  # (M_pad, F) bool, device
-    first_id: jnp.ndarray  # (F, N) int32, device
-    last_id: jnp.ndarray  # (F, N) int32, device
+    first_id: jnp.ndarray  # (F, N) int16, device
+    last_id: jnp.ndarray  # (F, N) int16, device
     scene_points: np.ndarray  # (N_pad, 3) f32, host (padded)
     frame_ids: Sequence  # padded frame identifiers
     k_max: int
@@ -235,6 +236,7 @@ def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
             contained_threshold=cfg.contained_threshold,
             undersegment_filter_threshold=cfg.undersegment_filter_threshold,
             big_mask_point_count=cfg.big_mask_point_count,
+            count_dtype=cfg.count_dtype,
         )
         # the schedule stays on device (f32 exact-integer-rank formulation,
         # shared with the fused mesh path): graph -> schedule -> clustering
@@ -249,6 +251,7 @@ def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
         result = iterative_clustering(
             stats.visible, stats.contained, active, schedule,
             view_consensus_threshold=cfg.view_consensus_threshold,
+            count_dtype=cfg.count_dtype,
         )
         # host sync 2/2: the assignment vector feeds the host-side live-rep
         # prep of the post-process

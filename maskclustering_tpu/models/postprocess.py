@@ -51,7 +51,8 @@ class SceneObjects(NamedTuple):
 def _claims_coo(first: np.ndarray, last: np.ndarray, gmap: np.ndarray):
     """COO arrays (global_mask, point, frame) of every (point, mask) claim.
 
-    first/last: (F, N) int32 claiming ids per point per frame (0 = none).
+    first/last: (F, N) integer claiming ids per point per frame (0 = none;
+    int16 since the plane narrowing — every op here is width-agnostic).
     gmap: (F, K+1) -> global mask index or -1.
 
     Each (frame, point) cell contributes at most two claims, and they
@@ -75,8 +76,8 @@ def _claims_coo(first: np.ndarray, last: np.ndarray, gmap: np.ndarray):
 
 def postprocess_scene(
     scene_points: np.ndarray,  # (N, 3)
-    first: np.ndarray,  # (F, N) int32
-    last: np.ndarray,  # (F, N) int32
+    first: np.ndarray,  # (F, N) int16 (any int width works)
+    last: np.ndarray,  # (F, N) int16
     point_visible: np.ndarray,  # (F, N) bool
     mask_frame: np.ndarray,  # (M_pad,) int32
     mask_id: np.ndarray,  # (M_pad,) int32

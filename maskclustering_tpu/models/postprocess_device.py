@@ -29,7 +29,8 @@ tensors the device already holds, so this module keeps it there:
   reference's per-(mask x group) intersect1d loop (post_process.py:~150).
 
 Net device->host traffic: ~2 x R_pad x N/8 bytes + O(M_pad) scalars instead
-of 2-3 (F, N) int32 tensors.
+of 2-3 (F, N) claim tensors (int16 since the plane narrowing — the
+non-device path's pull halved along with the HBM residency).
 """
 
 from __future__ import annotations
@@ -48,6 +49,7 @@ from maskclustering_tpu.utils.donation import suppress_unusable_donation_warning
 suppress_unusable_donation_warning()
 
 from maskclustering_tpu import obs
+from maskclustering_tpu.ops import counting
 from maskclustering_tpu.models.postprocess import (
     SceneObjects,
     _merge_overlapping,
@@ -89,7 +91,7 @@ def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
             scene_points, jnp.asarray(first), jnp.asarray(last), mask_frame,
             mask_id, mask_active, assignment, jnp.asarray(node_visible),
             frame_ids, pull_chunk=cfg.claims_pull_chunk,
-            donate=cfg.donate_buffers, **kwargs)
+            donate=cfg.donate_buffers, count_dtype=cfg.count_dtype, **kwargs)
     else:
         with obs.span("post.host_pull") as sp:
             # the host path pulls the full (F, N) claim tensors — the very
@@ -175,10 +177,11 @@ def _live_rep_prep(mask_frame, mask_id, mask_active, assignment, f, k2,
     return reps, r_pad, rep_lut, rep_tab, live_slots, live_valid, r_pull
 
 
-@functools.partial(jax.jit, static_argnames=("r_pad", "point_filter_threshold"))
+@functools.partial(jax.jit, static_argnames=("r_pad", "point_filter_threshold",
+                                             "count_dtype"))
 def _node_stats_kernel(
-    first: jnp.ndarray,  # (F, N) int32 smallest valid claiming id per (frame, point)
-    last: jnp.ndarray,  # (F, N) int32 largest valid claiming id
+    first: jnp.ndarray,  # (F, N) int16 smallest valid claiming id per (frame, point)
+    last: jnp.ndarray,  # (F, N) int16 largest valid claiming id
     rep_tab: jnp.ndarray,  # (F, K+2) int32: local mask id -> dense live-rep index, -1 none
     node_visible: jnp.ndarray,  # (M_pad, F) bool per-representative visibility
     live_slots: jnp.ndarray,  # (r_pad,) int32 global slot of each live rep (pad: 0)
@@ -186,6 +189,7 @@ def _node_stats_kernel(
     *,
     r_pad: int,
     point_filter_threshold: float,
+    count_dtype: str = "bf16",
 ):
     """Per-(rep, point) claim statistics, bit-packed.
 
@@ -201,14 +205,19 @@ def _node_stats_kernel(
     step made the contraction depth k2 (~65) — too shallow to feed the
     128x128 systolic array — and paid F sequential steps; C frames per
     step deepens the contraction C-fold and cuts the step count to F/C at
-    the cost of a (C, k2, N) bf16 operand window in HBM (~200 MB at
-    C=8, bench shapes). bf16 one-hot operands with f32 accumulation stay
-    exact. The ratio denominator drops out of the scan entirely: one
-    (R, F) @ (F, N) matmul of node-visibility against point-visibility.
+    the cost of a (C, k2, N) narrow operand window in HBM (~200 MB at
+    C=8, bench shapes, bf16; half that under ``count_dtype="int8"``).
+    One-hot operands with exact accumulation (f32 or s32, ops/counting.py)
+    stay exact; the only non-0/1 entries are the {0, 1, 2} values of the
+    duplicate-correction matrix m, representable in both encodings. The
+    ratio denominator drops out of the scan entirely: one (R, F) @ (F, N)
+    matmul of node-visibility against point-visibility.
     """
     f, n = first.shape
     k2 = rep_tab.shape[1]
     nv_rep = jnp.take(node_visible, live_slots, axis=0) & live_valid[:, None]
+    od = counting.operand_dtype(count_dtype)
+    acc_dtype = counting.accumulator_dtype(count_dtype)
 
     chunk = _frame_chunk(f)
 
@@ -218,9 +227,10 @@ def _node_stats_kernel(
         # per-chunk weight rows, built in-step from the scanned rep rows
         # and nv columns — negligible VPU work vs holding an (F, 2R, k2)
         # tensor in HBM for the whole scan
-        rep_oh = jax.nn.one_hot(rt, r_pad, axis=1, dtype=jnp.bfloat16)  # (C, R, k2)
+        rep_oh = counting.count_onehot(rt, r_pad, count_dtype=count_dtype,
+                                       axis=1)  # (C, R, k2)
         w = jnp.concatenate(
-            [rep_oh * nv_f.astype(jnp.bfloat16)[:, :, None], rep_oh],
+            [rep_oh * nv_f.astype(od)[:, :, None], rep_oh],
             axis=1)  # (C, 2R, k2)
         # id 0 = no claim and rep_tab[:, 0] is always -1 (ids are 1-based), so
         # W column 0 is zero — routing the a == b duplicate there drops it.
@@ -228,32 +238,33 @@ def _node_stats_kernel(
         # (one unique triple): detect rep_a == rep_b with a != b and subtract
         # the duplicate via a one-hot on the a id.
         b2 = jnp.where(b == a, 0, b)
-        rep_a = jnp.take_along_axis(rt, a, axis=1)  # (C, N) dense rep or -1
-        rep_b = jnp.take_along_axis(rt, b2, axis=1)
+        rep_a = jnp.take_along_axis(rt, a.astype(jnp.int32), axis=1)  # (C, N) dense rep or -1
+        rep_b = jnp.take_along_axis(rt, b2.astype(jnp.int32), axis=1)
         dup = (rep_a >= 0) & (rep_a == rep_b) & (a != b2)
-        oh_a = jax.nn.one_hot(a, k2, axis=1, dtype=jnp.bfloat16)  # (C, k2, N)
-        oh_b = jax.nn.one_hot(b2, k2, axis=1, dtype=jnp.bfloat16)
-        oh_dup = jax.nn.one_hot(jnp.where(dup, a, 0), k2, axis=1,
-                                dtype=jnp.bfloat16)
+        oh_a = counting.count_onehot(a, k2, count_dtype=count_dtype,
+                                     axis=1)  # (C, k2, N)
+        oh_b = counting.count_onehot(b2, k2, count_dtype=count_dtype, axis=1)
+        oh_dup = counting.count_onehot(jnp.where(dup, a, 0), k2,
+                                       count_dtype=count_dtype, axis=1)
         m = oh_a + oh_b - oh_dup
         # sum_c w[c] @ m[c] as ONE deep contraction over (c, k2)
-        acc = acc + jax.lax.dot_general(
+        acc = acc + counting.count_dot_general(
             w, m, (((0, 2), (0, 1)), ((), ())),
-            preferred_element_type=jnp.float32)
+            count_dtype=count_dtype, out_dtype=None)
         return acc, None
 
     acc, _ = jax.lax.scan(
-        step, jnp.zeros((2 * r_pad, n), jnp.float32),
+        step, jnp.zeros((2 * r_pad, n), acc_dtype),
         (first.reshape(f // chunk, chunk, n),
          last.reshape(f // chunk, chunk, n),
          rep_tab.reshape(f // chunk, chunk, k2),
          nv_rep.T.reshape(f // chunk, chunk, r_pad)))
-    num = acc[:r_pad]
+    # exact integer counts in either accumulator; f32 conversion is exact
+    # below 2^24, so the ratio threshold stays byte-identical across paths
+    num = acc[:r_pad].astype(jnp.float32)
     claimed = acc[r_pad:] > 0
 
-    visible = (first > 0).astype(jnp.bfloat16)  # (F, N)
-    den = jnp.dot(nv_rep.astype(jnp.bfloat16), visible,
-                  preferred_element_type=jnp.float32)
+    den = counting.count_dot(nv_rep, first > 0, count_dtype=count_dtype)
 
     ratio_ok = num / (den + 1e-6) > point_filter_threshold
     return _pack_bits(claimed), _pack_bits(ratio_ok), nv_rep
@@ -293,8 +304,8 @@ def _start_host_copy(arr) -> None:
 
 
 def _mask_group_counts_impl(
-    first: jnp.ndarray,  # (F, N) int32
-    last: jnp.ndarray,  # (F, N) int32
+    first: jnp.ndarray,  # (F, N) int16
+    last: jnp.ndarray,  # (F, N) int16
     pt_ids: jnp.ndarray,  # (C_pad,) int32 node point ids (pad: N — dropped)
     pt_group: jnp.ndarray,  # (C_pad,) int32 global group ids (pad: s_pad — dropped)
     mask_flat: jnp.ndarray,  # (M_pad,) int32 = frame * k2 + id of each mask slot
@@ -303,26 +314,30 @@ def _mask_group_counts_impl(
     *,
     k2: int,
     s_pad: int,
+    count_dtype: str = "bf16",
 ):
     """Best DBSCAN group (+ claim count) per mask slot.
 
     counts[m, g] = |claims of mask m with group label g| computed as per-frame
     one-hot matmuls against the (N, s_pad) group membership plane; the argmax
     is restricted to the mask's own rep's group range (ties -> lowest group,
-    like the host path's packed reduceat).
+    like the host path's packed reduceat). Counts reduce through the f32
+    argmax/max in BOTH encodings (exact integers below 2^24) so the emitted
+    coverage floats are bit-identical across count_dtype.
     """
     f, n = first.shape
-    goh = jnp.zeros((n, s_pad), jnp.bfloat16)
-    goh = goh.at[pt_ids, pt_group].set(1.0, mode="drop")
+    od = counting.operand_dtype(count_dtype)
+    goh = jnp.zeros((n, s_pad), od)
+    goh = goh.at[pt_ids, pt_group].set(1, mode="drop")
 
     def step(_, inp):
         a, b = inp
         # a cell where last == first holds ONE claim (one mask) — drop b
         b = jnp.where(b == a, k2 - 1, b)  # k2-1 is an unused sentinel row
-        oh_a = jax.nn.one_hot(a, k2, axis=0, dtype=jnp.bfloat16)  # (k2, N)
-        oh_b = jax.nn.one_hot(b, k2, axis=0, dtype=jnp.bfloat16)
-        cnt = jnp.dot(oh_a, goh, preferred_element_type=jnp.float32)
-        cnt = cnt + jnp.dot(oh_b, goh, preferred_element_type=jnp.float32)
+        oh_a = counting.count_onehot(a, k2, count_dtype=count_dtype, axis=0)  # (k2, N)
+        oh_b = counting.count_onehot(b, k2, count_dtype=count_dtype, axis=0)
+        cnt = counting.count_dot(oh_a, goh, count_dtype=count_dtype)
+        cnt = cnt + counting.count_dot(oh_b, goh, count_dtype=count_dtype)
         return None, cnt  # (k2, s_pad) exact integer counts in f32
 
     _, counts = jax.lax.scan(step, None, (first, last))  # (F, k2, s_pad)
@@ -337,20 +352,20 @@ def _mask_group_counts_impl(
 
 
 _mask_group_counts_kernel = functools.partial(
-    jax.jit, static_argnames=("k2", "s_pad"))(_mask_group_counts_impl)
+    jax.jit, static_argnames=("k2", "s_pad", "count_dtype"))(_mask_group_counts_impl)
 # donating variant: this kernel is the LAST consumer of the (F, N)
-# first/last claim tensors — donating them releases ~2 x F x N x 4 bytes of
+# first/last claim tensors — donating them releases ~2 x F x N x 2 bytes of
 # HBM mid-postprocess, in time for the NEXT scene's association dispatch at
 # the same shape bucket (the overlapped executor runs the two concurrently)
 _mask_group_counts_kernel_donating = functools.partial(
-    jax.jit, static_argnames=("k2", "s_pad"),
+    jax.jit, static_argnames=("k2", "s_pad", "count_dtype"),
     donate_argnums=(0, 1))(_mask_group_counts_impl)
 
 
 def postprocess_scene_device(
     scene_points: np.ndarray,  # (N, 3) float32, host
-    first: jnp.ndarray,  # (F, N) int32, device
-    last: jnp.ndarray,  # (F, N) int32, device
+    first: jnp.ndarray,  # (F, N) int16, device
+    last: jnp.ndarray,  # (F, N) int16, device
     mask_frame: np.ndarray,  # (M_pad,) int32, host
     mask_id: np.ndarray,  # (M_pad,) int32, host (-1 padding)
     mask_active: np.ndarray,  # (M_pad,) bool, host
@@ -367,6 +382,7 @@ def postprocess_scene_device(
     timings: Optional[Dict[str, float]] = None,
     pull_chunk: int = 0,
     donate: bool = False,
+    count_dtype: str = "bf16",
 ) -> SceneObjects:
     """Same contract and outputs as postprocess_scene, minus the (F, N) pulls.
 
@@ -412,7 +428,8 @@ def postprocess_scene_device(
         claimed_p, ratio_p, nv_rep_d = sp.sync(_node_stats_kernel(
             first, last, jnp.asarray(rep_tab), node_visible,
             jnp.asarray(live_slots), jnp.asarray(live_valid),
-            r_pad=r_pad, point_filter_threshold=float(point_filter_threshold)))
+            r_pad=r_pad, point_filter_threshold=float(point_filter_threshold),
+            count_dtype=count_dtype))
     # device->host transfers dominate this phase on a narrow link (the
     # driver rig's tunnel moves ~2-3 MB/s; a TPU-VM's PCIe makes them
     # ~free). Three cuts: pull only the len(reps) live rows of the
@@ -514,7 +531,7 @@ def postprocess_scene_device(
         best_group_d, best_count_d = sp.sync(kernel(
             first, last, jnp.asarray(pt_ids), jnp.asarray(pt_grp),
             jnp.asarray(mask_flat), jnp.asarray(glo), jnp.asarray(ghi),
-            k2=k2, s_pad=s_pad))
+            k2=k2, s_pad=s_pad, count_dtype=count_dtype))
     best_group = np.asarray(best_group_d)
     best_count = np.asarray(best_count_d)
     obs.count_transfer("d2h", best_group.nbytes + best_count.nbytes,

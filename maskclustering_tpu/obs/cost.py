@@ -67,6 +67,60 @@ _DTYPE_BYTES = {
 
 _SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
 
+# MLIR/StableHLO element type -> byte size (i1 is byte-backed like pred)
+_MLIR_DTYPE_BYTES = {
+    "i1": 1, "i4": 1, "ui4": 1, "i8": 1, "ui8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1,
+    "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "i32": 4, "ui32": 4, "f32": 4,
+    "i64": 8, "ui64": 8, "f64": 8,
+}
+
+# one stablehlo.dot_general instruction with its typed operand/result list:
+#   ... = stablehlo.dot_general %a, %b, ... : (tensor<8x16xi8>,
+#   tensor<16x8xi8>) -> tensor<8x8xi32>
+_DOT_RE = re.compile(
+    r"stablehlo\.dot_general\b[^\n]*?:\s*"
+    r"\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)\s*->\s*tensor<([^>]+)>")
+
+
+def _tensor_info(spec: str) -> Tuple[str, int, int]:
+    """('i8', element count, byte size) of a tensor<...> body like '8x16xi8'."""
+    parts = spec.split("x")
+    dtype = parts[-1]
+    count = 1
+    for d in parts[:-1]:
+        count *= int(d)
+    return dtype, count, count * _MLIR_DTYPE_BYTES.get(dtype, 0)
+
+
+def dot_census(stablehlo_text: str) -> Dict[str, Dict[str, float]]:
+    """Per-dtype-class census of every dot_general in a LOWERED module.
+
+    Keyed by ``LHSxRHS->OUT`` (e.g. ``i8xi8->i32``, ``bf16xbf16->f32``) with
+    instruction count and total operand bytes. This reads the *StableHLO*
+    the backend compiler receives, not the CPU-optimized HLO: the CPU
+    backend promotes s8 operands to s32 before its dots (no s8 ALU path),
+    which would misreport the MXU op class a TPU actually executes. The
+    byte totals are per-instruction static sizes — relative comparisons
+    across ``count_dtype`` variants of the SAME program are exact, which is
+    all the dtype census needs.
+    """
+    out: Dict[str, Dict[str, float]] = {}
+    for lhs, rhs, res in _DOT_RE.findall(stablehlo_text):
+        lt, _, lb = _tensor_info(lhs)
+        rt, _, rb = _tensor_info(rhs)
+        ot, _, _ = _tensor_info(res)
+        key = f"{lt}x{rt}->{ot}"
+        row = out.setdefault(key, {"count": 0, "operand_bytes": 0.0})
+        row["count"] += 1
+        row["operand_bytes"] += float(lb + rb)
+    return out
+
+
+def dot_operand_bytes(census: Dict[str, Dict[str, float]]) -> float:
+    return float(sum(c["operand_bytes"] for c in census.values()))
+
 
 def _element_bytes(type_str: str) -> List[int]:
     """Per-array byte sizes of every shape inside an HLO type string.
@@ -327,8 +381,16 @@ def observe_costs(
                 continue
             row = analyze_compiled(compiled, lower_s=t1 - t0,
                                    compile_s=t2 - t1)
+            try:
+                # the dot dtype census reads the pre-optimization StableHLO
+                # (the program a TPU backend receives; the CPU pipeline
+                # rewrites s8 dots to s32 and would misreport the MXU class)
+                row["dots"] = dot_census(lowered.as_text())
+            except Exception:  # noqa: BLE001 — census is best-effort
+                row["dots"] = {}
             row.update({"stage": stage, "mesh": list(mesh_shape),
-                        "devices": n_dev, "fingerprint": fingerprint})
+                        "devices": n_dev, "count_dtype": cfg.count_dtype,
+                        "fingerprint": fingerprint})
             rows.append(row)
             if sink is not None:
                 sink.emit(KIND_COST, row)
@@ -337,6 +399,90 @@ def observe_costs(
                      sum(c["count"] for c in row["collectives"].values()),
                      row["ici_bytes"])
     return rows
+
+
+def compare_dtypes(
+    mesh_shapes: Sequence[Tuple[int, int]] = DEFAULT_MESHES,
+    *,
+    stages: Sequence[str] = ALL_STAGES,
+    frames: int = 8,
+    points: int = 1024,
+    image_hw: Tuple[int, int] = (24, 32),
+    k_max: int = 7,
+    cfg=None,
+    sink: Optional[EventSink] = None,
+) -> Tuple[Dict[str, List[Dict]], List[Dict]]:
+    """A/B the whole observatory across ``count_dtype`` encodings.
+
+    Lowers every (stage, mesh) pair twice — ``count_dtype="bf16"`` and
+    ``"int8"`` — and returns ``(rows_by_dtype, diff_rows)``. Each diff row
+    compares one (stage, mesh):
+
+    - ``narrowed_*``: the dot classes that CHANGED between the variants
+      (the counting contractions this repo dispatches through
+      ops/counting.py) with their operand bytes per variant and the
+      reduction ratio — the "is the MXU really fed narrower operands"
+      evidence;
+    - ``stable_dots``: classes identical in both variants (the audited
+      stays-wide set: f32 geometry/projection matmuls);
+    - memory-plan deltas (``peak/arg/out`` bytes) from XLA's buffer
+      assignment.
+
+    Rows are also emitted as ``cost`` events (tagged ``count_dtype``) when
+    ``sink`` is given, so ``report --cost`` renders both variants later.
+    """
+    if cfg is None:
+        cfg = _default_pipeline_cfg(point_chunk=max(256, points // 4))
+    rows_by: Dict[str, List[Dict]] = {}
+    for cd in ("bf16", "int8"):
+        rows_by[cd] = observe_costs(
+            mesh_shapes, stages=stages, frames=frames, points=points,
+            image_hw=image_hw, k_max=k_max,
+            cfg=cfg.replace(count_dtype=cd), sink=sink)
+
+    def _key(r):
+        return (r.get("stage"), tuple(r.get("mesh") or ()))
+
+    bf_rows = {_key(r): r for r in rows_by["bf16"]}
+    diffs: List[Dict] = []
+    for r8 in rows_by["int8"]:
+        rb = bf_rows.get(_key(r8))
+        if rb is None or "error" in r8 or "error" in rb:
+            continue
+        dots_b = rb.get("dots") or {}
+        dots_8 = r8.get("dots") or {}
+        stable = {k: dots_b[k] for k in dots_b
+                  if k in dots_8 and dots_8[k] == dots_b[k]}
+        narrowed_b = {k: v for k, v in dots_b.items() if k not in stable}
+        narrowed_8 = {k: v for k, v in dots_8.items() if k not in stable}
+        nb = dot_operand_bytes(narrowed_b)
+        n8 = dot_operand_bytes(narrowed_8)
+        diffs.append({
+            "stage": r8["stage"], "mesh": r8.get("mesh"),
+            "narrowed_bf16": narrowed_b, "narrowed_int8": narrowed_8,
+            "narrowed_bytes_bf16": nb, "narrowed_bytes_int8": n8,
+            "operand_byte_ratio": (nb / n8) if n8 else None,
+            "stable_dots": stable,
+            "peak_bytes_bf16": rb.get("peak_bytes"),
+            "peak_bytes_int8": r8.get("peak_bytes"),
+            "arg_bytes": r8.get("arg_bytes"),
+            "out_bytes_bf16": rb.get("out_bytes"),
+            "out_bytes_int8": r8.get("out_bytes"),
+            "fingerprint": r8.get("fingerprint"),
+        })
+    return rows_by, diffs
+
+
+def claim_plane_bytes(frames: int, points: int) -> Dict[str, float]:
+    """Static size of the two (F, N) first/last claim planes per scene.
+
+    The int16 narrowing is unconditional (not count_dtype-gated), so the
+    A/B cannot show it as a delta; this puts the halving on the record
+    next to the census: 2 planes x F x N x 2 bytes, vs the historical
+    int32 layout's x4.
+    """
+    return {"int16": 2.0 * frames * points * 2,
+            "int32_historical": 2.0 * frames * points * 4}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -361,6 +507,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "obs.report --cost)")
     p.add_argument("--devices", type=int, default=8,
                    help="CPU virtual device count to request")
+    p.add_argument("--compare-dtypes", action="store_true",
+                   help="A/B every (stage, mesh) across count_dtype bf16 vs "
+                        "int8: dot-class census diff, operand bytes, memory-"
+                        "plan delta (see README 'Reading the dtype census')")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
@@ -371,8 +521,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.error(str(e))
 
     sink = EventSink(args.events) if args.events else None
+    stages = tuple(s for s in args.stages.split(",") if s)
+    if args.compare_dtypes:
+        from maskclustering_tpu.obs.report import render_dtype_compare
+
+        rows_by, diffs = compare_dtypes(
+            meshes, stages=stages, frames=args.frames, points=args.points,
+            image_hw=(args.image_h, args.image_w), k_max=args.k_max,
+            sink=sink)
+        if sink is not None:
+            sink.close()
+        print(render_dtype_compare(
+            diffs, planes=claim_plane_bytes(args.frames, args.points)))
+        ok = [r for rows in rows_by.values() for r in rows if "error" not in r]
+        return 0 if diffs and ok else 1
     rows = observe_costs(
-        meshes, stages=tuple(s for s in args.stages.split(",") if s),
+        meshes, stages=stages,
         frames=args.frames, points=args.points,
         image_hw=(args.image_h, args.image_w), k_max=args.k_max, sink=sink)
     if sink is not None:

@@ -334,6 +334,58 @@ def render_cost(rows: List[Dict]) -> str:
     return "\n".join(out).rstrip()
 
 
+def render_dtype_compare(diffs: List[Dict],
+                         planes: Optional[Dict] = None) -> str:
+    """The dtype census: count_dtype bf16-vs-int8 diff per (stage, mesh).
+
+    One row per (stage, mesh): the narrowed dot classes (the counting
+    contractions ops/counting.py dispatches) with operand bytes under each
+    encoding and the reduction ratio, the classes that stayed wide (the
+    audited f32 sites), and XLA's memory-plan peak per variant. ``planes``
+    (obs.cost.claim_plane_bytes) adds the unconditional int16 claim-plane
+    line — that halving is not count_dtype-gated, so it cannot appear as
+    an A/B delta.
+    """
+    out = ["== dtype census: count_dtype bf16 vs int8 (CPU AOT, StableHLO "
+           "dot classes) =="]
+    if not diffs:
+        out.append("no comparable (stage, mesh) rows — every lowering "
+                   "failed or meshes were skipped")
+        return "\n".join(out)
+
+    def _classes(d: Dict) -> str:
+        return (" ".join(f"{k}:{int(v['count'])}" for k, v in sorted(d.items()))
+                or "-")
+
+    rows = []
+    for d in diffs:
+        mesh = d.get("mesh") or []
+        label = f"{mesh[0]}x{mesh[1]}" if len(mesh) == 2 else "-"
+        ratio = d.get("operand_byte_ratio")
+        rows.append([
+            d["stage"], label,
+            _classes(d.get("narrowed_bf16") or {}),
+            _fmt_bytes(d.get("narrowed_bytes_bf16")),
+            _classes(d.get("narrowed_int8") or {}),
+            _fmt_bytes(d.get("narrowed_bytes_int8")),
+            "-" if ratio is None else f"{ratio:.2f}x",
+            _classes(d.get("stable_dots") or {}),
+            _fmt_bytes(d.get("peak_bytes_bf16")),
+            _fmt_bytes(d.get("peak_bytes_int8")),
+        ])
+    out.append(_render(
+        ["stage", "mesh", "bf16 dot classes", "op.bytes",
+         "int8 dot classes", "op.bytes", "ratio", "stays wide",
+         "peak bf16", "peak int8"], rows))
+    if planes:
+        out.append(
+            f"(F, N) first/last claim planes (unconditional int16): "
+            f"{_fmt_bytes(planes.get('int16'))} resident vs "
+            f"{_fmt_bytes(planes.get('int32_historical'))} at the "
+            f"historical int32 layout (halved)")
+    return "\n".join(out)
+
+
 def _fmt_count(v: Optional[float]) -> str:
     if v is None:
         return "-"

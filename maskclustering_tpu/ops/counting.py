@@ -1,0 +1,99 @@
+"""Exact boolean/one-hot counting contractions with a selectable MXU dtype.
+
+Nearly every matmul in this pipeline is a *count*: view-consensus rates,
+observer counts, per-mask visible/claim statistics, AP intersections — all
+contractions of {0, 1} (occasionally {0, 1, 2}) operands whose results are
+small integers. Historically those ran as bf16 operands with f32
+accumulation — bit-exact for 0/1 data up to 2^24 — because bf16 is the
+MXU's native fast path. On v5e the systolic array also runs s8 x s8 -> s32
+at 2x the bf16 rate with HALF the operand HBM traffic, and integer
+accumulation is exact to 2^31, so the same contractions can be dispatched
+as int8 with no tolerance games at all.
+
+This module is the single dispatch point: every counting site in
+models/graph.py, models/clustering.py, models/backprojection.py,
+models/postprocess_device.py and evaluation/ap.py routes through
+``count_dot`` / ``count_dot_general`` / ``count_onehot``, selected by
+``cfg.count_dtype in {"bf16", "int8"}``. Both paths produce IDENTICAL
+results (pinned by tests/test_counting.py and the artifact byte-identity
+tests): the operands are exact small integers in either encoding, and the
+accumulator (f32 below 2^24, s32 below 2^31) never rounds.
+
+What may NOT route through here: contractions with a real-valued operand
+(CLIP feature pooling, geometry transforms) or with integer operands that
+exceed the operand dtype's range — see ARCHITECTURE.md "Integer counting
+dtype policy" for the per-site audit. Small multi-valued operands (the
+postprocess claim-correction matrix holds {0, 1, 2}) are fine: both bf16
+and int8 represent them exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# the two supported operand encodings for counting contractions; config.py
+# validates against this tuple so a typo fails at construction, not in jit
+COUNT_DTYPES = ("bf16", "int8")
+
+# operand encoding -> (operand dtype, accumulator dtype the MXU natively
+# pairs with it: f32 for bf16 inputs, s32 for s8 inputs)
+_DTYPE_MAP = {
+    "bf16": (jnp.bfloat16, jnp.float32),
+    "int8": (jnp.int8, jnp.int32),
+}
+
+
+def operand_dtype(count_dtype: str):
+    """The jnp dtype counting operands are cast to under ``count_dtype``."""
+    return _dtypes(count_dtype)[0]
+
+
+def accumulator_dtype(count_dtype: str):
+    """The exact accumulator dtype paired with ``count_dtype`` operands."""
+    return _dtypes(count_dtype)[1]
+
+
+def _dtypes(count_dtype: str):
+    try:
+        return _DTYPE_MAP[count_dtype]
+    except KeyError:
+        raise ValueError(
+            f"unknown count_dtype {count_dtype!r}; valid: {COUNT_DTYPES}"
+        ) from None
+
+
+def count_dot(a, b, *, count_dtype: str = "bf16", out_dtype=jnp.float32):
+    """``a @ b`` for 0/1-valued operands, exact under either encoding.
+
+    Operands are cast to the counting operand dtype (bf16 or int8) and
+    contracted with the paired exact accumulator
+    (``preferred_element_type``); the result is cast to ``out_dtype``
+    (f32 by default — an exact conversion for any count below 2^24, which
+    keeps every downstream ratio/threshold comparison byte-identical
+    between the two encodings). Pass ``out_dtype=None`` to keep the raw
+    accumulator dtype.
+    """
+    od, acc = _dtypes(count_dtype)
+    out = jnp.dot(a.astype(od), b.astype(od), preferred_element_type=acc)
+    return out if out_dtype is None else out.astype(out_dtype)
+
+
+def count_dot_general(a, b, dimension_numbers, *, count_dtype: str = "bf16",
+                      out_dtype=jnp.float32):
+    """``lax.dot_general`` form of :func:`count_dot` (batch/multi-dim
+    contractions, e.g. the postprocess node-stats frame-chunk scan)."""
+    od, acc = _dtypes(count_dtype)
+    out = jax.lax.dot_general(a.astype(od), b.astype(od), dimension_numbers,
+                              preferred_element_type=acc)
+    return out if out_dtype is None else out.astype(out_dtype)
+
+
+def count_onehot(ids, num: int, *, count_dtype: str = "bf16", axis: int = -1):
+    """``jax.nn.one_hot`` in the counting operand dtype.
+
+    One-hot matrices built here feed straight into ``count_dot*`` without
+    a re-cast; out-of-range ids (negative sentinels, padded slots) produce
+    all-zero rows exactly as with the float encodings.
+    """
+    return jax.nn.one_hot(ids, num, axis=axis, dtype=operand_dtype(count_dtype))

@@ -127,6 +127,12 @@ def out_scene_points(tensors: SceneTensors, n_pad: int) -> np.ndarray:
 def _cached_step(mesh, cfg: PipelineConfig, k_max: int):
     """One jitted fused step per (mesh, cfg, k_max) — reuse across batches.
 
+    ``cfg`` is a frozen dataclass, so every knob that shapes the program —
+    including ``count_dtype`` — is part of the cache key: the bf16 and
+    int8 counting variants compile (and persist in the compilation cache)
+    as distinct fused steps with bit-identical outputs
+    (tests/test_counting.py).
+
     The depth/seg batch operands are built fresh per flush by
     ``pad_scene_batch`` (host-side stacking + feed encode) and are dead
     after the step, so they are donated when ``cfg.donate_buffers`` is on:

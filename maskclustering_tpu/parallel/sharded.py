@@ -53,8 +53,8 @@ class FusedStepResult(NamedTuple):
     node_visible: jnp.ndarray  # (S, M_pad, F) bool aggregated visible_frame per rep
     mask_active: jnp.ndarray  # (S, M_pad) bool valid & not undersegmented
     mask_of_point: jnp.ndarray  # (S, F, N) int32 point-in-mask matrix
-    first_id: jnp.ndarray  # (S, F, N) int32
-    last_id: jnp.ndarray  # (S, F, N) int32
+    first_id: jnp.ndarray  # (S, F, N) int16
+    last_id: jnp.ndarray  # (S, F, N) int16
     num_objects: jnp.ndarray  # (S,) int32 live representative count
 
 
@@ -90,6 +90,7 @@ def _assoc_stage(cfg, k_max, mesh, scene_points, depths, segs, intrinsics,
             depth_trunc=cfg.depth_trunc,
             few_points_threshold=cfg.few_points_threshold,
             coverage_threshold=cfg.coverage_threshold,
+            count_dtype=cfg.count_dtype,
         )
         return fa.mask_of_point, fa.first_id, fa.last_id, fa.mask_valid
 
@@ -115,6 +116,7 @@ def _graph_stage(cfg, k_max, mesh, mop, boundary, active0):
         contained_threshold=cfg.contained_threshold,
         undersegment_filter_threshold=cfg.undersegment_filter_threshold,
         big_mask_point_count=cfg.big_mask_point_count,
+        count_dtype=cfg.count_dtype,
     )
     visible = _maybe_constrain(stats.visible, mesh, "frame", None)
     contained = _maybe_constrain(stats.contained, mesh, "frame", None)
@@ -125,7 +127,8 @@ def _cluster_stage(cfg, mesh, visible, contained, active, schedule):
     """Iterative view-consensus clustering (unbatched)."""
     result = iterative_clustering(
         visible, contained, active, schedule,
-        view_consensus_threshold=cfg.view_consensus_threshold)
+        view_consensus_threshold=cfg.view_consensus_threshold,
+        count_dtype=cfg.count_dtype)
     assignment = _maybe_constrain(result.assignment, mesh, "frame")
     return result._replace(assignment=assignment)
 
@@ -247,7 +250,8 @@ def build_stage_step(stage: str, mesh, cfg, *, k_max: int = 15,
             return _node_stats_kernel(
                 first, last, rep_tab, node_visible, live_slots, live_valid,
                 r_pad=r_pad,
-                point_filter_threshold=float(cfg.point_filter_threshold))
+                point_filter_threshold=float(cfg.point_filter_threshold),
+                count_dtype=cfg.count_dtype)
 
         return jax.jit(post)
 
@@ -296,7 +300,8 @@ def stage_arg_shapes(stage: str, *, scenes: int = 1, frames: int = 8,
                 sds((s, m_pad), jnp.bool_), sds((s, max_iters), jnp.float32))
     if stage == "postprocess":
         k2 = k_max + 2
-        return (sds((f, n), jnp.int32), sds((f, n), jnp.int32),
+        # first/last are the int16 claim planes the association stage emits
+        return (sds((f, n), jnp.int16), sds((f, n), jnp.int16),
                 sds((f, k2), jnp.int32), sds((m_pad, f), jnp.bool_),
                 sds((r_pad,), jnp.int32), sds((r_pad,), jnp.bool_))
     raise ValueError(f"unknown stage {stage!r}; valid: {STAGE_NAMES}")

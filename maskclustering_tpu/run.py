@@ -855,7 +855,11 @@ def _run_pipeline_body(
                 led.run_row({"config_name": report.config_name,
                              "scenes": [dataclasses.asdict(s)
                                         for s in report.scenes],
-                             "obs": report.obs}))
+                             "obs": report.obs},
+                            # dtype attribution, same keys as bench rows:
+                            # --regress flags flips instead of blaming code
+                            count_dtype=cfg.count_dtype,
+                            plane_dtype="int16"))
         except Exception:  # noqa: BLE001 — the ledger must never fail the run
             log.exception("perf ledger append failed")
     return report

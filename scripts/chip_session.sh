@@ -14,15 +14,24 @@
 # Steps, most valuable first (each writes OUTDIR/NAME.out + NAME.err):
 #   1. bench.py (honest shape, 5 repeats)      -> bench_default.out (JSON line)
 #      + obs events (default-armed)            -> bench_default_events.jsonl
-#   2. claims_diag (kernel vs tunnel split)    -> claims_diag.out
+#   2. claims_diag (kernel vs tunnel split,    -> claims_diag.out
+#      + int16 claim-plane drain bytes)
 #   3. fb_identity (frame-batch byte-identity  -> fb_identity.out
 #      on the LIVE backend; CPU-only pinned by tests until this runs)
-#   4. bench.py --frame-batch 8 (A/B)          -> bench_fb8.out (JSON line)
-#   5. northstar sweep (multi-bucket, ~3 min)  -> northstar.out + NORTHSTAR_live.md
-#   6. obs report render of the bench captures -> obs_report.out
-#   7. cost observatory (CPU AOT; no chip time) -> cost_census.out + cost_events.jsonl
-#   8. perf ledger history + regress gate      -> perf_ledger.out
-#      (bench steps above append rows to PERF_LEDGER.jsonl by default)
+#   4. bench.py --count-dtype int8 (A/B vs     -> bench_int8.out (JSON line)
+#      step 1's bf16 default: the s8-MXU counting-path wall-clock number;
+#      flips cfg.count_dtype's default when it wins)
+#   5. bench.py --frame-batch 8 (A/B; VERDICT  -> bench_fb8.out (JSON line)
+#      Weak #4's decision record — this capture flips the
+#      association_frame_batch default to 8 or kills the knob)
+#   6. northstar sweep (multi-bucket, ~3 min)  -> northstar.out + NORTHSTAR_live.md
+#   7. obs report render of the bench captures -> obs_report.out
+#      (+ per-stage diffs of both A/B runs against the default)
+#   8. cost observatory (CPU AOT; no chip time) -> cost_census.out + cost_events.jsonl
+#      + dtype census (bf16-vs-int8 AOT diff)  -> dtype_census.out
+#   9. perf ledger history + regress gate      -> perf_ledger.out
+#      (bench steps above append rows to PERF_LEDGER.jsonl by default;
+#      rows carry count_dtype/plane_dtype so A/B deltas self-attribute)
 #   MCT_XPROF=SPANS adds a 1-repeat xprof capture bench step (e.g.
 #   MCT_XPROF=cluster,post.claims.kernel) -> xprof_trace.out + $OUT/xprof/
 set -u
@@ -50,9 +59,11 @@ fi
 # kernel-vs-transfer split becomes a by-product of any session, not a
 # bespoke diagnostic that needs its own recovery window
 OBS_DEFAULT=(--obs-events "$OUT/bench_default_events.jsonl")
+OBS_INT8=(--obs-events "$OUT/bench_int8_events.jsonl")
 OBS_FB8=(--obs-events "$OUT/bench_fb8_events.jsonl")
 if [ -n "${MCT_NO_OBS:-}" ]; then
   OBS_DEFAULT=(--no-obs)
+  OBS_INT8=(--no-obs)
   OBS_FB8=(--no-obs)
 fi
 
@@ -69,6 +80,10 @@ run() { # run NAME TIMEOUT CMD...
 run bench_default 900 python bench.py --retry-budget 300 --init-attempts 2 "${OBS_DEFAULT[@]}" ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
 run claims_diag   600 python scripts/claims_diag.py ${PLAT[@]+"${PLAT[@]}"} ${DIAG_QUICK[@]+"${DIAG_QUICK[@]}"}
 run fb_identity   600 python scripts/fb_identity.py --frame-batch 8 ${PLAT[@]+"${PLAT[@]}"}
+# the two knob A/Bs, run back-to-back against step 1's default record:
+# int8 counting path (tentpole — the s8-MXU wall-clock number) and the
+# frame-batch hypothesis (VERDICT Weak #4 — this record settles the knob)
+run bench_int8    700 python bench.py --retry-budget 200 --init-attempts 2 --count-dtype int8 "${OBS_INT8[@]}" ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
 run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8 "${OBS_FB8[@]}" ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
 if [ -n "${MCT_XPROF:-}" ] && [ -z "${MCT_NO_OBS:-}" ]; then
   # span-triggered profiler capture: one repeat, first opening of each
@@ -79,6 +94,11 @@ if [ -n "${MCT_XPROF:-}" ] && [ -z "${MCT_NO_OBS:-}" ]; then
 fi
 run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md" ${PLAT[@]+"${PLAT[@]}"} ${NS_QUICK[@]+"${NS_QUICK[@]}"}
 if [ -z "${MCT_NO_OBS:-}" ] && [ -f "$OUT/bench_default_events.jsonl" ]; then
+  if [ -f "$OUT/bench_int8_events.jsonl" ]; then
+    # same A-vs-B orientation as the fb8 diff below: default is always the
+    # A side, so a positive delta reads "variant slower" in both files
+    run obs_report_int8 120 python -m maskclustering_tpu.obs.report "$OUT/bench_default_events.jsonl" --diff "$OUT/bench_int8_events.jsonl"
+  fi
   if [ -f "$OUT/bench_fb8_events.jsonl" ]; then
     run obs_report 120 python -m maskclustering_tpu.obs.report "$OUT/bench_default_events.jsonl" --diff "$OUT/bench_fb8_events.jsonl"
   else
@@ -91,6 +111,10 @@ COST_SHAPE=(--frames 64 --points 65536 --image-h 240 --image-w 320 --k-max 63)
 [ -n "${MCT_QUICK:-}" ] && COST_SHAPE=(--frames 8 --points 1024 --image-h 24 --image-w 32 --k-max 7)
 run cost_census 900 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.obs.cost \
   --events "$OUT/cost_events.jsonl" --mesh 1x8 --mesh 8x1 "${COST_SHAPE[@]}"
+# dtype census: the static bf16-vs-int8 A/B (dot classes, operand bytes,
+# memory plan) — the off-chip half of the bench_int8 story, also chip-free
+run dtype_census 900 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.obs.cost \
+  --compare-dtypes --events "$OUT/dtype_census_events.jsonl" --mesh 1x8 "${COST_SHAPE[@]}"
 # perf ledger: render the trajectory the bench steps above just appended
 # to, and gate against the last committed good verdict when present
 if [ -f BENCH_builder_r05.json ]; then

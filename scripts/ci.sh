@@ -4,7 +4,12 @@
 #   scripts/ci.sh [BASELINE] [LEDGER]
 #
 # 1. runs the tier-1 suite (ROADMAP.md "Tier-1 verify": CPU backend, not
-#    slow-marked, collection errors tolerated but failures are not);
+#    slow-marked, collection errors tolerated but failures are not), with
+#    --durations=10 on record and a NON-FATAL warning when the suite wall
+#    exceeds 800 s of the 870 s timeout budget (MCT_TIER1_WALL_WARN to
+#    override) — new tests must reuse the small shared synthetic fixtures,
+#    not fresh full-depth scenes, and this is the tripwire that says so
+#    before the hard timeout does;
 # 2. gates the perf ledger's newest headline p50 against BASELINE via
 #    `python -m maskclustering_tpu.obs.report --regress` (exit 2 on a >15%
 #    regression — override the threshold with MCT_REGRESS_THRESHOLD).
@@ -22,12 +27,22 @@ LEDGER="${2:-${MCT_PERF_LEDGER:-PERF_LEDGER.jsonl}}"
 THRESHOLD="${MCT_REGRESS_THRESHOLD:-0.15}"
 rc=0
 
+WALL_WARN="${MCT_TIER1_WALL_WARN:-800}"
 echo "== ci: tier-1 tests =="
+t0=$(date +%s)
 if ! timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
-        -m 'not slow' --continue-on-collection-errors \
+        -m 'not slow' --continue-on-collection-errors --durations=10 \
         -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "ci: tier-1 tests FAILED" >&2
     rc=1
+fi
+wall=$(( $(date +%s) - t0 ))
+echo "== ci: tier-1 wall ${wall}s (budget: warn >${WALL_WARN}s of the 870s timeout) =="
+if [ "$wall" -gt "$WALL_WARN" ]; then
+    # non-fatal: the suite still passed, but the headroom is gone — trim
+    # the slowest tests (see the --durations table above) onto the shared
+    # small fixtures before the 870 s hard timeout starts eating the run
+    echo "ci: WARNING tier-1 wall ${wall}s exceeds the ${WALL_WARN}s soft budget" >&2
 fi
 
 echo "== ci: perf regression gate ($LEDGER vs $BASELINE, >$THRESHOLD p50) =="

@@ -15,8 +15,12 @@ times, over 5 repeats each:
   kernel        `_node_stats_kernel` with a 1-element sync (device time)
   pull_claimed  np.asarray of the (r_pull, N/8) claimed plane
   pull_ratio    np.asarray of the ratio plane (what copy_to_host_async hides)
-  pull_calib    np.asarray of a fresh device buffer of the same byte size
-                (pure tunnel rate at that transfer size, for comparison)
+  pull_plane16  np.asarray of one full (F, N) int16 claim plane — the
+                non-device-postprocess drain unit, HALVED by the int16
+                narrowing (was int32); reported with its byte size so the
+                record shows what the narrowing saves at the rig's real rate
+  pull_calib    np.asarray of a fresh device buffer of the claimed plane's
+                byte size (pure tunnel rate at that size, for comparison)
 
 Interpretation: if kernel >> floor, capture a trace (bench --profile-dir)
 and look at the one-hot/dot fusion; if pull_* ~ pull_calib dominates, the
@@ -150,16 +154,29 @@ def main():
     def pull_calib():
         return np.asarray(claimed_p[:r_pull] ^ np.uint8(next(calib_seq)))
 
+    # full (F, N) int16 claim plane: the drain unit of the non-device
+    # postprocess path (and the byte size the int16 narrowing halved).
+    # Same fresh-buffer XOR trick — jax.Array caches its host copy.
+    def pull_plane16():
+        return np.asarray(assoc.first_id ^ jnp.int16(next(calib_seq)))
+
+    assert assoc.first_id.dtype == jnp.int16, assoc.first_id.dtype
+    plane_mb = (f * n * 2) / 1e6
     print("[claims_diag] timings (median of 5):", flush=True)
     t_kernel = timeit("kernel", kernel)
     t_claim = timeit("pull_claimed", lambda: np.asarray(claimed_p[:r_pull]))
     t_ratio = timeit("pull_ratio", lambda: np.asarray(ratio_p[:r_pull]))
+    t_plane = timeit("pull_plane16", pull_plane16)
     t_calib = timeit("pull_calib", pull_calib)
     mb = (r_pull * (n // 8)) / 1e6
     print(f"[claims_diag] kernel={t_kernel*1e3:.0f}ms "
           f"claimed_pull={t_claim*1e3:.0f}ms ratio_pull={t_ratio*1e3:.0f}ms "
           f"calib({mb:.2f}MB)={t_calib*1e3:.0f}ms "
           f"-> tunnel {mb/max(t_calib,1e-9):.1f} MB/s", flush=True)
+    print(f"[claims_diag] int16 claim plane drain: {plane_mb:.1f} MB/plane "
+          f"(int32 layout would be {plane_mb*2:.1f} MB) in "
+          f"{t_plane*1e3:.0f}ms -> {plane_mb/max(t_plane,1e-9):.1f} MB/s; "
+          f"x2 planes/scene on the host-postprocess path", flush=True)
 
 
 if __name__ == "__main__":

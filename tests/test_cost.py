@@ -17,6 +17,9 @@ import pytest
 
 from maskclustering_tpu.obs.cost import (
     collective_census,
+    compare_dtypes,
+    dot_census,
+    dot_operand_bytes,
     ici_bytes,
     observe_costs,
     op_census,
@@ -78,6 +81,34 @@ def test_op_census_counts():
     assert ops["fusion"] == 1
     assert ops["copy"] == 1
     assert ops["transpose"] == 1
+
+
+_CANNED_STABLEHLO = """\
+module @jit_fn {
+  func.func public @main(%arg0: tensor<8x16xi8>) -> tensor<8x8xi32> {
+    %0 = stablehlo.dot_general %arg0, %arg1, contracting_dims = [1] x [0], \
+precision = [DEFAULT, DEFAULT] : (tensor<8x16xi8>, tensor<16x8xi8>) -> tensor<8x8xi32>
+    %1 = stablehlo.dot_general %a, %b, batching_dims = [0] x [0], \
+contracting_dims = [2] x [1] : (tensor<4x6x5xbf16>, tensor<4x5x7xbf16>) -> tensor<4x6x7xf32>
+    %2 = stablehlo.dot_general %c, %d, contracting_dims = [1] x [0] : \
+(tensor<8x3xf32>, tensor<3x3xf32>) -> tensor<8x3xf32>
+    %3 = stablehlo.dot_general %c, %d, contracting_dims = [1] x [0] : \
+(tensor<8x3xf32>, tensor<3x3xf32>) -> tensor<8x3xf32>
+  }
+}
+"""
+
+
+def test_dot_census_classes_and_bytes():
+    census = dot_census(_CANNED_STABLEHLO)
+    assert census["i8xi8->i32"] == {"count": 1,
+                                    "operand_bytes": 8 * 16 + 16 * 8}
+    assert census["bf16xbf16->f32"] == {
+        "count": 1, "operand_bytes": (4 * 6 * 5 + 4 * 5 * 7) * 2.0}
+    assert census["f32xf32->f32"]["count"] == 2
+    assert dot_operand_bytes(census) == (
+        8 * 16 + 16 * 8 + (4 * 6 * 5 + 4 * 5 * 7) * 2.0
+        + 2 * (8 * 3 + 3 * 3) * 4.0)
 
 
 # ---------------------------------------------------------------------------
@@ -169,6 +200,34 @@ def test_report_cost_renders_from_events(tmp_path, capsys):
     assert "mesh scene=1 x frame=8" in out
     assert "graph" in out and "ici" in out
     assert "v5e" in out
+
+
+def test_compare_dtypes_halves_counting_operand_bytes(tmp_path, capsys):
+    """The dtype census A/B: on the clustering stage (all of whose dots are
+    counting contractions) the int8 variant must show exactly the bf16
+    classes replaced by i8xi8->i32 at HALF the operand bytes, with the
+    render carrying the ratio and the int16-plane line."""
+    from maskclustering_tpu.obs.cost import claim_plane_bytes
+    from maskclustering_tpu.obs.report import render_dtype_compare
+
+    rows_by, diffs = compare_dtypes([(1, 8)], stages=("clustering",), **_TINY)
+    assert len(diffs) == 1
+    d = diffs[0]
+    assert set(d["narrowed_bf16"]) == {"bf16xbf16->f32"}
+    assert set(d["narrowed_int8"]) == {"i8xi8->i32"}
+    assert d["narrowed_int8"]["i8xi8->i32"]["count"] == \
+        d["narrowed_bf16"]["bf16xbf16->f32"]["count"]
+    assert d["operand_byte_ratio"] == pytest.approx(2.0)
+    assert d["narrowed_bytes_bf16"] == 2 * d["narrowed_bytes_int8"]
+    json.dumps(diffs)  # diff rows must be JSON-able
+    out = render_dtype_compare(
+        diffs, planes=claim_plane_bytes(_TINY["frames"], _TINY["points"]))
+    assert "2.00x" in out
+    assert "claim planes" in out and "halved" in out
+    # claim-plane arithmetic: 2 planes x F x N x bytes/el
+    planes = claim_plane_bytes(8, 512)
+    assert planes["int16"] == 2 * 8 * 512 * 2
+    assert planes["int32_historical"] == 2 * planes["int16"]
 
 
 def test_mesh_that_does_not_fit_is_skipped():

@@ -1,0 +1,185 @@
+"""Integer counting path: dtype dispatch, exactness, and artifact parity.
+
+The counting helpers (ops/counting.py) must produce IDENTICAL results under
+both operand encodings — bf16+f32 and int8+s32 — because every consumer
+(graph stats, clustering affinities, postprocess claim kernels, AP
+intersections) compares or ratios the counts against thresholds, and a
+single ULP of difference would flip an artifact byte. These tests pin:
+
+- helper-level exactness vs int64 numpy for random 0/1 operands;
+- the overflow guard: the honest bench bucket's worst-case counts
+  (N = 192k points, F = 256 frames) sit far inside s32 accumulation AND
+  inside f32's 2^24 exact-integer range (the out_dtype conversion);
+- scene-artifact byte identity between ``count_dtype="bf16"`` and
+  ``"int8"`` on the single-chip path (device and host postprocess, chunked
+  drain) and on the 8-virtual-device fused mesh path;
+- the int16 first/last claim planes: emit dtype and round-trip through
+  the postprocess consumers.
+
+Wall budget: every scene here is the small shared synthetic shape
+(<= 4 boxes, <= 10 frames) — tier-1 must stay under the 800 s soft budget
+(scripts/ci.sh), so no fresh full-depth scenes.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maskclustering_tpu.config import PipelineConfig
+from maskclustering_tpu.models.pipeline import run_scene
+from maskclustering_tpu.ops import counting
+from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+# the honest bench bucket (bench.py defaults): the worst-case single count
+HONEST_POINTS = 196608
+HONEST_FRAMES = 256
+
+
+def _config(**kw):
+    return PipelineConfig(
+        config_name="synthetic", dataset="demo", backend="cpu",
+        distance_threshold=0.03, step=1, mask_pad_multiple=64,
+        point_chunk=2048, **kw,
+    )
+
+
+@pytest.mark.parametrize("count_dtype", ["bf16", "int8"])
+def test_count_dot_exact_vs_numpy(rng, count_dtype):
+    a = rng.random((33, 70)) < 0.4
+    b = rng.random((70, 41)) < 0.4
+    want = a.astype(np.int64) @ b.astype(np.int64)
+    got = counting.count_dot(jnp.asarray(a), jnp.asarray(b),
+                             count_dtype=count_dtype)
+    assert got.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(got), want.astype(np.float32))
+    raw = counting.count_dot(jnp.asarray(a), jnp.asarray(b),
+                             count_dtype=count_dtype, out_dtype=None)
+    assert raw.dtype == counting.accumulator_dtype(count_dtype)
+    np.testing.assert_array_equal(np.asarray(raw, dtype=np.int64), want)
+
+
+@pytest.mark.parametrize("count_dtype", ["bf16", "int8"])
+def test_count_dot_general_batched_exact(rng, count_dtype):
+    # the node-stats kernel's shape: contract over (batch, k) at once
+    w = (rng.random((4, 6, 5)) < 0.5)
+    m = (rng.random((4, 5, 7)) < 0.5)
+    want = np.einsum("cik,ckn->in", w.astype(np.int64), m.astype(np.int64))
+    got = counting.count_dot_general(
+        jnp.asarray(w), jnp.asarray(m), (((0, 2), (0, 1)), ((), ())),
+        count_dtype=count_dtype)
+    np.testing.assert_array_equal(np.asarray(got), want.astype(np.float32))
+
+
+@pytest.mark.parametrize("count_dtype", ["bf16", "int8"])
+def test_count_onehot_dtype_and_drop(count_dtype):
+    ids = jnp.asarray([0, 2, -1, 5], jnp.int16)  # -1/5: sentinel + overflow
+    oh = counting.count_onehot(ids, 4, count_dtype=count_dtype)
+    assert oh.dtype == counting.operand_dtype(count_dtype)
+    want = np.zeros((4, 4))
+    want[0, 0] = want[1, 2] = 1  # out-of-range rows stay all-zero
+    np.testing.assert_array_equal(np.asarray(oh, dtype=np.float64), want)
+
+
+def test_unknown_count_dtype_rejected():
+    with pytest.raises(ValueError, match="count_dtype"):
+        counting.operand_dtype("fp64")
+    with pytest.raises(ValueError, match="count_dtype"):
+        PipelineConfig(config_name="x", dataset="demo", count_dtype="f32")
+
+
+def test_honest_bucket_counts_within_int32():
+    """Overflow guard for the int8 path at the honest bench bucket.
+
+    Every counting contraction's single-entry maximum is bounded by its
+    contraction depth: co-occurrence / group counts by N (one mask claiming
+    every point), observers / node-stats numerators and denominators by F.
+    Those bounds must sit inside s32 accumulation AND inside f32's exact
+    integer range (counts convert to f32 for the threshold math).
+    """
+    worst = max(HONEST_POINTS, HONEST_FRAMES)
+    assert worst < 2 ** 24  # f32 out_dtype conversion stays exact
+    assert worst * 4 < 2 ** 31  # s32 accumulator headroom, 4x margin
+    # empirical: an all-ones contraction at the honest point depth — the
+    # single worst accumulation the pipeline can produce — is exact
+    ones = jnp.ones((1, HONEST_POINTS), jnp.bool_)
+    got = counting.count_dot(ones, ones.T, count_dtype="int8", out_dtype=None)
+    assert int(np.asarray(got)[0, 0]) == HONEST_POINTS
+    got_f = counting.count_dot(ones, ones.T, count_dtype="int8")
+    assert float(np.asarray(got_f)[0, 0]) == float(HONEST_POINTS)
+
+
+def _assert_objects_equal(a, b, tag):
+    assert len(a.point_ids_list) == len(b.point_ids_list), tag
+    assert a.num_points == b.num_points, tag
+    for pa, pb in zip(a.point_ids_list, b.point_ids_list):
+        np.testing.assert_array_equal(pa, pb, err_msg=tag)
+    assert a.mask_list == b.mask_list, tag
+
+
+def test_scene_artifacts_identical_across_count_dtype():
+    """CPU byte-identity of single-chip scene artifacts, bf16 vs int8 —
+    covering the device postprocess, the chunked int16-plane-era claims
+    drain (claims_pull_chunk=1: adversarial 1-row slices), and the host
+    postprocess path (which pulls the full int16 planes)."""
+    scene = make_scene(num_boxes=4, num_frames=10, seed=21)
+    tensors = to_scene_tensors(scene)
+    base = run_scene(tensors, _config(count_dtype="bf16"), k_max=15)
+    for kw, tag in (
+        (dict(count_dtype="int8"), "int8 device-post"),
+        (dict(count_dtype="int8", claims_pull_chunk=1), "int8 chunked drain"),
+        (dict(count_dtype="int8", device_postprocess=False), "int8 host-post"),
+    ):
+        res = run_scene(tensors, _config(**kw), k_max=15)
+        _assert_objects_equal(base.objects, res.objects, tag)
+        np.testing.assert_array_equal(base.assignment, res.assignment, tag)
+
+
+def test_claim_planes_emit_int16_and_roundtrip():
+    """Association emits int16 first/last planes; values round-trip exactly
+    through the int32 formulation (the planes are ids <= k_max + 1)."""
+    from maskclustering_tpu.models.backprojection import associate_scene_tensors
+
+    scene = make_scene(num_boxes=3, num_frames=6, seed=7)
+    tensors = to_scene_tensors(scene)
+    assoc = associate_scene_tensors(tensors, _config(), k_max=15)
+    assert assoc.first_id.dtype == jnp.int16
+    assert assoc.last_id.dtype == jnp.int16
+    first = np.asarray(assoc.first_id)
+    last = np.asarray(assoc.last_id)
+    # ids are within the int16-safe range and the int32 widening is lossless
+    assert int(last.max(initial=0)) <= 16
+    np.testing.assert_array_equal(first.astype(np.int32).astype(np.int16), first)
+    # boundary/visibility derivations agree with the widened formulation
+    np.testing.assert_array_equal(
+        np.asarray(assoc.boundary), (first.astype(np.int32)
+                                     != last.astype(np.int32)).any(axis=0))
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4)])
+def test_fused_mesh_identical_across_count_dtype(mesh_shape):
+    """The fused multi-chip step compiles BOTH count_dtype variants and
+    their full result bundles match bit-for-bit on an 8-virtual-device
+    mesh (int16 planes included)."""
+    import jax
+
+    from maskclustering_tpu.parallel.mesh import make_mesh
+    from maskclustering_tpu.parallel.sharded import (
+        build_fused_step,
+        fused_step_example_args,
+    )
+
+    cfg = PipelineConfig(config_name="t", dataset="demo",
+                         distance_threshold=0.01, few_points_threshold=25,
+                         point_chunk=256)
+    args = fused_step_example_args(num_scenes=mesh_shape[0] * 2, num_frames=8,
+                                   num_points=4096)
+    mesh = make_mesh(mesh_shape)
+    outs = {}
+    for cd in ("bf16", "int8"):
+        step = build_fused_step(mesh, cfg.replace(count_dtype=cd), k_max=15)
+        outs[cd] = jax.block_until_ready(step(*args))
+    assert outs["bf16"].first_id.dtype == jnp.int16
+    for field in outs["bf16"]._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(outs["bf16"], field)),
+            np.asarray(getattr(outs["int8"], field)), err_msg=field)

@@ -88,6 +88,35 @@ def test_check_regression_thresholds():
     assert not ok
 
 
+def test_bench_row_carries_dtype_attribution():
+    row = led.bench_row(_verdict(3.2, count_dtype="int8",
+                                 plane_dtype="int16"))
+    assert row["count_dtype"] == "int8"
+    assert row["plane_dtype"] == "int16"
+    # rows predating the knob simply lack the keys — no synthesized default
+    assert "count_dtype" not in led.bench_row(_verdict(3.2))
+
+
+def test_check_regression_flags_dtype_flip():
+    """A headline delta coinciding with a count_dtype flip must be called
+    out as knob attribution, not silently read as code drift; rows without
+    the keys compare as the historical defaults (bf16 / int32 planes)."""
+    base = {"value": 1.0, "count_dtype": "bf16", "plane_dtype": "int16"}
+    ok, lines = led.check_regression(
+        {"value": 1.05, "count_dtype": "int8", "plane_dtype": "int16"}, base)
+    assert ok
+    assert any("count_dtype: bf16 -> int8" in ln for ln in lines)
+    assert not any("plane_dtype" in ln for ln in lines)
+    # a pre-knob baseline row (no keys) vs a current int16-plane row
+    ok, lines = led.check_regression({"value": 1.0, "plane_dtype": "int16"},
+                                     {"value": 1.0})
+    assert ok
+    assert any("plane_dtype: int32 -> int16" in ln for ln in lines)
+    # no flip, no noise
+    ok, lines = led.check_regression({"value": 1.0}, {"value": 1.0})
+    assert not any("dtype" in ln for ln in lines)
+
+
 def test_report_regress_exit_codes(tmp_path, capsys):
     """The acceptance gate: injected 15%+ regression -> non-zero exit."""
     baseline = str(tmp_path / "baseline.json")
