@@ -49,13 +49,21 @@ _INIT_FAILED_RCS = (2, 3)
 _INIT_OK_SENTINEL = "[bench-worker] INIT_OK"
 
 
-def _backoff_scale() -> float:
-    """Test knob; a malformed value must not break the one-JSON-line
-    contract mid-supervision, so fall back to 1.0 and never go negative."""
-    try:
-        return max(float(os.environ.get("MCT_BENCH_BACKOFF_SCALE", "1.0")), 0.0)
-    except ValueError:
-        return 1.0
+def _retry_policy(args):
+    """The supervisor's backoff schedule, on the SHARED retry primitive.
+
+    utils/faults.RetryPolicy (stdlib-only: safe in this chip-free process)
+    with the historical linear shape — min(20s * attempt, 120s) — and the
+    MCT_BENCH_BACKOFF_SCALE test knob (malformed values fall back to 1.0,
+    never negative, so a bad knob cannot break the one-JSON-line contract
+    mid-supervision). run.py's scene supervisor uses the same class with
+    the exponential style; one copy of the backoff semantics.
+    """
+    from maskclustering_tpu.utils.faults import RetryPolicy
+
+    return RetryPolicy(attempts=max(args.init_attempts, 1), base_s=20.0,
+                       cap_s=120.0, style="linear",
+                       scale_env="MCT_BENCH_BACKOFF_SCALE")
 
 
 def _metric_name(args) -> str:
@@ -307,6 +315,7 @@ def _supervise(args):
     """
     child_argv = [sys.executable, os.path.abspath(__file__), "--worker"]
     child_argv += [a for a in sys.argv[1:] if a != "--worker"]
+    policy = _retry_policy(args)
     t_start = time.time()
     # single source of truth for BOTH emission paths (the loop tail and the
     # signal handler): shadow locals desynchronize them
@@ -457,7 +466,7 @@ def _supervise(args):
                   f"last failure: {'post-init hang' if post_init_hang else 'backend init'})",
                   file=sys.stderr, flush=True)
             break
-        backoff = min(20.0 * attempt, 120.0) * _backoff_scale()
+        backoff = policy.backoff(attempt)
         if remaining <= backoff:
             # the promised retry could never launch: don't sleep into the wall
             print(f"[bench] giving up: {remaining:.0f}s of budget left "

@@ -115,6 +115,23 @@ class PipelineConfig:
     # copy_to_host_async so unpack overlaps the next chunk's DMA
     claims_pull_chunk: int = 64
 
+    # --- fault tolerance (run.py scene supervisor + utils/faults.py) ---
+    # extra attempts per failed scene beyond the first (0 = fail fast);
+    # only retryable/device error classes retry — terminal errors
+    # (programming/config) never burn the budget
+    scene_retries: int = 2
+    # base of the exponential per-round retry backoff (doubles per round,
+    # capped at 8x base by the supervisor's RetryPolicy)
+    retry_backoff_s: float = 0.25
+    # watchdog budgets (seconds; 0 = disabled, the default — no threads,
+    # no overhead). Armed, a phase that exceeds its budget raises a typed
+    # DeviceStallError in the scene loop (retried + degraded per the
+    # ladder) instead of wedging the run; size them ~5-10x the healthy
+    # phase wall (README "Surviving a wedged chip")
+    watchdog_load_s: float = 0.0
+    watchdog_device_s: float = 0.0
+    watchdog_host_s: float = 0.0
+
     # --- paths ---
     data_root: str = "./data"
     cropformer_path: str = ""
@@ -152,6 +169,14 @@ class PipelineConfig:
         if self.claims_pull_chunk < 0:
             raise ValueError(
                 f"claims_pull_chunk must be >= 0, got {self.claims_pull_chunk}")
+        if self.scene_retries < 0:
+            raise ValueError(
+                f"scene_retries must be >= 0, got {self.scene_retries}")
+        for knob in ("retry_backoff_s", "watchdog_load_s",
+                     "watchdog_device_s", "watchdog_host_s"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"{knob} must be >= 0, got {getattr(self, knob)}")
 
     def replace(self, **kw) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)

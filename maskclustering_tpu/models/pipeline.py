@@ -48,6 +48,7 @@ from maskclustering_tpu.models.graph import (
     observer_schedule_device,
 )
 from maskclustering_tpu.models.postprocess import SceneObjects, export_artifacts
+from maskclustering_tpu.utils import faults
 
 log = logging.getLogger("maskclustering_tpu")
 
@@ -185,6 +186,9 @@ def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
     """
     timings: Dict[str, float] = {}
     tracer = obs.scene_tracer()
+    # fault seam: deterministic injection point for the device phase
+    # (utils/faults.FaultPlan); a no-op without an active plan
+    faults.inject("device", seq_name)
 
     if k_max is None:
         max_id = int(np.max(tensors.segmentations)) if np.size(tensors.segmentations) else 0
@@ -218,7 +222,12 @@ def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
     with tracer.span("graph", scene=seq_name) as sp:
         # host sync 1/2: the compact mask table's M_pad bucket is
         # data-dependent, so the valid table must materialize before the
-        # graph program can be dispatched
+        # graph program can be dispatched. A wedged chip stalls exactly
+        # here (the drain never completes) — the pull is an injection
+        # seam, and its stall bound is the DEVICE-PHASE watchdog the
+        # scene executors arm around run_scene_device (nesting a second
+        # same-budget deadline here would double-count every stall)
+        faults.inject("pull", seq_name)
         mask_valid_host = np.asarray(assoc.mask_valid)
         obs.count("pipeline.host_sync")
         sp.set(host_pull="mask_valid")
@@ -254,7 +263,9 @@ def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
             count_dtype=cfg.count_dtype,
         )
         # host sync 2/2: the assignment vector feeds the host-side live-rep
-        # prep of the post-process
+        # prep of the post-process (same injection seam + device-phase
+        # stall bound as the first pull)
+        faults.inject("pull", seq_name)
         assignment = np.asarray(sp.sync(result.assignment))
         obs.count("pipeline.host_sync")
         sp.set(host_pull="assignment")
@@ -283,6 +294,8 @@ def run_scene_host(handoff: DeviceHandoff, cfg: PipelineConfig, *,
     timings = dict(handoff.timings)
     tracer = obs.scene_tracer()
     seq_name = handoff.seq_name
+    # fault seam: the host tail (claims drain, DBSCAN, merge)
+    faults.inject("host", seq_name)
 
     with tracer.span("postprocess", scene=seq_name) as sp:
         post_timings: Dict[str, float] = {}
@@ -303,6 +316,9 @@ def run_scene_host(handoff: DeviceHandoff, cfg: PipelineConfig, *,
     if export:
         if seq_name is None or object_dict_dir is None:
             raise ValueError("export=True requires seq_name and object_dict_dir")
+        # fault seam: artifact export (atomic tmp+rename, so an injected
+        # failure here can never leave a truncated npz for resume to latch)
+        faults.inject("export", seq_name)
         export_artifacts(objects, seq_name, cfg.config_name, object_dict_dir,
                          prediction_root=prediction_root,
                          top_k_repre=cfg.num_representative_masks)

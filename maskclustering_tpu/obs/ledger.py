@@ -114,6 +114,19 @@ def run_row(report: Dict, **extra) -> Dict:
     stages = digest.get("stages")
     if stages:
         row["stages"] = {k: v.get("p50_s") for k, v in stages.items()}
+    faults = report.get("faults") or {}
+    # fault attribution: a degraded/retried run's headline is the fault's
+    # story, not code drift — stamp it so --regress can say so (keys only
+    # appear when nonzero, keeping clean rows compact)
+    for src, dst in (("scene_retries", "retries"),
+                     ("device_stalls", "device_stalls"),
+                     ("final_rung", "final_rung")):
+        if faults.get(src):
+            row[dst] = faults[src]
+    if faults.get("degradations"):
+        row["degradations"] = sum(faults["degradations"].values())
+    if faults.get("interrupted"):
+        row["interrupted"] = True
     row.update(extra)
     return row
 
@@ -193,6 +206,18 @@ def check_regression(current: Optional[Dict], baseline: Optional[Dict], *,
         if c != b:
             lines.append(f"  {knob}: {b} -> {c} [dtype flip — attribute "
                          f"the delta before blaming code]")
+    # fault attribution: run rows stamp retries/degradations (run.py) — a
+    # degraded run is slower BY DESIGN, so the gate says so before anyone
+    # blames code drift for the fault's wall-clock cost
+    for label, r in (("current", current), ("baseline", baseline)):
+        retries = int(r.get("retries") or 0)
+        degr = int(r.get("degradations") or 0)
+        if retries or degr:
+            lines.append(
+                f"  {label} run recorded {retries} scene retr"
+                f"{'y' if retries == 1 else 'ies'} and {degr} "
+                f"degradation(s) [fault attribution — the delta may be "
+                f"the fault's, not code drift]")
     cur_stages = current.get("stages") or {}
     base_stages = baseline.get("stages") or {}
     for k in sorted(set(cur_stages) & set(base_stages)):

@@ -160,7 +160,7 @@ class RunData:
             "d2h_bytes": self._counters.get("d2h.bytes"),
             "counters": {k: v for k, v in sorted(self._counters.items())
                          if k.startswith(("run.", "bench.", "compile_cache.",
-                                          "pipeline."))},
+                                          "pipeline.", "faults."))},
         }
         ov = self.overlap()
         if ov is not None:
@@ -199,6 +199,39 @@ def _render(headers: List[str], rows: List[List[str]]) -> str:
     return "\n".join(lines)
 
 
+def render_faults(counters: Dict[str, float]) -> Optional[str]:
+    """The Faults section: retry/stall/degradation/injection accounting.
+
+    Rendered only when the run recorded any fault activity — a clean run's
+    report stays exactly as it was. Sources are the supervisor counters
+    (run.scene_retries / run.device_stalls / run.journal_skips), the
+    degradation ladder (run.degradations.<rung>) and the deterministic
+    fault-injection harness (faults.injected.<seam>).
+    """
+    retries = int(counters.get("run.scene_retries", 0))
+    stalls = int(counters.get("run.device_stalls", 0))
+    skips = int(counters.get("run.journal_skips", 0))
+    failed = int(counters.get("run.scenes_failed", 0))
+    degr = {k[len("run.degradations."):]: int(v)
+            for k, v in sorted(counters.items())
+            if k.startswith("run.degradations.")}
+    inj = {k[len("faults.injected."):]: int(v)
+           for k, v in sorted(counters.items())
+           if k.startswith("faults.injected.")}
+    if not (retries or stalls or skips or degr or inj):
+        return None
+    lines = ["== faults ==",
+             f"scene retries {retries} | device stalls {stalls} | "
+             f"journal skips {skips} | scenes failed {failed}"]
+    if degr:
+        lines.append("degradations: " + ", ".join(
+            f"{name} x{n}" for name, n in degr.items()))
+    if inj:
+        lines.append("injected (fault plan): " + ", ".join(
+            f"{seam} x{n}" for seam, n in inj.items()))
+    return "\n".join(lines)
+
+
 def render_report(run: RunData) -> str:
     rows = [[r["stage"], str(r["count"]), _fmt_s(r["p50_s"]), _fmt_s(r["p95_s"]),
              _fmt_s(r["device_p50_s"]), _fmt_s(r["host_p50_s"]),
@@ -233,6 +266,9 @@ def render_report(run: RunData) -> str:
             f"{k.split('.', 1)[1]}={int(v)}" for k, v in sorted(hits.items())))
     if tail:
         out.append(" | ".join(tail))
+    faults_sec = render_faults(run._counters)
+    if faults_sec:
+        out.append(faults_sec)
     return "\n".join(out)
 
 

@@ -35,6 +35,7 @@ from maskclustering_tpu import obs
 from maskclustering_tpu.config import PipelineConfig, load_config
 from maskclustering_tpu.datasets import get_dataset
 from maskclustering_tpu.semantics.vocab import vocab_name
+from maskclustering_tpu.utils import faults
 
 log = logging.getLogger("maskclustering_tpu")
 
@@ -61,13 +62,21 @@ _DATASET_LAYOUT = {
 @dataclasses.dataclass
 class SceneStatus:
     seq_name: str
-    status: str  # "ok" | "skipped" | "failed"
+    status: str  # "ok" | "skipped" | "failed" | "interrupted"
     seconds: float = 0.0
     error: str = ""
     num_objects: int = -1
     # per-stage wall seconds (associate/graph/cluster/postprocess + post.*),
     # same keys the bench reports — production triage without a re-run
     timings: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # fault attribution (utils/faults.py): how many attempts this scene
+    # took, the degradation-ladder rung it last ran at, and the stable
+    # error class of its last failure ("retryable" | "device" | "terminal";
+    # "" when it never failed). attempts == 0 means the scene never ran
+    # this process (journal-resume skip or interrupted before dispatch).
+    attempts: int = 1
+    degradation_rung: int = 0
+    error_class: str = ""
 
 
 @dataclasses.dataclass
@@ -83,14 +92,24 @@ class RunReport:
     # the events.jsonl path — render/diff it with
     # ``python -m maskclustering_tpu.obs.report <events>``
     obs: Optional[Dict] = None
+    # fault-tolerance digest of the cluster step: scene_retries,
+    # device_stalls, degradations{rung}, final_rung, journal_skips,
+    # interrupted — the ledger stamps it so --regress can attribute a perf
+    # delta to a degraded run instead of code drift
+    faults: Optional[Dict] = None
 
     @property
     def failed(self) -> List[SceneStatus]:
         return [s for s in self.scenes if s.status == "failed"]
 
     @property
+    def interrupted(self) -> List[SceneStatus]:
+        return [s for s in self.scenes if s.status == "interrupted"]
+
+    @property
     def ok(self) -> bool:
-        return not self.failed and not self.step_errors
+        return (not self.failed and not self.step_errors
+                and not self.interrupted)
 
     def save(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -102,6 +121,7 @@ class RunReport:
                 "step_errors": self.step_errors,
                 "clip_checkpoint": self.clip_checkpoint,
                 "obs": self.obs,
+                "faults": self.faults,
             }, f, indent=2)
 
 
@@ -201,6 +221,7 @@ def check_masks(cfg: PipelineConfig, seq_names: Sequence[str],
 def _load_for_cluster(cfg: PipelineConfig, seq_name: str, resume: bool,
                       prediction_root: Optional[str]):
     """(dataset, tensors): the host-IO half of one scene; tensors None = skip."""
+    faults.inject("load", seq_name)  # deterministic fault seam (disk IO)
     prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
     ds = get_dataset(cfg.dataset, seq_name, data_root=cfg.data_root)
     npz_path = os.path.join(prediction_root, cfg.config_name + "_class_agnostic",
@@ -210,39 +231,91 @@ def _load_for_cluster(cfg: PipelineConfig, seq_name: str, resume: bool,
     return ds, ds.load_scene_tensors(cfg.step)
 
 
+class _FaultCtx:
+    """Per-round fault bookkeeping shared by the scene executors.
+
+    Tracks attempt numbers across retry rounds, stamps every SceneStatus
+    with its fault attribution (attempts / degradation rung / error
+    class), and journals attempt + outcome rows as they happen — inside
+    the executors, where a crash can still find them on disk. A default
+    instance (no journal, rung 0) keeps direct executor calls working.
+    """
+
+    def __init__(self, journal: Optional[faults.RunJournal] = None,
+                 rung: int = 0, attempts: Optional[Dict[str, int]] = None):
+        self.journal = journal
+        self.rung = rung
+        self.attempts = attempts if attempts is not None else {}
+
+    def begin(self, seq: str) -> None:
+        self.attempts[seq] = self.attempts.get(seq, 0) + 1
+        if self.journal is not None:
+            self.journal.attempt(seq, self.attempts[seq], self.rung)
+
+    def finish(self, st: SceneStatus) -> SceneStatus:
+        st.attempts = self.attempts.get(st.seq_name, 0)
+        st.degradation_rung = self.rung
+        if self.journal is not None:
+            self.journal.outcome(
+                st.seq_name, st.status, attempt=st.attempts, rung=st.degradation_rung,
+                error_class=st.error_class, error=st.error,
+                seconds=st.seconds, num_objects=st.num_objects)
+        return st
+
+
 def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
                   prediction_root: Optional[str] = None,
-                  _preloaded=None) -> SceneStatus:
-    """Step 2 for one scene: tensors -> run_scene -> npz/object_dict export.
+                  _preloaded=None, _ctx: Optional[_FaultCtx] = None) -> SceneStatus:
+    """Step 2 for one scene: tensors -> device + host phases -> export.
 
     ``_preloaded``: zero-arg callable returning ``(dataset, tensors)`` — the
     prefetching loop passes ``_spawn_load``'s ``resolve`` closure so load
     errors of a prefetched scene re-raise here and are captured as that
-    scene's failure.
+    scene's failure. Each phase (load resolve, device dispatch, host tail)
+    runs under its configured watchdog budget (``cfg.watchdog_*_s``; 0 =
+    inline, no threads): a wedged chip raises ``DeviceStallError`` here
+    within the budget instead of hanging the queue forever.
     """
-    from maskclustering_tpu.models.pipeline import run_scene
+    from maskclustering_tpu.models.pipeline import run_scene_device, run_scene_host
 
     prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
+    ctx = _ctx if _ctx is not None else _FaultCtx()
     t0 = time.perf_counter()
+    ctx.begin(seq_name)
     try:
-        ds, tensors = (_preloaded() if _preloaded is not None
-                       else _load_for_cluster(cfg, seq_name, resume, prediction_root))
+        loader = (_preloaded if _preloaded is not None
+                  else lambda: _load_for_cluster(cfg, seq_name, resume,
+                                                 prediction_root))
+        ds, tensors = faults.call_with_deadline(
+            loader, cfg.watchdog_load_s, seam="load", scene=seq_name)
         if tensors is None:
             obs.count("run.scenes_skipped")
-            return SceneStatus(seq_name, "skipped")
-        result = run_scene(tensors, cfg, seq_name=seq_name, export=True,
-                           object_dict_dir=ds.object_dict_dir,
-                           prediction_root=prediction_root)
+            return ctx.finish(SceneStatus(seq_name, "skipped"))
+        if faults.stop_requested():
+            # SIGTERM landed during the load: journal the scene as
+            # interrupted (in flight, must re-run) rather than dispatching
+            # device work during shutdown
+            return ctx.finish(SceneStatus(seq_name, "interrupted"))
+        handoff = faults.call_with_deadline(
+            lambda: run_scene_device(tensors, cfg, seq_name=seq_name),
+            cfg.watchdog_device_s, seam="device", scene=seq_name)
+        result = faults.call_with_deadline(
+            lambda: run_scene_host(handoff, cfg, export=True,
+                                   object_dict_dir=ds.object_dict_dir,
+                                   prediction_root=prediction_root),
+            cfg.watchdog_host_s, seam="host", scene=seq_name)
         obs.count("run.scenes_ok")
-        return SceneStatus(seq_name, "ok", time.perf_counter() - t0,
-                           num_objects=len(result.objects.point_ids_list),
-                           timings={k: round(v, 4)
-                                    for k, v in result.timings.items()})
-    except Exception:
+        return ctx.finish(SceneStatus(
+            seq_name, "ok", time.perf_counter() - t0,
+            num_objects=len(result.objects.point_ids_list),
+            timings={k: round(v, 4) for k, v in result.timings.items()}))
+    except Exception as e:
         log.exception("scene %s failed", seq_name)
         obs.count("run.scenes_failed")
-        return SceneStatus(seq_name, "failed", time.perf_counter() - t0,
-                           error=traceback.format_exc(limit=20))
+        return ctx.finish(SceneStatus(
+            seq_name, "failed", time.perf_counter() - t0,
+            error=traceback.format_exc(limit=20),
+            error_class=faults.classify_error(e)))
 
 
 def _spawn_load(cfg: PipelineConfig, seq_name: str, resume: bool,
@@ -300,19 +373,31 @@ def _prefetched_loads(cfg: PipelineConfig, seq_names: Sequence[str], resume: boo
 
 
 def _cluster_scenes_sequential(cfg: PipelineConfig, seq_names: Sequence[str], *,
-                               resume: bool = True) -> List[SceneStatus]:
+                               resume: bool = True,
+                               ctx: Optional[_FaultCtx] = None
+                               ) -> List[SceneStatus]:
     """The serialized in-process scene loop (disk prefetch is the only
     overlap). Kept as the bit-for-bit reference order the overlapped
     executor is tested against, and as the ``scene_overlap=false`` path."""
+    ctx = ctx if ctx is not None else _FaultCtx()
+    out: List[SceneStatus] = []
     with obs.span("exec.scene_loop", scenes=len(seq_names), mode="sequential"):
-        return [cluster_scene(cfg, seq, resume=resume, _preloaded=resolve)
-                for seq, resolve in _prefetched_loads(
-                    cfg, seq_names, resume, depth=cfg.prefetch_depth)]
+        for seq, resolve in _prefetched_loads(cfg, seq_names, resume,
+                                              depth=cfg.prefetch_depth):
+            if faults.stop_requested():
+                # journal the un-run tail so the rerun knows these scenes
+                # never started (vs the in-flight one cluster_scene marks)
+                out.append(ctx.finish(SceneStatus(seq, "interrupted")))
+                continue
+            out.append(cluster_scene(cfg, seq, resume=resume,
+                                     _preloaded=resolve, _ctx=ctx))
+    return out
 
 
 def _cluster_scenes_overlapped(cfg: PipelineConfig, seq_names: Sequence[str], *,
                                resume: bool = True,
-                               prediction_root: Optional[str] = None
+                               prediction_root: Optional[str] = None,
+                               ctx: Optional[_FaultCtx] = None
                                ) -> List[SceneStatus]:
     """Step 2, software-pipelined: three overlapped per-scene timelines.
 
@@ -332,46 +417,73 @@ def _cluster_scenes_overlapped(cfg: PipelineConfig, seq_names: Sequence[str], *,
     from maskclustering_tpu.utils.daemon_future import DaemonFuture
 
     pred_root = prediction_root or os.path.join(cfg.data_root, "prediction")
+    ctx = ctx if ctx is not None else _FaultCtx()
     statuses: Dict[str, SceneStatus] = {}
     in_flight = None  # (seq_name, t0, DaemonFuture of the host tail)
 
     def finish(entry) -> None:
-        # (result, error, t_end) were produced INSIDE the worker when the
-        # tail finished: this join may happen a whole device-phase later
-        # (the backpressure point), and charging that wait to the scene —
-        # ok or failed — would roughly double its reported wall vs the
-        # sequential path
+        # (result, error, error_class, t_end) were produced INSIDE the
+        # worker when the tail finished: this join may happen a whole
+        # device-phase later (the backpressure point), and charging that
+        # wait to the scene — ok or failed — would roughly double its
+        # reported wall vs the sequential path. The join itself is a
+        # watchdog seam: a host tail wedged in a claims drain raises
+        # DeviceStallError within cfg.watchdog_host_s and is abandoned on
+        # its daemon thread.
         seq, t0, fut = entry
-        result, err, t_end = fut.result()
+        try:
+            result, err, err_class, t_end = fut.result(
+                cfg.watchdog_host_s if cfg.watchdog_host_s > 0 else None)
+        except TimeoutError:
+            stall = faults.DeviceStallError("host", seq, cfg.watchdog_host_s)
+            obs.count("run.device_stalls")
+            obs.count("run.scenes_failed")
+            log.error("scene %s failed: %s", seq, stall)
+            statuses[seq] = ctx.finish(SceneStatus(
+                seq, "failed", time.perf_counter() - t0, error=str(stall),
+                error_class="device"))
+            return
         if err is not None:
             log.error("scene %s failed\n%s", seq, err)
             obs.count("run.scenes_failed")
-            statuses[seq] = SceneStatus(seq, "failed", t_end - t0, error=err)
+            statuses[seq] = ctx.finish(SceneStatus(
+                seq, "failed", t_end - t0, error=err, error_class=err_class))
             return
         obs.count("run.scenes_ok")
-        statuses[seq] = SceneStatus(
+        statuses[seq] = ctx.finish(SceneStatus(
             seq, "ok", t_end - t0,
             num_objects=len(result.objects.point_ids_list),
-            timings={k: round(v, 4) for k, v in result.timings.items()})
+            timings={k: round(v, 4) for k, v in result.timings.items()}))
 
     with obs.span("exec.scene_loop", scenes=len(seq_names), mode="overlapped"):
         for seq, resolve in _prefetched_loads(cfg, seq_names, resume,
                                               depth=cfg.prefetch_depth):
+            if faults.stop_requested():
+                statuses[seq] = ctx.finish(SceneStatus(seq, "interrupted"))
+                continue
             t0 = time.perf_counter()
+            ctx.begin(seq)
             try:
-                ds, tensors = resolve()
+                ds, tensors = faults.call_with_deadline(
+                    resolve, cfg.watchdog_load_s, seam="load", scene=seq)
                 if tensors is None:
                     obs.count("run.scenes_skipped")
-                    statuses[seq] = SceneStatus(seq, "skipped")
+                    statuses[seq] = ctx.finish(SceneStatus(seq, "skipped"))
+                    continue
+                if faults.stop_requested():
+                    statuses[seq] = ctx.finish(SceneStatus(seq, "interrupted"))
                     continue
                 with obs.span("exec.device", scene=seq):
-                    handoff = run_scene_device(tensors, cfg, seq_name=seq)
-            except Exception:
+                    handoff = faults.call_with_deadline(
+                        lambda: run_scene_device(tensors, cfg, seq_name=seq),
+                        cfg.watchdog_device_s, seam="device", scene=seq)
+            except Exception as e:
                 log.exception("scene %s failed", seq)
                 obs.count("run.scenes_failed")
-                statuses[seq] = SceneStatus(seq, "failed",
-                                            time.perf_counter() - t0,
-                                            error=traceback.format_exc(limit=20))
+                statuses[seq] = ctx.finish(SceneStatus(
+                    seq, "failed", time.perf_counter() - t0,
+                    error=traceback.format_exc(limit=20),
+                    error_class=faults.classify_error(e)))
                 continue
             # backpressure OUTSIDE the exec spans: the previous host tail
             # must retire before another handoff goes live, bounding HBM
@@ -386,9 +498,10 @@ def _cluster_scenes_overlapped(cfg: PipelineConfig, seq_names: Sequence[str], *,
                             handoff, cfg, export=True,
                             object_dict_dir=ds.object_dict_dir,
                             prediction_root=pred_root)
-                    return result, None, time.perf_counter()
-                except Exception:
-                    return None, traceback.format_exc(limit=20), time.perf_counter()
+                    return result, None, "", time.perf_counter()
+                except Exception as e:
+                    return (None, traceback.format_exc(limit=20),
+                            faults.classify_error(e), time.perf_counter())
 
             in_flight = (seq, t0, DaemonFuture(host_tail,
                                                name=f"host-tail-{seq}"))
@@ -410,14 +523,18 @@ def _cluster_worker(payload):
 
 def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
                         resume: bool = True,
-                        prediction_root: Optional[str] = None) -> List[SceneStatus]:
+                        prediction_root: Optional[str] = None,
+                        ctx: Optional[_FaultCtx] = None) -> List[SceneStatus]:
     """Step 2 over a device mesh: fused batches -> per-scene artifacts.
 
     Scenes stream through the (scene, frame) mesh in batches of the scene
     axis size; each batch runs the fully-jitted fused step
     (parallel/batch.cluster_scene_batch), then post-process + export write
     the exact artifacts the single-chip path does. Per-scene failures are
-    captured without sinking the batch queue.
+    captured without sinking the batch queue; a batch dispatch that stalls
+    past ``cfg.watchdog_device_s`` fails the whole batch with
+    ``DeviceStallError`` (device-class), which the scene supervisor
+    retries on the single-chip rung of the degradation ladder.
     """
     from maskclustering_tpu.models.postprocess import export_artifacts
     from maskclustering_tpu.parallel.batch import cluster_scene_batch, make_run_mesh
@@ -425,6 +542,7 @@ def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
     prediction_root = prediction_root or os.path.join(cfg.data_root, "prediction")
     mesh = make_run_mesh(cfg)
     s_axis = int(mesh.shape["scene"])
+    ctx = ctx if ctx is not None else _FaultCtx()
     statuses: Dict[str, SceneStatus] = {}
     pending: List[tuple] = []  # (seq, dataset, tensors)
 
@@ -434,43 +552,66 @@ def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
         batch, pending[:] = list(pending), []
         t0 = time.perf_counter()
         try:
-            objects_list = cluster_scene_batch(cfg, mesh, [b[2] for b in batch])
-        except Exception:
+            def dispatch_batch():
+                # injection INSIDE the guarded call: a scripted stall then
+                # surfaces as DeviceStallError through the watchdog (the
+                # same conversion the single-chip path gets via
+                # run_scene_device) instead of sleeping the supervisor
+                for seq, _, _ in batch:
+                    faults.inject("device", seq)
+                return cluster_scene_batch(cfg, mesh, [b[2] for b in batch])
+
+            objects_list = faults.call_with_deadline(
+                dispatch_batch, cfg.watchdog_device_s, seam="device",
+                scene=",".join(b[0] for b in batch))
+        except Exception as e:
             log.exception("mesh batch %s failed", [b[0] for b in batch])
             err = traceback.format_exc(limit=20)
+            err_class = faults.classify_error(e)
             obs.count("run.scenes_failed", len(batch))
             for seq, _, _ in batch:
-                statuses[seq] = SceneStatus(seq, "failed", time.perf_counter() - t0,
-                                            error=err)
+                statuses[seq] = ctx.finish(SceneStatus(
+                    seq, "failed", time.perf_counter() - t0, error=err,
+                    error_class=err_class))
             return
         per_scene = (time.perf_counter() - t0) / len(batch)
         for (seq, ds, _), objects in zip(batch, objects_list):
             try:
+                faults.inject("export", seq)
                 export_artifacts(objects, seq, cfg.config_name, ds.object_dict_dir,
                                  prediction_root=prediction_root,
                                  top_k_repre=cfg.num_representative_masks)
                 obs.count("run.scenes_ok")
-                statuses[seq] = SceneStatus(seq, "ok", per_scene,
-                                            num_objects=len(objects.point_ids_list))
-            except Exception:
+                statuses[seq] = ctx.finish(SceneStatus(
+                    seq, "ok", per_scene,
+                    num_objects=len(objects.point_ids_list)))
+            except Exception as e:
                 log.exception("scene %s export failed", seq)
                 obs.count("run.scenes_failed")
-                statuses[seq] = SceneStatus(seq, "failed", per_scene,
-                                            error=traceback.format_exc(limit=20))
+                statuses[seq] = ctx.finish(SceneStatus(
+                    seq, "failed", per_scene,
+                    error=traceback.format_exc(limit=20),
+                    error_class=faults.classify_error(e)))
 
     # lookahead prefetch: the next scenes' disk loads overlap the current
     # batch's device compute in flush() (_prefetched_loads)
     for seq, resolve in _prefetched_loads(cfg, seq_names, resume, prediction_root,
                                           depth=cfg.prefetch_depth):
+        if faults.stop_requested():
+            statuses[seq] = ctx.finish(SceneStatus(seq, "interrupted"))
+            continue
+        ctx.begin(seq)
         try:
-            ds, tensors = resolve()
-        except Exception:
+            ds, tensors = faults.call_with_deadline(
+                resolve, cfg.watchdog_load_s, seam="load", scene=seq)
+        except Exception as e:
             log.exception("scene %s failed to load", seq)
-            statuses[seq] = SceneStatus(seq, "failed",
-                                        error=traceback.format_exc(limit=20))
+            statuses[seq] = ctx.finish(SceneStatus(
+                seq, "failed", error=traceback.format_exc(limit=20),
+                error_class=faults.classify_error(e)))
             continue
         if tensors is None:
-            statuses[seq] = SceneStatus(seq, "skipped")
+            statuses[seq] = ctx.finish(SceneStatus(seq, "skipped"))
             continue
         pending.append((seq, ds, tensors))
         if len(pending) == s_axis:
@@ -479,9 +620,10 @@ def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
     return [statuses[s] for s in seq_names if s in statuses]
 
 
-def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
-                   workers: int = 1, resume: bool = True) -> List[SceneStatus]:
-    """Step 2: the scene work queue.
+def _dispatch_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
+                     workers: int, resume: bool,
+                     ctx: _FaultCtx) -> List[SceneStatus]:
+    """One executor pass over ``seq_names`` at the CURRENT ladder rung.
 
     ``cfg.mesh_shape`` set routes through the fused multi-chip path
     (cluster_scenes_mesh). Otherwise ``workers == 1`` runs in-process (the
@@ -492,21 +634,146 @@ def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
     mirroring run.py:33-45 without os.system.
     """
     if cfg.mesh_shape:
-        return cluster_scenes_mesh(cfg, seq_names, resume=resume)
+        return cluster_scenes_mesh(cfg, seq_names, resume=resume, ctx=ctx)
     if workers <= 1:
         if cfg.scene_overlap and len(seq_names) > 1:
-            return _cluster_scenes_overlapped(cfg, seq_names, resume=resume)
-        return _cluster_scenes_sequential(cfg, seq_names, resume=resume)
+            return _cluster_scenes_overlapped(cfg, seq_names, resume=resume,
+                                              ctx=ctx)
+        return _cluster_scenes_sequential(cfg, seq_names, resume=resume,
+                                          ctx=ctx)
     import multiprocessing as mp
 
     shards = [list(seq_names[i::workers]) for i in range(workers)]
     payloads = [(cfg, shard, resume) for shard in shards if shard]
-    ctx = mp.get_context("spawn")  # fork is unsafe once jax owns the TPU
-    with ctx.Pool(len(payloads)) as pool:
+    mp_ctx = mp.get_context("spawn")  # fork is unsafe once jax owns the TPU
+    with mp_ctx.Pool(len(payloads)) as pool:
         out = pool.map(_cluster_worker, payloads)
     statuses = [s for chunk in out for s in chunk]
     order = {name: i for i, name in enumerate(seq_names)}
-    return sorted(statuses, key=lambda s: order[s.seq_name])
+    statuses = sorted(statuses, key=lambda s: order[s.seq_name])
+    for st in statuses:
+        # child processes carry no journal/attempt state; the parent
+        # stamps + journals their outcomes after the fact (coarser than
+        # the in-process executors, but the resume semantics hold)
+        ctx.begin(st.seq_name)
+        ctx.finish(st)
+    return statuses
+
+
+def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
+                   workers: int = 1, resume: bool = True,
+                   journal: Optional[faults.RunJournal] = None
+                   ) -> List[SceneStatus]:
+    """Step 2: the fault-supervised scene work queue.
+
+    The scene is the fault boundary (the pipeline is embarrassingly
+    scene-parallel): each executor pass captures per-scene failures, and
+    this supervisor then
+
+    - **retries** failed scenes whose error class is not terminal, up to
+      ``cfg.scene_retries`` extra rounds with exponential backoff
+      (``cfg.retry_backoff_s`` base, shared faults.RetryPolicy);
+    - **degrades** one ladder rung per round that saw a device-class
+      failure (overlapped -> sequential, fused mesh -> single chip,
+      donation off, device -> host postprocess) — a sick chip costs
+      throughput, not the batch;
+    - **journal-skips** scenes a ``journal`` (utils/faults.RunJournal)
+      records as already done — exact resume attribution where
+      artifact-exists resume cannot distinguish "done" from "never
+      started";
+    - stops cleanly at scene boundaries when a SIGTERM requested stop
+      (remaining scenes journal as ``interrupted`` and re-run next time).
+    """
+    ladder = faults.DegradationLadder(cfg)
+    policy = faults.RetryPolicy(attempts=cfg.scene_retries + 1,
+                                base_s=cfg.retry_backoff_s,
+                                cap_s=max(cfg.retry_backoff_s * 8.0, 0.0))
+    statuses: Dict[str, SceneStatus] = {}
+    attempts: Dict[str, int] = {}
+    pending = list(seq_names)
+    if journal is not None and resume:
+        done = journal.resume_done()
+        for seq in pending:
+            if seq in done:
+                obs.count("run.journal_skips")
+                st = SceneStatus(seq, "skipped", attempts=0)
+                journal.outcome(seq, "skipped", attempt=0, rung=0)
+                statuses[seq] = st
+        if done:
+            log.info("journal resume: skipping %d already-done scene(s)",
+                     len([s for s in pending if s in done]))
+        pending = [s for s in pending if s not in done]
+    round_no = 1
+    while pending:
+        ctx = _FaultCtx(journal=journal, rung=ladder.rung, attempts=attempts)
+        batch = _dispatch_scenes(ladder.apply(cfg), pending, workers=workers,
+                                 resume=resume, ctx=ctx)
+        retry: List[str] = []
+        saw_device = False
+        for st in batch:
+            statuses[st.seq_name] = st
+            if st.status != "failed":
+                continue
+            saw_device = saw_device or st.error_class == "device"
+            if (st.error_class != "terminal" and round_no <= cfg.scene_retries
+                    and not faults.stop_requested()):
+                retry.append(st.seq_name)
+        if not retry:
+            break
+        if saw_device:
+            # the chip, not the scenes, looks sick: drop one rung before
+            # the retry round so the SAME fault class cannot burn the
+            # whole retry budget at full configuration
+            ladder.degrade(reason=f"device-class failure(s) in round {round_no}")
+        delay = policy.backoff(round_no)
+        obs.count("run.scene_retries", len(retry))
+        log.warning("retrying %d scene(s) in %.2fs (round %d/%d, rung %d%s)",
+                    len(retry), delay, round_no + 1, cfg.scene_retries + 1,
+                    ladder.rung,
+                    f": {'+'.join(ladder.applied_names)}"
+                    if ladder.applied_names else "")
+        if delay > 0:
+            time.sleep(delay)
+        pending = retry
+        round_no += 1
+    return [statuses[s] for s in seq_names if s in statuses]
+
+
+_FAULT_COUNTERS = ("run.scene_retries", "run.device_stalls",
+                   "run.journal_skips")
+
+
+def _fault_counter_snapshot() -> Dict[str, float]:
+    """Relevant obs counters before the cluster step (the registry is
+    process-global and cumulative; the report wants THIS run's deltas)."""
+    counters = obs.registry().snapshot()["counters"]
+    return {k: v for k, v in counters.items()
+            if k in _FAULT_COUNTERS or k.startswith("run.degradations.")}
+
+
+def _fault_summary(before: Dict[str, float],
+                   scenes: Sequence[SceneStatus]) -> Dict:
+    """The run report's fault digest (counter deltas + scene rows)."""
+    counters = obs.registry().snapshot()["counters"]
+
+    def delta(name: str) -> int:
+        return int(counters.get(name, 0.0) - before.get(name, 0.0))
+
+    degradations = {}
+    for k in counters:
+        if k.startswith("run.degradations."):
+            d = delta(k)
+            if d:
+                degradations[k[len("run.degradations."):]] = d
+    return {
+        "scene_retries": delta("run.scene_retries"),
+        "device_stalls": delta("run.device_stalls"),
+        "journal_skips": delta("run.journal_skips"),
+        "degradations": degradations,
+        "final_rung": sum(degradations.values()),
+        "interrupted": (faults.stop_requested()
+                        or any(s.status == "interrupted" for s in scenes)),
+    }
 
 
 def evaluate_step(cfg: PipelineConfig, *, no_class: bool,
@@ -688,6 +955,8 @@ def run_pipeline(
     xprof_dir: Optional[str] = None,
     ledger_path: Optional[str] = None,
     ledger: bool = True,
+    journal_path: Optional[str] = None,
+    journal: bool = True,
 ) -> RunReport:
     unknown = set(steps) - set(ALL_STEPS)
     if unknown:
@@ -717,7 +986,8 @@ def run_pipeline(
                 encoder_spec=encoder_spec, mask_command=mask_command,
                 mask_predictor=mask_predictor, profile_dir=profile_dir,
                 report_path=report_path, obs_events=obs_events,
-                ledger_path=ledger_path, ledger=ledger)
+                ledger_path=ledger_path, ledger=ledger,
+                journal_path=journal_path, journal=journal)
         finally:
             # a step/encoder exception must not leave the global tracer
             # armed (fences on, sink open) for the rest of the process —
@@ -728,7 +998,8 @@ def run_pipeline(
         encoder_spec=encoder_spec, mask_command=mask_command,
         mask_predictor=mask_predictor, profile_dir=profile_dir,
         report_path=report_path, obs_events=None,
-        ledger_path=ledger_path, ledger=ledger)
+        ledger_path=ledger_path, ledger=ledger,
+        journal_path=journal_path, journal=journal)
 
 
 def _run_pipeline_body(
@@ -746,6 +1017,8 @@ def _run_pipeline_body(
     obs_events: Optional[str],
     ledger_path: Optional[str] = None,
     ledger: bool = True,
+    journal_path: Optional[str] = None,
+    journal: bool = True,
 ) -> RunReport:
     from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
 
@@ -795,16 +1068,36 @@ def _run_pipeline_body(
             seq_names = [s for s in seq_names if s not in set(missing)]
 
     if "cluster" in steps:
+        jr = None
+        if journal:
+            jp = journal_path
+            if jp is None and report_path:
+                # the crash-safe scene journal lives next to the report it
+                # backs; a crash that eats report.json still leaves exact
+                # per-scene attribution here (faults.replay_journal)
+                jp = os.path.join(os.path.dirname(report_path) or ".",
+                                  "run_journal.jsonl")
+            if jp:
+                jr = faults.RunJournal(jp, cfg.config_name)
+                jr.begin_run()
+        fault_snap = _fault_counter_snapshot()
         if trace_ctx is not None:
             trace_ctx.__enter__()
         try:
             report.scenes = timed("cluster", lambda: cluster_scenes(
-                cfg, seq_names, workers=workers, resume=resume)) or []
+                cfg, seq_names, workers=workers, resume=resume,
+                journal=jr)) or []
         finally:
             if trace_ctx is not None:
                 trace_ctx.__exit__(None, None, None)
+            report.faults = _fault_summary(fault_snap, report.scenes)
+            if jr is not None:
+                jr.end_run(interrupted=report.faults["interrupted"])
+                jr.close()
         ok = sum(1 for s in report.scenes if s.status != "failed")
         log.info("clustered %d/%d scenes", ok, len(report.scenes))
+        if report.faults["scene_retries"] or report.faults["degradations"]:
+            log.warning("fault summary: %s", report.faults)
 
     if "eval_ca" in steps:
         timed("eval_ca", lambda: evaluate_step(cfg, no_class=True,
@@ -855,7 +1148,8 @@ def _run_pipeline_body(
                 led.run_row({"config_name": report.config_name,
                              "scenes": [dataclasses.asdict(s)
                                         for s in report.scenes],
-                             "obs": report.obs},
+                             "obs": report.obs,
+                             "faults": report.faults},
                             # dtype attribution, same keys as bench rows:
                             # --regress flags flips instead of blaming code
                             count_dtype=cfg.count_dtype,
@@ -928,6 +1222,29 @@ def main(argv=None) -> int:
                              "(default: PERF_LEDGER.jsonl / $MCT_PERF_LEDGER)")
     parser.add_argument("--no-ledger", action="store_true",
                         help="do not append this run to the perf ledger")
+    parser.add_argument("--journal", default=None,
+                        help="crash-safe scene journal JSONL (default: "
+                             "run_journal.jsonl next to --report); reruns "
+                             "skip journaled-done scenes and re-run "
+                             "in-flight ones")
+    parser.add_argument("--no-journal", action="store_true",
+                        help="disable the scene journal (artifact-exists "
+                             "resume only)")
+    parser.add_argument("--scene-retries", type=int, default=None,
+                        help="extra attempts per failed scene (default: "
+                             "config scene_retries, normally 2; 0 = fail "
+                             "fast)")
+    parser.add_argument("--watchdog-device", type=float, default=None,
+                        help="device-phase watchdog budget in seconds (0 "
+                             "= off, the default): a dispatch or host "
+                             "pull exceeding it raises DeviceStallError "
+                             "and the scene retries/degrades instead of "
+                             "wedging the run")
+    parser.add_argument("--fault-plan", default=None,
+                        help="deterministic fault injection spec (e.g. "
+                             "'load:scene2, stall:scene4.device, "
+                             "flaky:scene5:2'; default: $MCT_FAULT_PLAN). "
+                             "Testing/drill knob — never set in production")
     parser.add_argument("--data_root", default=None,
                         help="override the config's data root")
     parser.add_argument("--init_timeout", type=float, default=120.0,
@@ -942,7 +1259,18 @@ def main(argv=None) -> int:
         overrides["prefetch_depth"] = args.prefetch_depth
     if args.no_overlap:
         overrides["scene_overlap"] = False
+    if args.scene_retries is not None:
+        overrides["scene_retries"] = args.scene_retries
+    if args.watchdog_device is not None:
+        overrides["watchdog_device_s"] = args.watchdog_device
     cfg = load_config(args.config, **overrides)
+    if args.fault_plan:
+        faults.set_plan(faults.FaultPlan.from_spec(args.fault_plan))
+    # SIGTERM-safe shutdown: the scene loops stop at the next scene
+    # boundary, in-flight scenes journal as interrupted, and a valid
+    # partial run_report.json still lands — the same contract bench.py's
+    # supervisor keeps for its one-JSON-line stdout
+    faults.install_sigterm_handler()
     init_backend_or_die(args.init_timeout,
                         platform="cpu" if cfg.backend == "cpu" else None)
     seq_names = get_seq_name_list(cfg.dataset, args.splits_dir, args.seq_name_list)
@@ -982,10 +1310,17 @@ def main(argv=None) -> int:
         xprof_dir=args.xprof_dir,
         ledger_path=args.ledger,
         ledger=not args.no_ledger,
+        journal_path=args.journal,
+        journal=not args.no_journal,
     )
     total = time.time() - t0
     log.info("total time %.1f min (%.1f s/scene)", total / 60,
              total / max(len(seq_names), 1))
+    if report.interrupted or faults.stop_requested():
+        # SIGTERM convention (128 + 15): the run stopped cleanly with a
+        # valid partial report + journal; rerun with the same --report to
+        # resume from the journal
+        return 143
     return 0 if report.ok else 1
 
 

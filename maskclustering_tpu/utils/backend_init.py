@@ -66,3 +66,39 @@ def init_backend(platform: Optional[str] = None, timeout_s: float = 120.0,
         timer.cancel()
     _info(f"backend up: {len(devices)}x {devices[0].device_kind}")
     return devices
+
+
+def _main(argv=None) -> int:
+    """Health probe CLI: ``python -m maskclustering_tpu.utils.backend_init``.
+
+    Exit 0 = backend up (one line on stdout), exit 3 = init timed out
+    (the watchdog's os._exit), exit 2 = init raised. chip_session.sh's
+    wait-for-healthy preflight loops on this probe so a capture session
+    arms itself and fires the moment a healthy window opens, instead of
+    failing fast into a wedged chip.
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m maskclustering_tpu.utils.backend_init",
+        description="probe jax backend health under a watchdog")
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="seconds before a hung init exits 3 (60 cleanly "
+                        "separates 'no usable chip' from a healthy init)")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu) before init")
+    args = p.parse_args(argv)
+    try:
+        devices = init_backend(args.platform, timeout_s=args.timeout,
+                               tag="probe")
+    except Exception as e:  # noqa: BLE001 — one-line diagnosis, nonzero exit
+        print(f"[probe] backend init failed: {type(e).__name__}: "
+              f"{str(e).splitlines()[0] if str(e) else e}",
+              file=sys.stderr, flush=True)
+        return 2
+    print(f"healthy: {len(devices)}x {devices[0].device_kind}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

@@ -35,8 +35,17 @@ class DaemonFuture:
 
         threading.Thread(target=work, daemon=True, name=name).start()
 
-    def result(self):
-        self._done.wait()
+    def result(self, timeout: Optional[float] = None):
+        """Block for the value (re-raising the worker's error).
+
+        ``timeout`` (seconds) raises ``TimeoutError`` when the worker has
+        not finished in time — the fault layer's host-tail watchdog turns
+        that into a typed ``DeviceStallError`` and abandons this thread
+        (daemon: it can never stall shutdown).
+        """
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"daemon future did not finish within {timeout}s")
         if self._exc is not None:
             raise self._exc
         return self._value

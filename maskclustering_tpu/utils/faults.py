@@ -1,0 +1,662 @@
+"""Fault-tolerance primitives: watchdogs, retries, degradation, journal.
+
+The pipeline is embarrassingly scene-parallel (each scene's mask graph is
+built and clustered independently, arXiv:2401.07745 §3), which makes the
+SCENE the natural fault boundary: a transient device fault should cost one
+scene-retry, not a run. Before this module the runtime only survived
+faults at process *startup* (utils/backend_init.py); a wedged chip mid-run
+— a device dispatch that never completes, a stuck device->host drain —
+hung the whole run forever (VERDICT round 5: a 17+ hour outage produced a
+third consecutive null bench). This module is the in-run half:
+
+- **watchdogs** (`call_with_deadline`, `Heartbeat`): a bounded wait around
+  any device-phase dispatch / host pull / prefetch resolve; on expiry a
+  typed ``DeviceStallError`` is raised in the CALLER and the wedged work
+  is abandoned on its daemon thread (a hung native call cannot be
+  interrupted — only outwaited — so the watchdog moves the wait, not the
+  work);
+- **retry + degradation** (`RetryPolicy`, `DegradationLadder`): failed
+  scenes retry with backoff (``cfg.scene_retries``/``cfg.retry_backoff_s``),
+  and repeated device-class failures degrade the run along an explicit,
+  logged ladder (overlapped -> sequential executor, fused mesh -> single
+  chip, donation off, device -> host postprocess) instead of failing the
+  batch. bench.py's supervisor shares ``RetryPolicy`` (linear style) so
+  the backoff semantics cannot silently diverge;
+- **crash-safe run journal** (`RunJournal`): an append-only,
+  schema-versioned JSONL of scene attempt/outcome/degradation-rung rows
+  (the obs/events.py sink + torn-line read policy), giving mid-run resume
+  with exact attribution — artifact-exists resume cannot distinguish
+  "done" from "never started" for non-exporting steps;
+- **deterministic fault injection** (`FaultPlan`): seam-level fault
+  scripts (``MCT_FAULT_PLAN="load:scene2, stall:scene4.device,
+  flaky:scene5:2"``) so every watchdog, retry, degradation rung and
+  journal-resume path is exercised deterministically on CPU in tier-1,
+  not argued from the next outage.
+
+This module imports nothing heavier than the stdlib at module scope (obs
+metrics are imported lazily per call) so bench.py's chip-free supervisor
+can use ``RetryPolicy`` without pulling jax pre-watchdog.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Set
+
+log = logging.getLogger("maskclustering_tpu")
+
+# seams a FaultPlan can target; these are the places run.py / models/
+# pipeline.py call inject() (see ARCHITECTURE.md §Fault tolerance)
+SEAMS = ("load", "device", "host", "export", "pull")
+
+# error_class vocabulary stamped on SceneStatus / journal rows:
+#   retryable — transient by default (IO, unknown runtime errors)
+#   device    — retryable AND drives the degradation ladder (stalls,
+#               XLA runtime/OOM errors: the chip, not the scene, is sick)
+#   terminal  — a retry cannot help (programming/config errors)
+ERROR_CLASSES = ("retryable", "device", "terminal")
+
+
+def _count(name: str, delta: float = 1.0) -> None:
+    """obs counter bump; lazy import keeps this module stdlib-only."""
+    try:
+        from maskclustering_tpu.obs import metrics
+
+        metrics.count(name, delta)
+    except Exception:  # noqa: BLE001 — accounting must never fault the fault layer
+        pass
+
+
+# ---------------------------------------------------------------------------
+# typed errors + classification
+# ---------------------------------------------------------------------------
+
+
+class DeviceStallError(RuntimeError):
+    """A watchdog deadline expired: the guarded call never returned.
+
+    Raised in the CALLING thread; the stalled work is abandoned on its
+    daemon thread (it cannot be cancelled, only outwaited). Carries the
+    seam/scene/budget so retry and degradation decisions — and the run
+    journal — get exact attribution.
+    """
+
+    def __init__(self, seam: str, scene: Optional[str], budget_s: float):
+        self.seam = seam
+        self.scene = scene
+        self.budget_s = budget_s
+        super().__init__(
+            f"{seam} phase of scene {scene!r} did not finish within "
+            f"{budget_s:.3g}s (device stalled or wedged)")
+
+
+class InjectedFault(RuntimeError):
+    """A FaultPlan-scripted failure; ``retryable`` steers classification."""
+
+    def __init__(self, msg: str, *, retryable: bool = True):
+        self.retryable = retryable
+        super().__init__(msg)
+
+
+# exception type names that mean "the device/runtime is sick" without
+# importing jaxlib here (the names are stable across jaxlib versions)
+_DEVICE_ERROR_NAMES = frozenset({
+    "XlaRuntimeError", "DeadlineExceeded", "UnavailableError",
+    "InternalError", "ResourceExhaustedError",
+})
+# a retry cannot fix a programming/config error; fail fast and keep the
+# retry budget for faults that can actually heal
+_TERMINAL_TYPES = (ValueError, TypeError, KeyError, IndexError,
+                   AttributeError, AssertionError, NotImplementedError,
+                   ImportError)
+
+
+def classify_error(exc: BaseException) -> str:
+    """Stable error class for retry/degradation decisions (ERROR_CLASSES)."""
+    if isinstance(exc, DeviceStallError):
+        return "device"
+    if isinstance(exc, InjectedFault):
+        return "retryable" if exc.retryable else "terminal"
+    if isinstance(exc, MemoryError) or type(exc).__name__ in _DEVICE_ERROR_NAMES:
+        return "device"
+    if isinstance(exc, _TERMINAL_TYPES):
+        return "terminal"
+    return "retryable"  # OSError and unknown runtime errors: worth one more try
+
+
+# ---------------------------------------------------------------------------
+# watchdogs
+# ---------------------------------------------------------------------------
+
+
+def call_with_deadline(fn: Callable, budget_s: float, *, seam: str = "device",
+                       scene: Optional[str] = None):
+    """Run ``fn`` under a watchdog; ``DeviceStallError`` after ``budget_s``.
+
+    ``budget_s <= 0`` (the production default) calls inline — zero threads,
+    zero overhead. Armed, ``fn`` runs on a daemon thread and this thread
+    waits at most ``budget_s``: a wedged device dispatch or host pull then
+    costs one bounded wait instead of the rest of the run. The abandoned
+    thread keeps blocking in native code but — being a daemon — can never
+    stall process shutdown. ``fn``'s own exception re-raises here so
+    failures attribute to the calling scene.
+    """
+    if not budget_s or budget_s <= 0:
+        return fn()
+    box: Dict[str, object] = {}
+    done = threading.Event()
+
+    def work():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["error"] = e
+        finally:
+            done.set()
+
+    threading.Thread(target=work, daemon=True,
+                     name=f"watchdog-{seam}-{scene}").start()
+    if not done.wait(budget_s):
+        _count("run.device_stalls")
+        raise DeviceStallError(seam, scene, budget_s)
+    if "error" in box:
+        raise box["error"]  # type: ignore[misc]
+    return box["value"]
+
+
+class Heartbeat:
+    """A deadline that re-arms on progress (long multi-step loops).
+
+    ``beat()`` marks liveness; ``check()`` raises ``DeviceStallError``
+    when no beat landed within ``budget_s`` — a loop that is merely SLOW
+    keeps beating and lives, one whose next step never arrives dies
+    within the budget. Thread-safe (the beating worker and the checking
+    supervisor are usually different threads).
+
+    Status: an exported, unit-tested primitive for supervisor loops that
+    can interleave ``check()`` with their own progress. It is NOT wired
+    into the chunked claims drain: the drain blocks inside ``np.asarray``
+    (it cannot self-check mid-chunk), and bounding each chunk with a
+    watchdog thread is the GIL-serialization this backend measured as a
+    regression (postprocess_device.py's drain comment) — the coarse
+    ``watchdog_host_s`` phase deadline bounds the whole drain instead.
+    """
+
+    def __init__(self, budget_s: float, *, seam: str = "device",
+                 scene: Optional[str] = None):
+        self.budget_s = budget_s
+        self.seam = seam
+        self.scene = scene
+        self._lock = threading.Lock()
+        self._last = time.monotonic()
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last = time.monotonic()
+
+    def remaining(self) -> float:
+        with self._lock:
+            return self.budget_s - (time.monotonic() - self._last)
+
+    def expired(self) -> bool:
+        return self.budget_s > 0 and self.remaining() <= 0
+
+    def check(self) -> None:
+        if self.expired():
+            _count("run.device_stalls")
+            raise DeviceStallError(self.seam, self.scene, self.budget_s)
+
+
+# ---------------------------------------------------------------------------
+# retry policy (shared with bench.py's supervisor)
+# ---------------------------------------------------------------------------
+
+
+class RetryPolicy:
+    """Backoff schedule for retry loops; one copy of the semantics.
+
+    ``style="exp"``: ``base * 2**(attempt-1)`` capped at ``cap_s`` — the
+    scene-retry shape. ``style="linear"``: ``base * attempt`` capped —
+    bench.py's historical supervisor shape (20s, 40s, ... cap 120s),
+    preserved exactly so the chip-recovery cadence three rounds of BENCH
+    records were tuned against does not silently change.
+
+    ``scale_env`` names an env var multiplying every delay (tests shrink
+    waits to milliseconds); a malformed value falls back to 1.0 and never
+    goes negative — a bad knob must not break a retry loop mid-outage.
+    """
+
+    def __init__(self, attempts: int = 3, base_s: float = 0.25,
+                 cap_s: float = 30.0, style: str = "exp",
+                 scale_env: Optional[str] = None):
+        if style not in ("exp", "linear"):
+            raise ValueError(f"unknown backoff style {style!r}")
+        self.attempts = max(int(attempts), 1)
+        self.base_s = max(float(base_s), 0.0)
+        self.cap_s = max(float(cap_s), 0.0)
+        self.style = style
+        self.scale_env = scale_env
+
+    def scale(self) -> float:
+        if not self.scale_env:
+            return 1.0
+        try:
+            return max(float(os.environ.get(self.scale_env, "1.0")), 0.0)
+        except ValueError:
+            return 1.0
+
+    def backoff(self, attempt: int) -> float:
+        """Delay after the ``attempt``-th failure (1-based)."""
+        attempt = max(int(attempt), 1)
+        if self.style == "linear":
+            delay = self.base_s * attempt
+        else:
+            delay = self.base_s * (2.0 ** (attempt - 1))
+        return min(delay, self.cap_s) * self.scale()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+# ---------------------------------------------------------------------------
+
+# (rung name, config overrides, applicability predicate). Ordered most-
+# performant first; each device-class failure round drops ONE rung and the
+# overrides accumulate. Rungs the config already satisfies are skipped at
+# ladder construction (degrading an already-sequential run to "sequential"
+# would burn a rung for nothing).
+_LADDER_RUNGS = (
+    ("sequential-executor", {"scene_overlap": False},
+     lambda cfg: bool(cfg.scene_overlap)),
+    ("single-chip", {"mesh_shape": ()},
+     lambda cfg: bool(cfg.mesh_shape)),
+    ("donation-off", {"donate_buffers": False},
+     lambda cfg: bool(cfg.donate_buffers)),
+    ("host-postprocess", {"device_postprocess": False},
+     lambda cfg: bool(cfg.device_postprocess)),
+)
+
+
+class DegradationLadder:
+    """Run-level graceful degradation on repeated device-class failures.
+
+    Each ``degrade()`` call drops one rung (logged + counted on
+    ``run.degradations.<rung>``); ``apply(cfg)`` returns the config with
+    every dropped rung's overrides merged. The ladder trades throughput
+    for survivability in a fixed, auditable order — the run report and
+    perf ledger stamp the final rung so a degraded run's numbers are
+    attributed to the fault, not to code drift.
+    """
+
+    def __init__(self, cfg):
+        self._rungs = [(name, overrides) for name, overrides, pred
+                       in _LADDER_RUNGS if pred(cfg)]
+        self._applied = 0
+
+    @property
+    def rung(self) -> int:
+        """Rungs dropped so far (0 = full configuration)."""
+        return self._applied
+
+    @property
+    def applied_names(self) -> List[str]:
+        return [name for name, _ in self._rungs[:self._applied]]
+
+    @property
+    def exhausted(self) -> bool:
+        return self._applied >= len(self._rungs)
+
+    def degrade(self, reason: str = "") -> Optional[str]:
+        """Drop one rung; returns its name, or None when exhausted."""
+        if self.exhausted:
+            return None
+        name, _ = self._rungs[self._applied]
+        self._applied += 1
+        _count(f"run.degradations.{name}")
+        log.warning("degrading to rung %d (%s)%s", self._applied, name,
+                    f": {reason}" if reason else "")
+        return name
+
+    def apply(self, cfg):
+        """The config at the current rung (overrides of every dropped rung)."""
+        overrides: Dict[str, object] = {}
+        for _, o in self._rungs[:self._applied]:
+            overrides.update(o)
+        return cfg.replace(**overrides) if overrides else cfg
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault injection
+# ---------------------------------------------------------------------------
+
+
+class _FaultEntry:
+    __slots__ = ("kind", "seam", "scene", "remaining", "lock")
+
+    def __init__(self, kind: str, seam: str, scene: str,
+                 count: Optional[int]):
+        self.kind = kind
+        self.seam = seam
+        self.scene = scene
+        self.remaining = count  # None = every attempt
+        self.lock = threading.Lock()
+
+    def take(self) -> bool:
+        """Consume one firing; False once the count is exhausted."""
+        with self.lock:
+            if self.remaining is None:
+                return True
+            if self.remaining <= 0:
+                return False
+            self.remaining -= 1
+            return True
+
+
+# kind -> (default seam, default count; None = unlimited)
+_KIND_DEFAULTS = {
+    "fail": ("device", None),
+    "load": ("load", None),
+    "flaky": ("device", 1),
+    "stall": ("device", 1),
+    "terminal": ("device", None),
+    "sigterm": ("load", 1),
+}
+
+
+class FaultPlan:
+    """A deterministic, seam-scripted fault schedule.
+
+    Spec grammar (comma-separated entries)::
+
+        KIND:SCENE[.SEAM][:COUNT]
+
+        load:scene2           # scene2's load raises, every attempt
+        stall:scene4.device   # scene4's first device phase hangs (sleep)
+        flaky:scene5:2        # scene5's device phase fails twice, then ok
+        fail:scene3.export:1  # one export failure
+        terminal:scene6       # a non-retryable failure (classification)
+        sigterm:scene1.load   # one real SIGTERM to this process at the seam
+
+    ``stall`` sleeps ``stall_s`` at the seam — under an armed watchdog the
+    caller sees ``DeviceStallError`` within its budget; without one the
+    sleep IS the simulated hang. Counts decrement per firing, so retries
+    see the scripted sequence deterministically (flaky-then-ok, stall-
+    then-heal). Thread-safe: seams fire from prefetch daemons, the
+    dispatch thread and the host-tail worker.
+    """
+
+    def __init__(self, entries: Iterable[_FaultEntry], *,
+                 stall_s: float = 5.0, spec: str = ""):
+        self.entries = list(entries)
+        self.stall_s = float(stall_s)
+        self.spec = spec
+
+    @classmethod
+    def from_spec(cls, spec: str, *, stall_s: Optional[float] = None) -> "FaultPlan":
+        if stall_s is None:
+            try:
+                stall_s = float(os.environ.get("MCT_FAULT_STALL_S", "5.0"))
+            except ValueError:
+                stall_s = 5.0
+        entries = []
+        for raw in spec.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            parts = raw.split(":")
+            if len(parts) not in (2, 3):
+                raise ValueError(f"bad fault entry {raw!r} "
+                                 "(KIND:SCENE[.SEAM][:COUNT])")
+            kind, target = parts[0].strip(), parts[1].strip()
+            if kind not in _KIND_DEFAULTS:
+                raise ValueError(f"unknown fault kind {kind!r} in {raw!r} "
+                                 f"(one of {sorted(_KIND_DEFAULTS)})")
+            seam, count = _KIND_DEFAULTS[kind]
+            if "." in target:
+                scene, _, maybe_seam = target.rpartition(".")
+                if maybe_seam not in SEAMS:
+                    raise ValueError(f"unknown seam {maybe_seam!r} in {raw!r} "
+                                     f"(one of {SEAMS})")
+                target, seam = scene, maybe_seam
+            if len(parts) == 3:
+                count = int(parts[2])
+                if count < 1:
+                    raise ValueError(f"count must be >= 1 in {raw!r}")
+            if not target:
+                raise ValueError(f"empty scene name in {raw!r}")
+            entries.append(_FaultEntry(kind, seam, target, count))
+        return cls(entries, stall_s=stall_s, spec=spec)
+
+    def fire(self, seam: str, scene: Optional[str]) -> None:
+        """Perform every scripted action matching (seam, scene); called by
+        ``inject()`` at the seam sites. Raising entries raise; a ``stall``
+        sleeps; ``sigterm`` signals this very process (exercising the real
+        handler deterministically)."""
+        if scene is None:
+            return
+        for e in self.entries:
+            if e.seam != seam or e.scene != scene or not e.take():
+                continue
+            _count(f"faults.injected.{seam}")
+            log.warning("fault injection: %s at %s seam of scene %s",
+                        e.kind, seam, scene)
+            if e.kind == "stall":
+                time.sleep(self.stall_s)
+            elif e.kind == "sigterm":
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif e.kind == "terminal":
+                raise InjectedFault(
+                    f"injected terminal fault at {seam} seam of {scene}",
+                    retryable=False)
+            else:  # fail / load / flaky
+                raise InjectedFault(
+                    f"injected {e.kind} fault at {seam} seam of {scene}")
+
+
+_PLAN: Optional[FaultPlan] = None
+_PLAN_LOADED = False
+_PLAN_LOCK = threading.Lock()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The process-wide plan: explicit ``set_plan`` wins, else
+    ``$MCT_FAULT_PLAN`` (parsed once)."""
+    global _PLAN, _PLAN_LOADED
+    with _PLAN_LOCK:
+        if not _PLAN_LOADED:
+            spec = os.environ.get("MCT_FAULT_PLAN", "").strip()
+            _PLAN = FaultPlan.from_spec(spec) if spec else None
+            _PLAN_LOADED = True
+        return _PLAN
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear with None) the process-wide plan; overrides env."""
+    global _PLAN, _PLAN_LOADED
+    with _PLAN_LOCK:
+        _PLAN = plan
+        _PLAN_LOADED = True
+
+
+def inject(seam: str, scene: Optional[str]) -> None:
+    """The seam hook: a no-op without an active plan (one dict lookup),
+    else fires the plan's matching entries. Call sites: run.py executors
+    (load/device/export), models/pipeline.py (device/host/export/pull)."""
+    plan = active_plan()
+    if plan is not None:
+        plan.fire(seam, scene)
+
+
+# ---------------------------------------------------------------------------
+# cooperative stop (SIGTERM-safe shutdown)
+# ---------------------------------------------------------------------------
+
+_STOP = threading.Event()
+_STOP_REASON = ""
+
+
+def request_stop(reason: str = "") -> None:
+    global _STOP_REASON
+    if not _STOP.is_set():
+        _STOP_REASON = reason
+        log.warning("stop requested%s: finishing in-flight scenes, "
+                    "journaling the rest", f" ({reason})" if reason else "")
+    _STOP.set()
+
+
+def stop_requested() -> bool:
+    return _STOP.is_set()
+
+
+def stop_reason() -> str:
+    return _STOP_REASON
+
+
+def clear_stop() -> None:
+    global _STOP_REASON
+    _STOP.clear()
+    _STOP_REASON = ""
+
+
+def install_sigterm_handler() -> Callable:
+    """SIGTERM -> cooperative stop; a second SIGTERM force-exits (143).
+
+    The scene loops check ``stop_requested()`` at every scene boundary, so
+    a terminated run journals in-flight scenes and still writes a valid
+    partial run_report.json — the same posture bench.py's supervisor takes
+    for its one-JSON-line contract. Returns the previous handler (callers
+    restore it; tests install/restore around in-process runs).
+    """
+    def _handler(signum, frame):  # noqa: ARG001 — signal API shape
+        if _STOP.is_set():
+            os._exit(143)  # second signal: the polite path already ran
+        request_stop(f"signal {signum}")
+
+    return signal.signal(signal.SIGTERM, _handler)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe run journal
+# ---------------------------------------------------------------------------
+
+# the journal rides the obs event envelope (v/kind/ts/pid + one flush per
+# line) and the shared torn-line read policy — one copy of crash tolerance
+KIND_RUN = "run"
+KIND_SCENE = "scene"
+
+
+class RunJournal:
+    """Append-only scene attempt/outcome journal for one config's runs.
+
+    One line per scene attempt start and per outcome, so a crash (SIGKILL,
+    chip wedge, OOM) leaves exact attribution on disk: ``done`` scenes are
+    skipped on resume, an ``attempt`` with no outcome was in flight and
+    re-runs, scenes never journaled never started. Rows carry the config
+    name — one journal file can serve several configs without cross-talk.
+    Writes go through the obs EventSink (thread-safe, flush per line,
+    never the failure source).
+    """
+
+    def __init__(self, path: str, config_name: str):
+        from maskclustering_tpu.obs.events import EventSink
+
+        self.path = path
+        self.config_name = config_name
+        self._sink = EventSink(path)
+
+    def begin_run(self) -> None:
+        self._sink.emit(KIND_RUN, {"event": "begin",
+                                   "config": self.config_name})
+
+    def end_run(self, *, interrupted: bool = False) -> None:
+        self._sink.emit(KIND_RUN, {"event": "end",
+                                   "config": self.config_name,
+                                   "interrupted": bool(interrupted)})
+
+    def attempt(self, seq: str, attempt: int, rung: int) -> None:
+        self._sink.emit(KIND_SCENE, {"event": "attempt", "seq": seq,
+                                     "attempt": attempt, "rung": rung,
+                                     "config": self.config_name})
+
+    def outcome(self, seq: str, status: str, *, attempt: int = 0,
+                rung: int = 0, error_class: str = "", error: str = "",
+                seconds: float = 0.0, num_objects: int = -1) -> None:
+        payload = {"event": "outcome", "seq": seq, "status": status,
+                   "attempt": attempt, "rung": rung,
+                   "error_class": error_class,
+                   "num_objects": num_objects,
+                   "seconds": round(float(seconds), 4),
+                   "config": self.config_name}
+        if error:
+            # final line only ("ExceptionType: message" in a formatted
+            # traceback): the journal is attribution, not a stack dump
+            payload["error"] = str(error).strip().splitlines()[-1][:200]
+        self._sink.emit(KIND_SCENE, payload)
+
+    def resume_done(self) -> Set[str]:
+        return resume_done(self.path, config=self.config_name)
+
+    def close(self) -> None:
+        self._sink.close()
+
+
+def read_journal(path: str, *, config: Optional[str] = None, stats=None
+                 ) -> List[Dict]:
+    """All journal rows (oldest first), sharing the events torn-line
+    policy; ``config`` filters to one config's rows."""
+    from maskclustering_tpu.obs.events import SCHEMA_VERSION, iter_jsonl_rows
+
+    rows = []
+    for row in iter_jsonl_rows(path, version=SCHEMA_VERSION, stats=stats):
+        if row.get("kind") not in (KIND_RUN, KIND_SCENE):
+            continue
+        if config is not None and row.get("config") != config:
+            continue
+        rows.append(row)
+    return rows
+
+
+def replay_journal(path: str, *, config: Optional[str] = None, stats=None
+                   ) -> Dict[str, Dict]:
+    """Final per-scene state from the journal alone.
+
+    Returns ``{seq: {status, attempts, degradation_rung, error_class,
+    num_objects}}`` — the same fields run_report.json carries per scene,
+    so a report can be REPLAYED from the journal and cross-checked (or
+    reconstructed after a crash that ate the report). A trailing
+    ``attempt`` with no outcome replays as status ``"in-flight"``: that
+    scene was running when the process died and must re-run.
+    """
+    out: Dict[str, Dict] = {}
+    for row in read_journal(path, config=config, stats=stats):
+        if row.get("kind") != KIND_SCENE:
+            continue
+        seq = row.get("seq")
+        if not isinstance(seq, str):
+            continue
+        cur = out.setdefault(seq, {"status": "in-flight", "attempts": 0,
+                                   "degradation_rung": 0, "error_class": "",
+                                   "num_objects": -1})
+        if row.get("event") == "attempt":
+            cur["attempts"] = max(cur["attempts"], int(row.get("attempt", 0)))
+            cur["status"] = "in-flight"
+        elif row.get("event") == "outcome":
+            cur["status"] = row.get("status", "in-flight")
+            cur["attempts"] = max(cur["attempts"], int(row.get("attempt", 0)))
+            cur["degradation_rung"] = int(row.get("rung", 0))
+            cur["error_class"] = row.get("error_class", "")
+            cur["num_objects"] = int(row.get("num_objects", -1))
+    return out
+
+
+def resume_done(path: str, *, config: Optional[str] = None) -> Set[str]:
+    """Scenes whose journal says they need no re-run: final status ``ok``
+    (exported) or ``skipped`` (a previous resume already vouched). Failed,
+    interrupted and in-flight scenes all re-run."""
+    if not os.path.exists(path):
+        return set()
+    return {seq for seq, st in replay_journal(path, config=config).items()
+            if st["status"] in ("ok", "skipped")}

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# CI gate: tier-1 tests + perf-ledger regression check, one command.
+# CI gate: tier-1 tests + fault-matrix smoke + perf regression, one command.
 #
 #   scripts/ci.sh [BASELINE] [LEDGER]
 #
@@ -9,16 +9,22 @@
 #    exceeds 800 s of the 870 s timeout budget (MCT_TIER1_WALL_WARN to
 #    override) — new tests must reuse the small shared synthetic fixtures,
 #    not fresh full-depth scenes, and this is the tripwire that says so
-#    before the hard timeout does;
-# 2. gates the perf ledger's newest headline p50 against BASELINE via
+#    before the hard timeout does (the fault-tolerance tests are counted
+#    by the same --durations table);
+# 2. runs the fault-matrix smoke (scripts/fault_smoke.py): three canned
+#    FaultPlans — flaky-then-ok, device stall + degradation ladder,
+#    persistent load failure + journal replay — through a 2-scene
+#    synthetic CPU run, budgeted under 60 s (MCT_FAULT_SMOKE=0 skips);
+# 3. gates the perf ledger's newest headline p50 against BASELINE via
 #    `python -m maskclustering_tpu.obs.report --regress` (exit 2 on a >15%
 #    regression — override the threshold with MCT_REGRESS_THRESHOLD).
 #
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
-# Exits non-zero on test failures OR a perf regression, so it gates both
-# correctness and the trajectory.
+# Exits non-zero on test failures (1), a fault-matrix failure (3) or a
+# perf regression (2), so it gates correctness, fault tolerance AND the
+# trajectory.
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -43,6 +49,14 @@ if [ "$wall" -gt "$WALL_WARN" ]; then
     # the slowest tests (see the --durations table above) onto the shared
     # small fixtures before the 870 s hard timeout starts eating the run
     echo "ci: WARNING tier-1 wall ${wall}s exceeds the ${WALL_WARN}s soft budget" >&2
+fi
+
+if [ "${MCT_FAULT_SMOKE:-1}" != "0" ]; then
+    echo "== ci: fault-matrix smoke (3 canned FaultPlans, 2-scene CPU run, <60s) =="
+    if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python scripts/fault_smoke.py; then
+        echo "ci: fault-matrix smoke FAILED" >&2
+        rc=3
+    fi
 fi
 
 echo "== ci: perf regression gate ($LEDGER vs $BASELINE, >$THRESHOLD p50) =="

@@ -1,0 +1,121 @@
+"""Fault-matrix smoke: three canned FaultPlans through a 2-scene CPU run.
+
+CI's drill of the fault-tolerance layer (scripts/ci.sh, budgeted < 60 s):
+every path a wedged chip would exercise — retry-after-flaky, watchdog
+stall + degradation ladder, persistent failure + journal replay — runs
+deterministically on CPU against a tiny synthetic layout. The plans:
+
+1. ``flaky:<scene0>:1``          one failure, heals on retry
+2. ``stall:<scene0>.device``     a device stall: DeviceStallError within
+                                 the watchdog budget, one ladder rung
+                                 dropped, heals on the degraded retry
+3. ``load:<scene1>``             a persistent load failure: the scene ends
+                                 failed after the retry budget, the other
+                                 scene is untouched, and the run journal
+                                 replays to the executor's exact verdict
+
+Exit 0 = every expectation held; any assertion prints and exits 1.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# the image preloads the TPU plugin via sitecustomize: the env var is too
+# late, the config flag is not (same dance as tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
+from maskclustering_tpu.config import load_config  # noqa: E402
+from maskclustering_tpu.run import cluster_scenes  # noqa: E402
+from maskclustering_tpu.utils import faults  # noqa: E402
+from maskclustering_tpu.utils.synthetic import (make_scene,  # noqa: E402
+                                                write_scannet_layout)
+
+SCENES = ("scene0000_00", "scene0001_00")
+# ~5-10x the warm tiny-scene device phase (a loaded box spikes phases
+# several-fold; a healthy dispatch must never lose this race), while one
+# stall detection still fits the step's 60 s ci.sh budget
+WATCHDOG_S = 10.0
+
+
+def _cfg(root, name, **kw):
+    return load_config("scannet").replace(
+        data_root=root, config_name=name, step=1, distance_threshold=0.05,
+        mask_pad_multiple=32, frame_pad_multiple=4, point_chunk=2048,
+        retry_backoff_s=0.01, **kw)
+
+
+def _run(root, name, plan_spec, **cfg_kw):
+    faults.set_plan(faults.FaultPlan.from_spec(plan_spec, stall_s=60.0)
+                    if plan_spec else None)
+    try:
+        t0 = time.perf_counter()
+        out = cluster_scenes(_cfg(root, name, **cfg_kw), list(SCENES),
+                             resume=False,
+                             journal=faults.RunJournal(
+                                 os.path.join(root, f"{name}_journal.jsonl"),
+                                 name))
+        print(f"[fault_smoke] {name}: "
+              f"{[(s.seq_name, s.status, s.attempts, s.degradation_rung) for s in out]} "
+              f"({time.perf_counter() - t0:.1f}s)", flush=True)
+        return out
+    finally:
+        faults.set_plan(None)
+
+
+def main() -> int:
+    t_start = time.time()
+    with tempfile.TemporaryDirectory(prefix="fault_smoke_") as root:
+        for i, seq in enumerate(SCENES):
+            write_scannet_layout(
+                make_scene(num_boxes=2, num_frames=6, image_hw=(40, 56),
+                           seed=70 + i), root, seq)
+        print(f"[fault_smoke] layout ready ({time.time() - t_start:.1f}s)",
+              flush=True)
+
+        # plan 1: flaky-then-ok — one retry heals the scene
+        out = _run(root, "smk1", f"flaky:{SCENES[0]}:1")
+        assert [s.status for s in out] == ["ok", "ok"], out
+        assert out[0].attempts == 2 and out[1].attempts == 1, out
+
+        # plan 2: a device stall — the watchdog raises DeviceStallError
+        # within its budget, the ladder drops one rung (overlapped ->
+        # sequential), and the degraded retry succeeds
+        t0 = time.perf_counter()
+        out = _run(root, "smk2", f"stall:{SCENES[0]}.device",
+                   watchdog_device_s=WATCHDOG_S)
+        stall_wall = time.perf_counter() - t0
+        assert [s.status for s in out] == ["ok", "ok"], out
+        assert out[0].attempts == 2, out
+        assert out[0].degradation_rung == 1, out  # retried one rung down
+        assert stall_wall < 60.0, f"stall handling took {stall_wall:.1f}s"
+
+        # plan 3: a persistent load failure — retries exhaust, exactly one
+        # scene fails, and the journal replays the executor's verdict
+        out = _run(root, "smk3", f"load:{SCENES[1]}", scene_retries=1)
+        by = {s.seq_name: s for s in out}
+        assert by[SCENES[0]].status == "ok", out
+        assert by[SCENES[1]].status == "failed", out
+        assert by[SCENES[1]].error_class == "retryable", out
+        assert by[SCENES[1]].attempts == 2, out
+        replay = faults.replay_journal(
+            os.path.join(root, "smk3_journal.jsonl"), config="smk3")
+        for s in out:
+            r = replay[s.seq_name]
+            assert (r["status"], r["attempts"], r["error_class"]) \
+                == (s.status, s.attempts, s.error_class), (r, s)
+
+    print(f"[fault_smoke] OK: 3 plans, {time.time() - t_start:.1f}s total",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
