@@ -1,0 +1,46 @@
+"""mct-check: static invariant analysis over the pipeline's contracts.
+
+PRs 3-5 established hard perf/robustness contracts — 2 host syncs per
+scene, buffer donation actually consumed, every counting contraction on
+the narrow MXU path, 2-byte collectives under pure scene-DP, lock-guarded
+shared state across the three executor threads — but they lived as prose
+in ARCHITECTURE.md plus a handful of point tests, and each one was won
+back from a real regression (the PR-3 review caught an unlocked metrics
+registry; the PR-4 census found f32 dots that had silently survived).
+This package verifies them from the lowered IR and the source AST on
+every CI run, so the scene-serving daemon and device-resident-tail
+rewrites cannot silently undo them. Three families:
+
+- **Family 1 — IR invariants** (``ir_checks``): AOT-lowers the fused
+  step over CPU virtual devices (the obs/cost.py seam; nothing is ever
+  materialized) and checks the StableHLO/HLO text: counting-dtype policy
+  conformance, the 2-sync host-transfer census, donation aliasing, and
+  the scene-DP/frame-sharded collective payload budgets across the
+  divisor lattice of 8.
+- **Family 2 — AST lint** (``ast_checks``): walks ``maskclustering_tpu/``
+  + ``scripts/`` for unsanctioned host-sync calls, wall-clock/randomness
+  reachable from jitted code, unlocked module-level state mutated on
+  executor threads (the PR-3 registry race as the motivating pattern),
+  and bare ``except:`` that would swallow the typed fault classes of
+  ``utils/faults.py``.
+- **Family 3 — runtime sanitizer** (``transfer_guard``): opt-in
+  ``jax.transfer_guard("disallow")`` around ``run_scene_device``
+  (``--transfer-guard`` / ``MCT_TRANSFER_GUARD``) so implicit transfers
+  the AST lint cannot see become hard errors on CPU in CI.
+
+Findings carry stable ids + ``file:line``; a committed
+``analysis_baseline.json`` suppresses accepted pre-existing findings
+(each with a one-line justification) so the gate starts green and only
+ratchets. CLI::
+
+    python -m maskclustering_tpu.analysis [--baseline analysis_baseline.json] \
+        [--format text|json] [--events out.jsonl]
+
+exits 0 clean, 2 on unsuppressed findings.
+"""
+
+from maskclustering_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    load_baseline,
+    partition_findings,
+)

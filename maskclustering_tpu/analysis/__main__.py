@@ -1,0 +1,189 @@
+"""mct-check CLI: run the static invariant families, gate on findings.
+
+::
+
+    python -m maskclustering_tpu.analysis \
+        [--baseline analysis_baseline.json] [--format text|json] \
+        [--families ir,ast] [--mesh SxF ...] [--events out.jsonl] \
+        [--write-baseline PATH]
+
+Exit codes: 0 clean (every finding suppressed by the baseline), 2 on any
+unsuppressed finding, 1 on an analyzer crash. Stale baseline entries are
+advisory (reported, never fatal) — they are the ratchet's "delete me"
+signal.
+
+Triage workflow (README "Running mct-check"): read the finding's
+``file:line`` and fix it, or — for an accepted trade — add its id to
+``analysis_baseline.json`` with a one-line justification.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+from maskclustering_tpu.analysis.findings import (
+    DEFAULT_BASELINE,
+    Finding,
+    load_baseline,
+    partition_findings,
+    stale_in_scope,
+    write_baseline,
+)
+
+log = logging.getLogger("maskclustering_tpu")
+
+
+def _render_text(unsuppressed: List[Finding], suppressed: List[Finding],
+                 stale: List[str], baseline_path: Optional[str],
+                 elapsed_s: float) -> str:
+    out = [f"== mct-check: {len(unsuppressed)} finding(s), "
+           f"{len(suppressed)} suppressed, {len(stale)} stale "
+           f"suppression(s) ({elapsed_s:.1f}s) =="]
+    for f in unsuppressed:
+        out.append(f"FAIL {f.id}")
+        out.append(f"     {f.location}: {f.message}")
+    if suppressed:
+        out.append(f"-- suppressed by {baseline_path or 'baseline'} --")
+        for f in suppressed:
+            out.append(f"  ok {f.id}  ({f.location})")
+    if stale:
+        out.append("-- stale baseline entries (finding no longer fires; "
+                   "delete them) --")
+        for fid in stale:
+            out.append(f"  stale {fid}")
+    if not unsuppressed:
+        out.append("mct-check: clean")
+    return "\n".join(out)
+
+
+def run_analysis(families: List[str], meshes, repo_root: str,
+                 ) -> tuple:
+    """(findings, analyzed fused@SxF labels | None if ir did not run)."""
+    findings: List[Finding] = []
+    ir_labels = None
+    if "ast" in families:
+        from maskclustering_tpu.analysis.ast_checks import analyze_ast
+
+        findings += analyze_ast(repo_root)
+    if "ir" in families:
+        from maskclustering_tpu.analysis.ir_checks import LATTICE, analyze_ir
+
+        ir_findings, rows = analyze_ir(meshes or LATTICE,
+                                       repo_root=repo_root)
+        findings += ir_findings
+        ir_labels = {r["target"] for r in rows}
+    return findings, ir_labels
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m maskclustering_tpu.analysis",
+        description="mct-check: static IR + AST invariant analyzer "
+                    "(dtype policy, 2-sync census, donation, collective "
+                    "budgets, host-sync/thread-safety lint)")
+    p.add_argument("--baseline", default=None,
+                   help=f"suppression baseline (default: {DEFAULT_BASELINE} "
+                        f"at the repo root when present)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--families", default="ast,ir",
+                   help="comma-subset of {ast,ir} (default both)")
+    p.add_argument("--mesh", action="append", default=None, metavar="SxF",
+                   help="IR-family mesh config, repeatable (default: the "
+                        "full divisor lattice of 8)")
+    p.add_argument("--events", default=None,
+                   help="append findings as schema-versioned 'analysis' "
+                        "events to this JSONL (render with obs.report)")
+    p.add_argument("--write-baseline", default=None, metavar="PATH",
+                   help="write a baseline suppressing every current "
+                        "finding (new entries get TODO justifications "
+                        "that a human must replace)")
+    p.add_argument("--root", default=None,
+                   help="repo root to analyze (default: auto-detected)")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
+    from maskclustering_tpu.analysis.ir_checks import (
+        _repo_root,
+        parse_meshes,
+    )
+
+    repo_root = args.root or _repo_root()
+    families = [f for f in args.families.split(",") if f]
+    unknown = set(families) - {"ast", "ir"}
+    if unknown:
+        p.error(f"unknown families {sorted(unknown)}")
+    meshes = None
+    if args.mesh:
+        try:
+            meshes = parse_meshes(args.mesh)
+        except ValueError as e:
+            p.error(str(e))
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        default = os.path.join(repo_root, DEFAULT_BASELINE)
+        baseline_path = default if os.path.exists(default) else None
+    try:
+        baseline = load_baseline(baseline_path)
+    except (ValueError, OSError) as e:
+        print(f"mct-check: bad baseline: {e}", file=sys.stderr)
+        return 1
+
+    t0 = time.perf_counter()
+    try:
+        findings, ir_labels = run_analysis(families, meshes, repo_root)
+    except Exception:
+        log.exception("mct-check: analyzer crashed")
+        return 1
+    elapsed = time.perf_counter() - t0
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings, baseline)
+        print(f"mct-check: wrote {len(findings)} suppression(s) to "
+              f"{args.write_baseline} (replace any TODO justifications)")
+
+    unsuppressed, suppressed, stale = partition_findings(findings, baseline)
+    # a family-/mesh-filtered run never re-derives the out-of-scope
+    # findings; reporting their suppressions as stale would tell the user
+    # to delete still-valid baseline entries
+    stale = stale_in_scope(stale, families, ir_labels)
+
+    if args.events:
+        from maskclustering_tpu.obs.events import KIND_ANALYSIS, EventSink
+
+        sink = EventSink(args.events)
+        for f in findings:
+            payload: Dict = f.to_json()
+            payload["suppressed"] = f.id in baseline
+            if f.id in baseline:
+                payload["justification"] = baseline[f.id]
+            sink.emit(KIND_ANALYSIS, payload)
+        sink.emit(KIND_ANALYSIS, {
+            "summary": True, "families": families,
+            "findings": len(unsuppressed), "suppressed": len(suppressed),
+            "stale": len(stale), "elapsed_s": round(elapsed, 2),
+            "clean": not unsuppressed})
+        sink.close()
+
+    if args.format == "json":
+        print(json.dumps({
+            "clean": not unsuppressed,
+            "findings": [f.to_json() for f in unsuppressed],
+            "suppressed": [f.to_json() for f in suppressed],
+            "stale": stale,
+            "elapsed_s": round(elapsed, 2),
+        }, indent=2))
+    else:
+        print(_render_text(unsuppressed, suppressed, stale, baseline_path,
+                           elapsed))
+    return 2 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,518 @@
+"""Family 1: IR invariants read from the AOT-lowered fused step.
+
+Reuses the obs/cost.py lowering seam — ``build_fused_step`` over CPU
+virtual devices, censuses parsed from the StableHLO/optimized-HLO text —
+so the checks inspect the program production runs, not a lookalike, and
+need no chip and no new compile machinery. Four invariants:
+
+- **counting-dtype policy** (generalizes DTYPE_CENSUS.md from a one-shot
+  report into pass/fail): every dot class in the lowered module is either
+  the configured counting class (``bf16xbf16->f32`` / ``i8xi8->i32``) or
+  a member of the audited stays-wide f32 set, whose size is pinned
+  (``EXPECTED_WIDE_DOTS``) so a counting dispatch that silently regresses
+  to a raw f32 dot GROWS the wide census and fails; nothing may widen to
+  f64; the (F, N) claim-plane outputs stay s16.
+- **host-transfer census**: the compiled fused step contains zero
+  mid-program host crossings (send/recv/infeed/outfeed/host callbacks)
+  across the divisor lattice of 8 — so the only device->host syncs are
+  the orchestrated pulls, whose source sites are counted by
+  ``check_source_sync_sites`` (exactly 2 in ``run_scene_device``, the
+  PR-3 contract).
+- **donation effectiveness**: every input ``cfg.donate_buffers`` donates
+  must carry a ``tf.aliasing_output`` marker in the lowered module. A
+  donation XLA could not alias leaves NO marker (jax drops it with a
+  warning this repo suppresses) — that silent waste is exactly what this
+  check surfaces; known-unaliasable cases live in the baseline with their
+  justification instead of being invisible.
+- **collective-payload budget** (pins MESH_BENCH.md's settled numbers
+  statically): pure scene-DP moves <= 2 bytes (the two 1-byte ``pred[]``
+  while-predicates); frame-sharded meshes stay within a declared envelope
+  at the canonical analyzer shape.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from maskclustering_tpu.analysis.findings import Finding, make_id
+
+# ---------------------------------------------------------------------------
+# policy constants (the contracts, in one place)
+# ---------------------------------------------------------------------------
+
+# canonical analyzer shape: tiny enough that the full divisor lattice of 8
+# AOT-compiles in ~12 s on CPU, large enough that every counting dot and
+# collective of the production program appears in the lowering
+CANONICAL_SHAPE = dict(frames=8, points=1024, image_hw=(24, 32), k_max=7)
+
+# the full divisor lattice of 8: every (scene, frame) factorization
+LATTICE: Tuple[Tuple[int, int], ...] = ((1, 8), (2, 4), (4, 2), (8, 1))
+
+# counting-contraction operand class per cfg.count_dtype (ops/counting.py)
+COUNTING_DOT_CLASS = {"bf16": "bf16xbf16->f32", "int8": "i8xi8->i32"}
+# the audited stays-wide set: f32 projection/geometry matmuls only
+WIDE_DOT_CLASSES = frozenset({"f32xf32->f32"})
+# ...and its pinned size (DTYPE_CENSUS.md's per-site table): a counting
+# dispatch regressing to a raw f32 dot grows this census and fails here
+EXPECTED_WIDE_DOTS = 3
+
+# run_scene_device's host-sync contract (models/pipeline.py, PR 3)
+EXPECTED_HOST_SYNCS = 2
+
+# scene-DP collective budget: two 1-byte pred[] while-loop predicates
+# (MESH_BENCH.md "Pure scene-DP moves 2 bytes across chips")
+SCENE_DP_ICI_BUDGET_BYTES = 2.0
+# frame-sharded envelope at CANONICAL_SHAPE: measured 92,458 B (12
+# all-gathers + while predicates); 128 KiB leaves ~40% headroom for
+# benign layout drift while a new data collective (~M_pad*F bytes at
+# minimum) still lands far outside it
+FRAME_SHARDED_ICI_BUDGET_BYTES = 128.0 * 1024
+
+# donated fused-step params: depths (1) and segs (2) — parallel/sharded.py
+# build_fused_step donate_argnums; utils/donation.py documents why their
+# aliasing so rarely materializes
+FUSED_DONATE_ARGNUMS = (1, 2)
+# the postprocess group-counts kernel donates first/last (args 0, 1)
+GROUPCOUNTS_DONATE_ARGNUMS = (0, 1)
+
+# claim-plane outputs that must stay s16 (PR-4 narrowing)
+CLAIM_PLANE_OUTPUTS = ("first_id", "last_id")
+
+# mid-program host-crossing instructions in optimized HLO; the callback
+# patterns are jax's host-callback custom-call targets (io_callback /
+# pure_callback / debug prints) — each one is a hidden per-dispatch sync
+# result types may be tuples with spaces — `%s = (f32[8], token[]) send(`
+# — so the type alternation mirrors obs/cost.py's _op_pattern
+_HLO_TYPE = r"(?:\([^=]*?\)|\S+)"
+_HOST_TRANSFER_RES = {
+    "send": re.compile(r"=\s*" + _HLO_TYPE + r"\s+send(?:-start)?\("),
+    "recv": re.compile(r"=\s*" + _HLO_TYPE + r"\s+recv(?:-start)?\("),
+    "infeed": re.compile(r"=\s*" + _HLO_TYPE + r"\s+infeed\("),
+    "outfeed": re.compile(r"=\s*" + _HLO_TYPE + r"\s+outfeed\("),
+    "host-callback": re.compile(
+        r"custom-call[^\n]*(?:python_cpu_callback|host_callback)"),
+}
+
+_RESULT_DTYPE_RE = (
+    r"tensor<[0-9x]*x([a-z]+[0-9]+)>\s*\{[^}]*jax\.result_info = \"\.%s\"")
+
+
+# ---------------------------------------------------------------------------
+# pure text/census checks (unit-testable without jax)
+# ---------------------------------------------------------------------------
+
+
+def check_dot_classes(dots: Dict[str, Dict[str, float]], count_dtype: str,
+                      label: str) -> List[Finding]:
+    """Dot-class conformance of one lowering's census (obs.cost.dot_census)."""
+    out: List[Finding] = []
+    counting_class = COUNTING_DOT_CLASS[count_dtype]
+    for cls, row in sorted(dots.items()):
+        if cls == counting_class or cls in WIDE_DOT_CLASSES:
+            continue
+        out.append(Finding(
+            id=make_id("IR.DTYPE.CLASS", label, cls),
+            check="IR.DTYPE.CLASS", family="ir",
+            message=f"{label}: dot class {cls} (x{int(row['count'])}) is "
+                    f"neither the {count_dtype!r} counting class "
+                    f"({counting_class}) nor in the audited wide set"))
+    wide = sum(int(dots[c]["count"]) for c in dots if c in WIDE_DOT_CLASSES)
+    if wide != EXPECTED_WIDE_DOTS:
+        out.append(Finding(
+            id=make_id("IR.DTYPE.WIDE", label),
+            check="IR.DTYPE.WIDE", family="ir",
+            message=f"{label}: {wide} wide f32 dot(s), expected "
+                    f"{EXPECTED_WIDE_DOTS} (the audited projection/geometry "
+                    f"set) — a counting contraction regressed to f32, or a "
+                    f"new wide matmul needs auditing (DTYPE_CENSUS.md)"))
+    return out
+
+
+def check_no_f64(stablehlo_text: str, label: str) -> List[Finding]:
+    if "f64" not in stablehlo_text:
+        return []
+    n = stablehlo_text.count("xf64")
+    return [Finding(
+        id=make_id("IR.DTYPE.F64", label),
+        check="IR.DTYPE.F64", family="ir",
+        message=f"{label}: f64 appeared in the lowered module "
+                f"({n} tensor reference(s)) — nothing in the device "
+                f"pipeline may widen to f64")]
+
+
+def check_claim_planes(stablehlo_text: str, label: str) -> List[Finding]:
+    """The (F, N) first/last claim-plane outputs must stay s16 (PR 4)."""
+    out: List[Finding] = []
+    for name in CLAIM_PLANE_OUTPUTS:
+        m = re.search(_RESULT_DTYPE_RE % name, stablehlo_text)
+        if m is None:
+            out.append(Finding(
+                id=make_id("IR.DTYPE.PLANE", label, name, "missing"),
+                check="IR.DTYPE.PLANE", family="ir",
+                message=f"{label}: fused-step output {name!r} not found in "
+                        f"the lowered signature — claim-plane contract "
+                        f"unverifiable"))
+        elif m.group(1) != "i16":
+            out.append(Finding(
+                id=make_id("IR.DTYPE.PLANE", label, name, m.group(1)),
+                check="IR.DTYPE.PLANE", family="ir",
+                message=f"{label}: claim plane {name} lowered as "
+                        f"{m.group(1)}, must stay i16 (the PR-4 HBM "
+                        f"halving)"))
+    return out
+
+
+def check_host_transfers(compiled_text: str, label: str) -> List[Finding]:
+    """Zero mid-program host crossings in the compiled fused step."""
+    out: List[Finding] = []
+    for kind, pat in _HOST_TRANSFER_RES.items():
+        n = len(pat.findall(compiled_text))
+        if n:
+            out.append(Finding(
+                id=make_id("IR.SYNC.HLO", label, kind),
+                check="IR.SYNC.HLO", family="ir",
+                message=f"{label}: compiled step contains {n} {kind} "
+                        f"instruction(s) — a mid-program host crossing "
+                        f"breaks the 2-sync scene contract"))
+    return out
+
+
+def check_collective_budget(ici_bytes: float,
+                            collectives: Dict[str, Dict[str, float]],
+                            mesh: Tuple[int, int], label: str,
+                            canonical_shape: bool = True) -> List[Finding]:
+    """Scene-DP <= 2 bytes always; frame-sharded within the envelope at
+    the canonical shape (budgets are shape-dependent there)."""
+    _, f_ax = mesh
+    if f_ax == 1:
+        data_colls = {k: v for k, v in collectives.items()
+                      if k != "all-reduce"}
+        out: List[Finding] = []
+        if data_colls:
+            out.append(Finding(
+                id=make_id("IR.COLLECTIVE.SCENE_DP", label, "data"),
+                check="IR.COLLECTIVE.SCENE_DP", family="ir",
+                message=f"{label}: pure scene-DP compiled DATA "
+                        f"collective(s) {sorted(data_colls)} — cross-scene "
+                        f"traffic appeared on the critical path"))
+        if ici_bytes > SCENE_DP_ICI_BUDGET_BYTES:
+            out.append(Finding(
+                id=make_id("IR.COLLECTIVE.SCENE_DP", label, "bytes"),
+                check="IR.COLLECTIVE.SCENE_DP", family="ir",
+                message=f"{label}: scene-DP ICI payload {ici_bytes:.0f} B "
+                        f"exceeds the {SCENE_DP_ICI_BUDGET_BYTES:.0f} B "
+                        f"while-predicate budget (MESH_BENCH.md)"))
+        return out
+    if not canonical_shape:
+        return []
+    if ici_bytes > FRAME_SHARDED_ICI_BUDGET_BYTES:
+        return [Finding(
+            id=make_id("IR.COLLECTIVE.FRAME", label),
+            check="IR.COLLECTIVE.FRAME", family="ir",
+            message=f"{label}: frame-sharded ICI payload {ici_bytes:.0f} B "
+                    f"exceeds the {FRAME_SHARDED_ICI_BUDGET_BYTES:.0f} B "
+                    f"canonical-shape envelope — a new collective joined "
+                    f"the fused step")]
+    return []
+
+
+def donated_param_aliases(stablehlo_text: str) -> Dict[int, Optional[int]]:
+    """%argN -> aliased output index for params carrying donation markers.
+
+    ``tf.aliasing_output = K`` means XLA aliased the donated input to
+    output K; ``jax.buffer_donor = true`` (rare) means declared-but-
+    unresolved. Params with neither marker are absent from the dict —
+    indistinguishable from never-donated, which is the point of the check.
+    """
+    sig = stablehlo_text[stablehlo_text.index("func.func public @main"):]
+    sig = sig[:sig.index(")\n") + 1] if ")\n" in sig else sig
+    out: Dict[int, Optional[int]] = {}
+    for m in re.finditer(r"%arg(\d+): tensor<[^>]+>\s*(\{[^}]*\})?", sig):
+        attrs = m.group(2) or ""
+        alias = re.search(r"tf\.aliasing_output = (\d+)", attrs)
+        if alias:
+            out[int(m.group(1))] = int(alias.group(1))
+        elif "jax.buffer_donor" in attrs:
+            out[int(m.group(1))] = None
+    return out
+
+
+def check_donation(stablehlo_text: str, donated_args: Sequence[int],
+                   label: str) -> List[Finding]:
+    """Every donated param must be effectively aliased in the lowering."""
+    aliases = donated_param_aliases(stablehlo_text)
+    out: List[Finding] = []
+    for argnum in donated_args:
+        if aliases.get(argnum) is None:
+            state = ("declared but unresolved (jax.buffer_donor)"
+                     if argnum in aliases else
+                     "absent from the lowering (dropped as unusable, or "
+                     "the donate wiring was removed)")
+            out.append(Finding(
+                id=make_id("IR.DONATION", label, f"arg{argnum}"),
+                check="IR.DONATION", family="ir",
+                message=f"{label}: donated input %arg{argnum} is {state} — "
+                        f"no buffer aliasing in the executable"))
+    return out
+
+
+# donate_argnums tuples the source must carry: CPU lowers these donations
+# away as unusable (the baselined IR.DONATION findings), so the IR alone
+# cannot tell "declared but unaliasable" from "wiring deleted" — this
+# source-level check is what makes a DROPPED donation fail the gate
+DONATION_WIRING = (
+    ("maskclustering_tpu/parallel/sharded.py", (1, 2)),
+    ("maskclustering_tpu/models/postprocess_device.py", (0, 1)),
+)
+
+
+def check_donation_wiring(repo_root: str) -> List[Finding]:
+    """Every expected ``donate_argnums=(...)`` tuple still exists in source."""
+    out: List[Finding] = []
+    for rel, expected in DONATION_WIRING:
+        path = os.path.join(repo_root, rel)
+        found: set = set()
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            tree = None
+        if tree is not None:
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.keyword) \
+                        or node.arg != "donate_argnums":
+                    continue
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Tuple) and all(
+                            isinstance(e, ast.Constant) for e in sub.elts):
+                        found.add(tuple(e.value for e in sub.elts))
+        if expected not in found:
+            out.append(Finding(
+                id=make_id("IR.DONATION.WIRING", rel,
+                           "-".join(map(str, expected))),
+                check="IR.DONATION.WIRING", family="ir",
+                message=f"{rel}: donate_argnums={expected} no longer in "
+                        f"source — a cfg.donate_buffers donation was "
+                        f"dropped (HBM stops recycling at the shape "
+                        f"bucket)",
+                file=rel))
+    return out
+
+
+def check_source_sync_sites(pipeline_path: str,
+                            rel: str = "maskclustering_tpu/models/pipeline.py"
+                            ) -> List[Finding]:
+    """The source half of the 2-sync contract: ``run_scene_device`` bumps
+    ``pipeline.host_sync`` exactly EXPECTED_HOST_SYNCS times."""
+    with open(pipeline_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=pipeline_path)
+    # the public wrapper + its guard-wrapped impl are ONE device phase
+    phase_fns = ("run_scene_device", "_run_scene_device_impl")
+    sites = 0
+    anchor = 0
+    found = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in phase_fns:
+            found = True
+            anchor = anchor or node.lineno
+            sites += sum(
+                1 for n in ast.walk(node)
+                if isinstance(n, ast.Call) and n.args
+                and isinstance(n.args[0], ast.Constant)
+                and n.args[0].value == "pipeline.host_sync"
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "count")
+    if not found:
+        return [Finding(
+            id=make_id("IR.SYNC.SOURCE", "missing"),
+            check="IR.SYNC.SOURCE", family="ir",
+            message="run_scene_device not found in models/pipeline.py — "
+                    "host-sync contract unverifiable", file=rel)]
+    if sites == EXPECTED_HOST_SYNCS:
+        return []
+    return [Finding(
+        id=make_id("IR.SYNC.SOURCE", "run_scene_device"),
+        check="IR.SYNC.SOURCE", family="ir",
+        message=f"run_scene_device carries {sites} pipeline.host_sync "
+                f"site(s), contract says exactly {EXPECTED_HOST_SYNCS} "
+                f"(mask table + assignment)",
+        file=rel, line=anchor)]
+
+
+# ---------------------------------------------------------------------------
+# the driver: lower once per (mesh, dtype), fan the checks over the texts
+# ---------------------------------------------------------------------------
+
+
+def _lower_fused(mesh_shape: Tuple[int, int], cfg, shape: Dict):
+    """(lowered, label) for the fused step on one lattice mesh."""
+    from maskclustering_tpu.parallel.mesh import make_mesh
+    from maskclustering_tpu.parallel.sharded import (
+        build_fused_step,
+        stage_arg_shapes,
+    )
+
+    mesh = make_mesh(mesh_shape)
+    step = build_fused_step(mesh, cfg, k_max=shape["k_max"],
+                            donate=bool(cfg.donate_buffers))
+    shapes = stage_arg_shapes(
+        "backprojection", scenes=mesh_shape[0], frames=shape["frames"],
+        points=shape["points"], image_hw=tuple(shape["image_hw"]),
+        k_max=shape["k_max"])
+    return step.lower(*shapes)
+
+
+def _lower_groupcounts(shape: Dict):
+    """Lower the donating postprocess group-counts kernel at tiny shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.models.postprocess_device import (
+        _mask_group_counts_kernel_donating,
+    )
+
+    f, n = shape["frames"], shape["points"]
+    k2 = shape["k_max"] + 2
+    m_pad = f * shape["k_max"]
+    sds = jax.ShapeDtypeStruct
+    return _mask_group_counts_kernel_donating.lower(
+        sds((f, n), jnp.int16), sds((f, n), jnp.int16),
+        sds((1024,), jnp.int32), sds((1024,), jnp.int32),
+        sds((m_pad,), jnp.int32), sds((m_pad,), jnp.int32),
+        sds((m_pad,), jnp.int32), k2=k2, s_pad=128,
+        count_dtype="bf16")
+
+
+def analyze_ir(
+    meshes: Sequence[Tuple[int, int]] = LATTICE,
+    *,
+    shape: Optional[Dict] = None,
+    cfg=None,
+    repo_root: Optional[str] = None,
+) -> Tuple[List[Finding], List[Dict]]:
+    """Run Family 1 end-to-end; returns (findings, JSON-able census rows).
+
+    One fused lowering+compile per mesh under the production config
+    (``count_dtype`` default, donation per ``cfg.donate_buffers``), plus a
+    lower-only int8 variant on the first mesh for the narrowing A/B, plus
+    the donating group-counts kernel. ~15 s of CPU compiles at the
+    canonical shape over the full lattice; never materializes data.
+    """
+    from maskclustering_tpu.obs.cost import (
+        collective_census,
+        default_pipeline_cfg,
+        dot_census,
+        ensure_cpu_devices,
+        ici_bytes,
+    )
+
+    shape = dict(CANONICAL_SHAPE) if shape is None else dict(shape)
+    canonical = shape == CANONICAL_SHAPE
+    if cfg is None:
+        cfg = default_pipeline_cfg(
+            point_chunk=max(256, shape["points"] // 4))
+    n_dev = ensure_cpu_devices(8)
+    findings: List[Finding] = []
+    rows: List[Dict] = []
+
+    ab_dots: Dict[str, Dict] = {}
+    analyzed = 0
+    for mesh_shape in meshes:
+        if mesh_shape[0] * mesh_shape[1] != n_dev:
+            # a mesh that does not fit the backend is skipped — but see the
+            # IR.MESH backstop below: skipping EVERY mesh must not pass
+            continue
+        analyzed += 1
+        label = f"fused@{mesh_shape[0]}x{mesh_shape[1]}"
+        lowered = _lower_fused(mesh_shape, cfg, shape)
+        stablehlo = lowered.as_text()
+        compiled_text = lowered.compile().as_text()
+        dots = dot_census(stablehlo)
+        colls = collective_census(compiled_text)
+        ici = ici_bytes(colls)
+        findings += check_dot_classes(dots, cfg.count_dtype, label)
+        findings += check_no_f64(stablehlo, label)
+        findings += check_claim_planes(stablehlo, label)
+        findings += check_host_transfers(compiled_text, label)
+        findings += check_collective_budget(ici, colls, mesh_shape, label,
+                                            canonical_shape=canonical)
+        findings += check_donation(stablehlo, FUSED_DONATE_ARGNUMS, label)
+        rows.append({"target": label, "mesh": list(mesh_shape),
+                     "count_dtype": cfg.count_dtype, "dots": dots,
+                     "collectives": colls, "ici_bytes": ici,
+                     "fingerprint": shape})
+        if not ab_dots:
+            ab_dots[cfg.count_dtype] = dots
+            other = "int8" if cfg.count_dtype == "bf16" else "bf16"
+            lo8 = _lower_fused(mesh_shape, cfg.replace(count_dtype=other),
+                               shape)
+            ab_dots[other] = dot_census(lo8.as_text())
+            findings += check_narrowing_ab(ab_dots, label)
+
+    if analyzed == 0:
+        # hard backstop: a --mesh typo (e.g. 4x4 on an 8-device backend)
+        # must never turn the fused-step gate silently green — every IR
+        # invariant above would be unverified while mct-check exits 0
+        findings.append(Finding(
+            id=make_id("IR.MESH", "none-analyzed"),
+            check="IR.MESH", family="ir",
+            message=f"no requested mesh {sorted(set(meshes))} fits the "
+                    f"{n_dev}-device backend — zero fused-step lowerings "
+                    f"analyzed, the IR invariants are unverified (fix "
+                    f"--mesh or the device count)"))
+
+    # the donating group-counts kernel (postprocess_device) — per-scene,
+    # mesh-independent
+    gc_lowered = _lower_groupcounts(shape)
+    findings += check_donation(gc_lowered.as_text(),
+                               GROUPCOUNTS_DONATE_ARGNUMS,
+                               "post.group_counts")
+
+    root = repo_root or _repo_root()
+    pipeline_py = os.path.join(root, "maskclustering_tpu", "models",
+                               "pipeline.py")
+    if os.path.exists(pipeline_py):
+        findings += check_source_sync_sites(pipeline_py)
+    findings += check_donation_wiring(root)
+    return findings, rows
+
+
+def check_narrowing_ab(ab_dots: Dict[str, Dict], label: str) -> List[Finding]:
+    """The bf16-vs-int8 narrowing A/B: classes that differ between the two
+    lowerings are the counting contractions — they must be exactly the two
+    counting classes with EQUAL instruction counts, and non-empty."""
+    if set(ab_dots) != {"bf16", "int8"}:
+        return []
+    db, d8 = ab_dots["bf16"], ab_dots["int8"]
+    stable = {k for k in db if k in d8 and d8[k] == db[k]}
+    narrowed_b = {k: v for k, v in db.items() if k not in stable}
+    narrowed_8 = {k: v for k, v in d8.items() if k not in stable}
+    cb = COUNTING_DOT_CLASS["bf16"]
+    c8 = COUNTING_DOT_CLASS["int8"]
+    ok = (set(narrowed_b) == {cb} and set(narrowed_8) == {c8}
+          and narrowed_b[cb]["count"] == narrowed_8[c8]["count"]
+          and narrowed_b[cb]["count"] > 0)
+    if ok:
+        return []
+    return [Finding(
+        id=make_id("IR.DTYPE.NARROW", label),
+        check="IR.DTYPE.NARROW", family="ir",
+        message=f"{label}: count_dtype A/B narrowing broke — bf16 variant "
+                f"classes {sorted(narrowed_b)} vs int8 {sorted(narrowed_8)}; "
+                f"every counting contraction must flip between "
+                f"{cb} and {c8} with equal counts")]
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def parse_meshes(specs: Sequence[str]) -> List[Tuple[int, int]]:
+    """CLI mesh parsing, shared with the cost observatory."""
+    from maskclustering_tpu.obs.cost import parse_mesh_specs
+
+    return parse_mesh_specs(specs)

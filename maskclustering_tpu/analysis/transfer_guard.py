@@ -1,0 +1,76 @@
+"""Family 3: the runtime transfer sanitizer (opt-in, zero-cost when off).
+
+The AST lint sees syntactic syncs; it cannot see an implicit transfer
+born inside a library call — an eager op chain mixing a Python scalar
+into a device computation (the io/feed decode used to upload its scale
+constant per scene exactly this way), a stray ``__array__`` on a device
+value, a debug print. This module arms ``jax.transfer_guard("disallow")``
+around the DEVICE PHASE of every scene (``run_scene_device``), so any
+implicit transfer becomes a hard ``XlaRuntimeError`` at the offending
+line — on CPU, in CI, before a chip ever sees it.
+
+Opt-in via ``run.py --transfer-guard`` or ``MCT_TRANSFER_GUARD=1``; the
+two sanctioned host pulls of the pipeline (mask table, assignment) open a
+``sanctioned_pull`` window that restores ``allow`` — the guard verifies
+the 2-sync contract's COMPLEMENT: nothing else crosses.
+
+jax's transfer guard is thread-local, so guarding the device phase on the
+dispatch thread never constrains the overlapped executor's host-tail
+worker (whose claim drains are sanctioned by design).
+
+Off (the default) both context managers are a shared null context: no
+jax import cost at call time, no per-scene overhead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+ENV_FLAG = "MCT_TRANSFER_GUARD"
+
+_armed: Optional[bool] = None  # None -> the environment decides
+
+
+def arm(on: Optional[bool]) -> None:
+    """Explicitly enable/disable the guard (``None`` defers to the env)."""
+    global _armed
+    _armed = on
+
+
+def enabled() -> bool:
+    if _armed is not None:
+        return _armed
+    return os.environ.get(ENV_FLAG, "").strip().lower() in ("1", "true",
+                                                            "on", "yes")
+
+
+@contextlib.contextmanager
+def device_phase_guard() -> Iterator[None]:
+    """``jax.transfer_guard("disallow")`` around a device phase when armed."""
+    if not enabled():
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def sanctioned_pull(what: str) -> Iterator[None]:
+    """A declared host-pull window inside a guarded device phase.
+
+    ``what`` names the pull for error context only; the AST lint
+    recognizes this context manager as a sanctioned seam, so runtime
+    sanction and static sanction stay one vocabulary.
+    """
+    del what  # documentation + lint marker; the guard needs no label
+    if not enabled():
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard("allow"):
+        yield

@@ -430,6 +430,17 @@ def _associate_scene_impl(
     )
 
 
+# jitted so the threshold constant bakes into the program (the eager form
+# was an implicit per-scene scalar host->device upload — flagged by the
+# Family-3 transfer guard) and the spacing-median chain dispatches as one
+# program instead of op-by-op. Static threshold: a handful of distinct
+# configs, same cache story as _associate_scene_jit.
+@functools.partial(jax.jit, static_argnames="distance_threshold")
+def _vox_size_jit(scene_points, *, distance_threshold: float):
+    return jnp.maximum(jnp.float32(distance_threshold),
+                       estimate_spacing(scene_points))
+
+
 @functools.lru_cache(maxsize=None)
 def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
                          few_points_threshold, coverage_threshold,
@@ -473,8 +484,8 @@ def associate_scene(
     ``donate=True`` invalidates the passed depths/segs device arrays.
     """
     if vox_size is None:
-        vox_size = jnp.maximum(jnp.float32(distance_threshold),
-                               estimate_spacing(scene_points))
+        vox_size = _vox_size_jit(scene_points,
+                                 distance_threshold=float(distance_threshold))
     fn = _associate_scene_jit(k_max, window, float(distance_threshold),
                               float(depth_trunc), few_points_threshold,
                               float(coverage_threshold), int(frame_batch),

@@ -36,12 +36,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from maskclustering_tpu import obs
+from maskclustering_tpu.analysis.transfer_guard import (
+    device_phase_guard,
+    sanctioned_pull,
+)
 from maskclustering_tpu.config import PipelineConfig
 from maskclustering_tpu.datasets.base import SceneTensors
 from maskclustering_tpu.models.backprojection import associate_scene_tensors
-from maskclustering_tpu.models.clustering import ClusterResult, iterative_clustering
+from maskclustering_tpu.models.clustering import iterative_clustering
 from maskclustering_tpu.models.graph import (
-    GraphStats,
     MaskTable,
     build_mask_table,
     compute_graph_stats,
@@ -183,7 +186,22 @@ def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
 
     - graph start: the mask-valid table materializes (drains associate);
     - cluster end: the final assignment vector.
+
+    Under ``--transfer-guard`` / ``MCT_TRANSFER_GUARD`` (the Family-3
+    sanitizer, analysis/transfer_guard.py) the whole phase runs inside
+    ``jax.transfer_guard("disallow")`` with only the two pulls above
+    opened as sanctioned windows — any OTHER implicit transfer raises at
+    its source line. Off by default; results are identical either way
+    (pinned by tests/test_analysis.py).
     """
+    with device_phase_guard():
+        return _run_scene_device_impl(tensors, cfg, k_max=k_max,
+                                      seq_name=seq_name)
+
+
+def _run_scene_device_impl(tensors: SceneTensors, cfg: PipelineConfig, *,
+                           k_max: Optional[int],
+                           seq_name: Optional[str]) -> DeviceHandoff:
     timings: Dict[str, float] = {}
     tracer = obs.scene_tracer()
     # fault seam: deterministic injection point for the device phase
@@ -228,7 +246,8 @@ def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
         # scene executors arm around run_scene_device (nesting a second
         # same-budget deadline here would double-count every stall)
         faults.inject("pull", seq_name)
-        mask_valid_host = np.asarray(assoc.mask_valid)
+        with sanctioned_pull("mask_valid"):
+            mask_valid_host = np.asarray(assoc.mask_valid)
         obs.count("pipeline.host_sync")
         sp.set(host_pull="mask_valid")
         table = build_mask_table(mask_valid_host, pad_multiple=cfg.mask_pad_multiple)
@@ -266,7 +285,8 @@ def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
         # prep of the post-process (same injection seam + device-phase
         # stall bound as the first pull)
         faults.inject("pull", seq_name)
-        assignment = np.asarray(sp.sync(result.assignment))
+        with sanctioned_pull("assignment"):
+            assignment = np.asarray(sp.sync(result.assignment))
         obs.count("pipeline.host_sync")
         sp.set(host_pull="assignment")
         obs.count_transfer("d2h", assignment.nbytes, "cluster")

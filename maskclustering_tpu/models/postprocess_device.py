@@ -356,7 +356,9 @@ _mask_group_counts_kernel = functools.partial(
 # donating variant: this kernel is the LAST consumer of the (F, N)
 # first/last claim tensors — donating them releases ~2 x F x N x 2 bytes of
 # HBM mid-postprocess, in time for the NEXT scene's association dispatch at
-# the same shape bucket (the overlapped executor runs the two concurrently)
+# the same shape bucket (the overlapped executor runs the two concurrently);
+# (0, 1) is pinned by mct-check IR.DONATION.WIRING — dropping the donation
+# fails the analysis gate
 _mask_group_counts_kernel_donating = functools.partial(
     jax.jit, static_argnames=("k2", "s_pad", "count_dtype"),
     donate_argnums=(0, 1))(_mask_group_counts_impl)

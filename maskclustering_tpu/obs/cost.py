@@ -296,7 +296,12 @@ def ensure_cpu_devices(count: int = 8) -> int:
     return jax.device_count()
 
 
-def _default_pipeline_cfg(point_chunk: int):
+def default_pipeline_cfg(point_chunk: int):
+    """The observatory's lowering config — also the mct-check seam.
+
+    ``analysis/ir_checks.py`` lowers through this exact config so the IR
+    invariant gates inspect the same program the cost rows describe.
+    """
     from maskclustering_tpu.config import PipelineConfig
 
     return PipelineConfig(config_name="cost_observatory", dataset="demo",
@@ -325,7 +330,7 @@ def observe_costs(
     import jax
 
     if cfg is None:
-        cfg = _default_pipeline_cfg(point_chunk=max(256, points // 4))
+        cfg = default_pipeline_cfg(point_chunk=max(256, points // 4))
     from maskclustering_tpu.parallel.mesh import make_mesh
     from maskclustering_tpu.parallel.sharded import (
         build_fused_step,
@@ -432,7 +437,7 @@ def compare_dtypes(
     ``sink`` is given, so ``report --cost`` renders both variants later.
     """
     if cfg is None:
-        cfg = _default_pipeline_cfg(point_chunk=max(256, points // 4))
+        cfg = default_pipeline_cfg(point_chunk=max(256, points // 4))
     rows_by: Dict[str, List[Dict]] = {}
     for cd in ("bf16", "int8"):
         rows_by[cd] = observe_costs(

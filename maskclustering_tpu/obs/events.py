@@ -35,6 +35,7 @@ KIND_META = "meta"
 KIND_SPAN = "span"
 KIND_METRICS = "metrics"
 KIND_COST = "cost"  # compile-time cost observatory rows (obs/cost.py)
+KIND_ANALYSIS = "analysis"  # mct-check findings/summary (analysis/__main__.py)
 
 
 class ReadStats:

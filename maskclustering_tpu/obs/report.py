@@ -25,7 +25,8 @@ import logging
 import sys
 from typing import Dict, List, Optional
 
-from maskclustering_tpu.obs.events import (KIND_COST, KIND_METRICS, KIND_SPAN,
+from maskclustering_tpu.obs.events import (KIND_ANALYSIS, KIND_COST,
+                                           KIND_METRICS, KIND_SPAN,
                                            ReadStats, read_events)
 
 log = logging.getLogger("maskclustering_tpu")
@@ -49,6 +50,7 @@ class RunData:
         self.spans: Dict[str, List[Dict]] = {}  # name -> span events, in order
         self.order: List[str] = []
         self.cost_rows: List[Dict] = []  # cost-observatory events, in order
+        self.analysis_rows: List[Dict] = []  # mct-check findings/summaries
         self.hbm_high_water: Optional[float] = None
         self.read_stats = ReadStats()  # torn/unknown lines: counted, warned
         metrics_by_pid: Dict = {}  # counters are monotonic PER PROCESS:
@@ -74,6 +76,8 @@ class RunData:
                     self.hbm_high_water = float(in_use)
             elif kind == KIND_COST:
                 self.cost_rows.append(ev)
+            elif kind == KIND_ANALYSIS:
+                self.analysis_rows.append(ev)
             elif kind == KIND_METRICS:
                 metrics_by_pid[ev.get("pid")] = ev.get("metrics") or {}
         if self.read_stats.skipped:
@@ -232,6 +236,67 @@ def render_faults(counters: Dict[str, float]) -> Optional[str]:
     return "\n".join(lines)
 
 
+def latest_analysis_run(rows: List[Dict]) -> tuple:
+    """(finding rows, summary row|None) of the newest mct-check run.
+
+    The analysis CLI appends one event per finding then one summary row
+    per invocation; a shared events file holds several runs, and only the
+    newest one describes the current tree. Findings are keyed to their
+    summary by PID: a run killed before its summary (the 90 s CI
+    timeout) leaves orphan rows that must not be attributed to the NEXT
+    invocation — a clean summary rendered above a dead run's findings
+    would contradict itself. Trailing rows after the last summary are a
+    newer in-flight/crashed run and render summary-less.
+    """
+    runs: List[tuple] = []
+    pending: Dict = {}  # pid -> finding rows not yet closed by a summary
+    tail: List[Dict] = []  # rows appended after the newest summary
+    for ev in rows:
+        if ev.get("summary"):
+            runs.append((pending.pop(ev.get("pid"), []), ev))
+            tail = []
+        else:
+            pending.setdefault(ev.get("pid"), []).append(ev)
+            tail.append(ev)
+    if tail or not runs:
+        return tail, None
+    return runs[-1]
+
+
+def render_analysis(rows: List[Dict]) -> Optional[str]:
+    """The Analysis section: the newest mct-check run's findings.
+
+    Rendered only when the events file carries ``analysis`` events (the
+    mct-check CLI with ``--events``); a plain run report is unchanged.
+    """
+    findings, summary = latest_analysis_run(rows)
+    if not findings and summary is None:
+        return None
+    out = ["== analysis (mct-check) =="]
+    if summary is not None:
+        state = "clean" if summary.get("clean") else "FINDINGS"
+        out.append(
+            f"{state}: {summary.get('findings', 0)} unsuppressed | "
+            f"{summary.get('suppressed', 0)} suppressed | "
+            f"{summary.get('stale', 0)} stale suppression(s) "
+            f"({summary.get('elapsed_s', '?')}s, "
+            f"families {'+'.join(summary.get('families') or [])})")
+    table = []
+    for ev in findings:
+        if ev.get("suppressed"):
+            continue  # the gate cares about unsuppressed ones
+        loc = ev.get("file") or "<ir>"
+        if ev.get("line"):
+            loc = f"{loc}:{ev['line']}"
+        table.append([str(ev.get("check", "?")), loc,
+                      str(ev.get("message", ""))[:72]])
+    if table:
+        out.append(_render(["check", "location", "finding"], table))
+    elif summary is not None and summary.get("suppressed"):
+        out.append("(all findings baseline-suppressed)")
+    return "\n".join(out)
+
+
 def render_report(run: RunData) -> str:
     rows = [[r["stage"], str(r["count"]), _fmt_s(r["p50_s"]), _fmt_s(r["p95_s"]),
              _fmt_s(r["device_p50_s"]), _fmt_s(r["host_p50_s"]),
@@ -269,6 +334,9 @@ def render_report(run: RunData) -> str:
     faults_sec = render_faults(run._counters)
     if faults_sec:
         out.append(faults_sec)
+    analysis_sec = render_analysis(run.analysis_rows)
+    if analysis_sec:
+        out.append(analysis_sec)
     return "\n".join(out)
 
 

@@ -29,7 +29,6 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
 
 from maskclustering_tpu.io.feed import (
     FUSED_FEED_DEPTH_SCALE,
@@ -207,6 +206,8 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
         batched,
         in_shardings=in_shardings,
         out_shardings=out_shardings,
+        # (1, 2) = depths, segs — pinned by mct-check IR.DONATION.WIRING:
+        # changing the tuple (or dropping it) fails the analysis gate
         donate_argnums=(1, 2) if donate else (),
     )
 

@@ -1240,6 +1240,13 @@ def main(argv=None) -> int:
                              "pull exceeding it raises DeviceStallError "
                              "and the scene retries/degrades instead of "
                              "wedging the run")
+    parser.add_argument("--transfer-guard", action="store_true",
+                        help="arm jax.transfer_guard('disallow') around "
+                             "every scene's device phase (Family-3 "
+                             "sanitizer; default: $MCT_TRANSFER_GUARD). "
+                             "Any implicit transfer outside the two "
+                             "sanctioned host pulls becomes a hard error "
+                             "— CI/drill knob, results identical")
     parser.add_argument("--fault-plan", default=None,
                         help="deterministic fault injection spec (e.g. "
                              "'load:scene2, stall:scene4.device, "
@@ -1264,6 +1271,10 @@ def main(argv=None) -> int:
     if args.watchdog_device is not None:
         overrides["watchdog_device_s"] = args.watchdog_device
     cfg = load_config(args.config, **overrides)
+    if args.transfer_guard:
+        from maskclustering_tpu.analysis import transfer_guard
+
+        transfer_guard.arm(True)
     if args.fault_plan:
         faults.set_plan(faults.FaultPlan.from_spec(args.fault_plan))
     # SIGTERM-safe shutdown: the scene loops stop at the next scene

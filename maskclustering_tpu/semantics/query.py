@@ -13,7 +13,7 @@ products; here every object's similarity against the full vocabulary is one
 from __future__ import annotations
 
 import os
-from typing import Dict, Sequence, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

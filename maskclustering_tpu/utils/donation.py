@@ -21,6 +21,12 @@ def suppress_unusable_donation_warning() -> None:
     from two threads concurrently. The filter matches only this exact
     jax message; embedding applications that want the warning back can
     re-enable it after importing this package.
+
+    The suppression is NOT unaudited: mct-check (analysis/ir_checks.py)
+    reads the aliasing markers from every donating program's lowering, so
+    each unaliased donation is a named IR.DONATION baseline entry with a
+    justification, and IR.DONATION.WIRING fails the gate if a
+    donate_argnums tuple is dropped from source.
     """
     warnings.filterwarnings(
         "ignore", message="Some donated buffers were not usable")

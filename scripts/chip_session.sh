@@ -149,6 +149,12 @@ run cost_census 900 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.obs.cost 
 # memory plan) — the off-chip half of the bench_int8 story, also chip-free
 run dtype_census 900 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.obs.cost \
   --compare-dtypes --events "$OUT/dtype_census_events.jsonl" --mesh 1x8 "${COST_SHAPE[@]}"
+# mct-check: the static invariant gates, CPU-side like the cost census —
+# ADVISORY here (the `run` helper never aborts the session): a finding in
+# a recovery window should be read in mct_check.out after the capture, not
+# cost chip minutes; scripts/ci.sh is where the same check is fatal
+run mct_check 120 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.analysis \
+  --events "$OUT/analysis_events.jsonl"
 # perf ledger: render the trajectory the bench steps above just appended
 # to, and gate against the last committed good verdict when present
 if [ -f BENCH_builder_r05.json ]; then

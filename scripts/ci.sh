@@ -15,16 +15,28 @@
 #    FaultPlans — flaky-then-ok, device stall + degradation ladder,
 #    persistent load failure + journal replay — through a 2-scene
 #    synthetic CPU run, budgeted under 60 s (MCT_FAULT_SMOKE=0 skips);
-# 3. gates the perf ledger's newest headline p50 against BASELINE via
+# 3. runs mct-check (python -m maskclustering_tpu.analysis): the static
+#    IR + AST invariant gates — counting-dtype policy, 2-sync census,
+#    donation aliasing/wiring, collective budgets, host-sync/thread lint —
+#    against analysis_baseline.json, CPU-only, budgeted under 90 s
+#    (MCT_CHECK=0 skips). FATAL: an unsuppressed finding fails CI.
+# 4. runs ruff (the style/correctness front-end pinned in pyproject.toml)
+#    when the PINNED version is installed (fatal); an unpinned ruff runs
+#    advisory-only — a floating linter's new rules must not flip CI red,
+#    that is exactly what the pin exists to prevent — and a missing ruff
+#    is skipped with a notice (the container image does not bake it in).
+# 5. gates the perf ledger's newest headline p50 against BASELINE via
 #    `python -m maskclustering_tpu.obs.report --regress` (exit 2 on a >15%
 #    regression — override the threshold with MCT_REGRESS_THRESHOLD).
 #
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
-# Exits non-zero on test failures (1), a fault-matrix failure (3) or a
-# perf regression (2), so it gates correctness, fault tolerance AND the
-# trajectory.
+# Exits non-zero on test failures (1), a fault-matrix failure (3), an
+# mct-check finding or ruff violation (4), or a perf regression (2), so it
+# gates correctness, fault tolerance, the invariants AND the trajectory.
+# Every gate still RUNS after a failure, but the exit code is the FIRST
+# failing gate's — triage by exit code points at the right gate.
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -32,6 +44,7 @@ BASELINE="${1:-BENCH_builder_r05.json}"
 LEDGER="${2:-${MCT_PERF_LEDGER:-PERF_LEDGER.jsonl}}"
 THRESHOLD="${MCT_REGRESS_THRESHOLD:-0.15}"
 rc=0
+fail() { [ "$rc" -eq 0 ] && rc=$1 || true; }  # first failure wins the exit code
 
 WALL_WARN="${MCT_TIER1_WALL_WARN:-800}"
 echo "== ci: tier-1 tests =="
@@ -40,7 +53,7 @@ if ! timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'not slow' --continue-on-collection-errors --durations=10 \
         -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "ci: tier-1 tests FAILED" >&2
-    rc=1
+    fail 1
 fi
 wall=$(( $(date +%s) - t0 ))
 echo "== ci: tier-1 wall ${wall}s (budget: warn >${WALL_WARN}s of the 870s timeout) =="
@@ -55,8 +68,40 @@ if [ "${MCT_FAULT_SMOKE:-1}" != "0" ]; then
     echo "== ci: fault-matrix smoke (3 canned FaultPlans, 2-scene CPU run, <60s) =="
     if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python scripts/fault_smoke.py; then
         echo "ci: fault-matrix smoke FAILED" >&2
-        rc=3
+        fail 3
     fi
+fi
+
+if [ "${MCT_CHECK:-1}" != "0" ]; then
+    echo "== ci: mct-check static invariant gate (IR + AST, CPU, <90s) =="
+    if ! timeout -k 10 90 env JAX_PLATFORMS=cpu \
+            python -m maskclustering_tpu.analysis; then
+        echo "ci: mct-check FAILED (fix the finding at its file:line, or" \
+             "baseline it in analysis_baseline.json with a justification)" >&2
+        fail 4
+    fi
+fi
+
+if command -v ruff >/dev/null 2>&1; then
+    RUFF_PIN=$(grep -oE 'ruff==[0-9.]+' pyproject.toml | head -1)
+    RUFF_HAVE="ruff==$(ruff --version 2>/dev/null | awk '{print $2}')"
+    if [ "$RUFF_HAVE" = "$RUFF_PIN" ]; then
+        echo "== ci: ruff ($RUFF_PIN, config: pyproject.toml [tool.ruff]) =="
+        if ! ruff check .; then
+            echo "ci: ruff FAILED" >&2
+            fail 4
+        fi
+    else
+        # only the pinned version gates: a floating ruff's new/changed
+        # rules turning CI red is what the pyproject pin exists to prevent
+        echo "== ci: ruff $RUFF_HAVE != pinned $RUFF_PIN — ADVISORY only" \
+             "(pip install -e '.[dev]' for the gating version) =="
+        ruff check . || echo "ci: WARNING unpinned ruff found violations" \
+                             "(non-fatal; verify against $RUFF_PIN)" >&2
+    fi
+else
+    echo "== ci: ruff not installed; skipping the lint front-end" \
+         "(pip install -e '.[dev]' to enable) =="
 fi
 
 echo "== ci: perf regression gate ($LEDGER vs $BASELINE, >$THRESHOLD p50) =="
@@ -65,7 +110,7 @@ if [ ! -f "$LEDGER" ]; then
 elif ! python -m maskclustering_tpu.obs.report --ledger "$LEDGER" \
         --regress "$BASELINE" --regress-threshold "$THRESHOLD"; then
     echo "ci: perf regression gate FAILED" >&2
-    rc=2
+    fail 2
 fi
 
 exit $rc

@@ -10,8 +10,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import sys
 import time
 
-import numpy as np
-
 from maskclustering_tpu.config import PipelineConfig
 from maskclustering_tpu.utils.synthetic import (make_scene,
                                                   resize_scene_points,

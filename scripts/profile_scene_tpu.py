@@ -38,8 +38,6 @@ def main():
         jax.config.update("jax_platforms", args.platform)
     print(f"devices: {jax.devices()}", file=sys.stderr, flush=True)
 
-    import numpy as np
-
     from maskclustering_tpu.config import PipelineConfig
     from maskclustering_tpu.models.pipeline import run_scene
     from maskclustering_tpu.utils.compile_cache import setup_compilation_cache

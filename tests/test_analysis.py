@@ -1,0 +1,590 @@
+"""mct-check contract tests (maskclustering_tpu/analysis/).
+
+Three layers, mirroring the analyzer's families:
+
+- pure units (no jax): finding ids, the baseline/ratchet policy, the AST
+  lint on known-bad fixture snippets, and the IR text checks on canned
+  StableHLO/HLO — each of the four IR invariants (counting dtype, 2-sync
+  census, donation, collective budget) has a DELIBERATE-BREAK case here,
+  proving the analyzer detects regressions rather than blessing whatever
+  the current tree does;
+- real lowerings: donation aliasing read from an actual jit lowering
+  (marker present vs dropped), and one full ``analyze_ir`` run on the
+  8x1 scene-DP mesh asserting the tree is clean modulo the baselined
+  CPU-unaliasable donations;
+- the runtime sanitizer: a 2-scene synthetic CPU pipeline under
+  ``transfer_guard`` with artifacts byte-identical to the unguarded run
+  (the ISSUE-6 Family-3 acceptance bar).
+
+The full-lattice CLI integration is slow-marked; scripts/ci.sh runs the
+same gate fatally anyway.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu.analysis.ast_checks import (
+    analyze_ast,
+    check_bare_except,
+    check_host_syncs,
+    check_jit_purity,
+    check_thread_shared_state,
+    collect_thread_targets,
+)
+from maskclustering_tpu.analysis.findings import (
+    Finding,
+    load_baseline,
+    make_id,
+    partition_findings,
+    stale_in_scope,
+    write_baseline,
+)
+from maskclustering_tpu.analysis.ir_checks import (
+    EXPECTED_WIDE_DOTS,
+    check_claim_planes,
+    check_collective_budget,
+    check_donation,
+    check_donation_wiring,
+    check_dot_classes,
+    check_host_transfers,
+    check_narrowing_ab,
+    check_no_f64,
+    check_source_sync_sites,
+    donated_param_aliases,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _f(check="X", fid=None, **kw):
+    return Finding(id=fid or make_id(check, "k"), check=check,
+                   family="ast", message="m", **kw)
+
+
+# ---------------------------------------------------------------------------
+# findings + baseline policy
+# ---------------------------------------------------------------------------
+
+
+def test_make_id_is_content_coordinates_no_lines():
+    fid = make_id("AST.HOSTSYNC", "a/b.py", "fn", "np.asarray", 2)
+    assert fid == "AST.HOSTSYNC:a/b.py:fn:np.asarray:2"
+
+
+def test_partition_findings_split_and_stale():
+    live = [_f(fid="A"), _f(fid="B")]
+    unsup, sup, stale = partition_findings(live, {"B": "why", "GONE": "old"})
+    assert [f.id for f in unsup] == ["A"]
+    assert [f.id for f in sup] == ["B"]
+    assert stale == ["GONE"]
+
+
+def test_stale_scoped_to_families_and_meshes_actually_run():
+    stale = ["AST.HOSTSYNC:a.py:f:np.asarray:1",
+             "IR.DONATION:fused@2x4:arg1",
+             "IR.DONATION:post.group_counts:arg0"]
+    # an ast-only run never re-derives IR findings: only the AST entry
+    # may be called stale
+    assert stale_in_scope(stale, ["ast"]) == [stale[0]]
+    # a mesh-filtered ir run covered only fused@1x8: the fused@2x4 entry
+    # stays, mesh-independent IR entries (group_counts) are in scope
+    assert stale_in_scope(stale, ["ast", "ir"], {"fused@1x8"}) == [
+        stale[0], stale[2]]
+    # the full run reports everything
+    assert stale_in_scope(
+        stale, ["ast", "ir"],
+        {"fused@1x8", "fused@2x4", "fused@4x2", "fused@8x1"}) == stale
+
+
+def test_load_baseline_rejects_missing_and_todo_justifications(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"version": 1, "suppressions": [
+        {"id": "A", "justification": ""}]}))
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+    # write_baseline's TODO placeholder is deliberate friction, not a pass
+    write_baseline(str(p), [_f(fid="A")])
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+    # a human replaces the TODO -> loads
+    doc = json.loads(p.read_text())
+    doc["suppressions"][0]["justification"] = "accepted trade"
+    p.write_text(json.dumps(doc))
+    assert load_baseline(str(p)) == {"A": "accepted trade"}
+
+
+def test_load_baseline_rejects_wrong_version(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"version": 99, "suppressions": []}))
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+
+
+def test_repo_baseline_loads_with_real_justifications():
+    # the committed gate baseline: loadable, every entry human-justified
+    baseline = load_baseline(os.path.join(REPO_ROOT, "analysis_baseline.json"))
+    assert baseline  # non-empty: the accepted trades are named, not hidden
+    assert all(len(why) > 10 for why in baseline.values())
+
+
+# ---------------------------------------------------------------------------
+# Family 2: AST lint on fixture snippets
+# ---------------------------------------------------------------------------
+
+
+def _lint(src, check_fn, **kw):
+    src = textwrap.dedent(src)
+    tree = ast.parse(src)
+    return check_fn(tree, "maskclustering_tpu/models/pipeline.py",
+                    src.splitlines(), **kw)
+
+
+def test_hostsync_flags_unsanctioned_pulls():
+    out = _lint("""
+        def device_phase(x):
+            a = np.asarray(x)          # unsanctioned
+            b = x.item()               # unsanctioned
+            c = float(compute(x))      # unsanctioned
+            return a, b, c
+    """, check_host_syncs)
+    assert sorted(f.id.split(":")[-2] for f in out) == [
+        ".item", "float(<call>)", "np.asarray"]
+    assert all(f.check == "AST.HOSTSYNC" and f.line for f in out)
+
+
+def test_hostsync_sanctioned_seams_and_optout_pass():
+    out = _lint("""
+        def device_phase(x, sp):
+            with sanctioned_pull("mask_valid"):
+                a = np.asarray(x)                  # family-3 seam
+            with tracer.span("post.claims_pull", scene=s):
+                b = np.asarray(x)                  # pull-named span
+            c = np.asarray(x)  # mct-ok: AST.HOSTSYNC
+            return a, b, c
+    """, check_host_syncs)
+    assert out == []
+
+
+def test_hostsync_body_markers_do_not_sanction_the_whole_block():
+    # a booked pull inside a span must NOT blind the lint to a SECOND
+    # pull added to the same 30-line block (the seam is the with item,
+    # not the body vocabulary)
+    out = _lint("""
+        def device_phase(x, sp):
+            with tracer.span("graph", scene=s) as sp2:
+                b = np.asarray(x)
+                obs.count("pipeline.host_sync")
+            return b
+    """, check_host_syncs)
+    assert [f.check for f in out] == ["AST.HOSTSYNC"]
+
+
+def test_jitpurity_flags_wallclock_reachable_from_jit():
+    out = _lint("""
+        import jax, time
+
+        def helper():
+            return time.perf_counter()   # reachable from the jitted root
+
+        @jax.jit
+        def step(x):
+            return x + helper()
+
+        def host_only():
+            return time.time()           # NOT reachable from any trace
+    """, check_jit_purity)
+    assert [f.check for f in out] == ["AST.JITPURITY"]
+    assert "helper" in out[0].id
+
+
+def test_threads_flags_unlocked_module_state():
+    src = """
+        registry = {}
+        _lock = threading.Lock()
+
+        def worker(k):
+            registry[k] = 1        # unlocked mutation on a thread target
+
+        def locked_worker(k):
+            with _lock:
+                registry[k] = 1    # guarded: fine
+
+        t = threading.Thread(target=worker)
+        u = threading.Thread(target=locked_worker)
+    """
+    src = textwrap.dedent(src)
+    tree = ast.parse(src)
+    targets = collect_thread_targets(tree)
+    assert targets == {"worker", "locked_worker"}
+    out = check_thread_shared_state(tree, "m.py", src.splitlines(), targets)
+    assert [f.check for f in out] == ["AST.THREADS"]
+    assert "worker" in out[0].id and "locked_worker" not in out[0].id
+
+
+def test_bare_except_flagged_typed_except_not():
+    out = _lint("""
+        try:
+            risky()
+        except:
+            pass
+        try:
+            risky()
+        except Exception:
+            pass
+    """, check_bare_except)
+    assert [f.check for f in out] == ["AST.EXCEPT"]
+
+
+def test_analyze_ast_driver_on_a_bad_tmp_tree(tmp_path):
+    pkg = tmp_path / "maskclustering_tpu" / "models"
+    pkg.mkdir(parents=True)
+    # device-path module (path matches DEVICE_PATH_MODULES) with both an
+    # unsanctioned sync and a bare except
+    (pkg / "pipeline.py").write_text(textwrap.dedent("""
+        def run_scene_device(x):
+            try:
+                return np.asarray(x)
+            except:
+                pass
+    """))
+    findings = analyze_ast(str(tmp_path))
+    assert {f.check for f in findings} == {"AST.HOSTSYNC", "AST.EXCEPT"}
+
+
+# ---------------------------------------------------------------------------
+# Family 1: IR invariants — text-level units with deliberate breaks
+# ---------------------------------------------------------------------------
+
+
+def _dots(**classes):
+    return {cls: {"count": float(n), "operand_bytes": 0.0}
+            for cls, n in classes.items()}
+
+
+def test_dtype_conforming_census_is_clean():
+    dots = _dots(**{"bf16xbf16->f32": 11, "f32xf32->f32": EXPECTED_WIDE_DOTS})
+    assert check_dot_classes(dots, "bf16", "fused@1x8") == []
+
+
+def test_dtype_break_forced_f32_counting_dot_fails():
+    # DELIBERATE BREAK: a counting contraction regressed to f32 — the wide
+    # census grows past the audited set and the invariant fires
+    dots = _dots(**{"bf16xbf16->f32": 10,
+                    "f32xf32->f32": EXPECTED_WIDE_DOTS + 1})
+    out = check_dot_classes(dots, "bf16", "fused@1x8")
+    assert [f.check for f in out] == ["IR.DTYPE.WIDE"]
+
+
+def test_dtype_break_foreign_class_fails():
+    dots = _dots(**{"i8xi8->i32": 11, "f16xf16->f32": 1,
+                    "f32xf32->f32": EXPECTED_WIDE_DOTS})
+    out = check_dot_classes(dots, "int8", "fused@8x1")
+    assert [f.check for f in out] == ["IR.DTYPE.CLASS"]
+    assert "f16xf16->f32" in out[0].id
+
+
+def test_f64_widening_fails():
+    assert check_no_f64("tensor<8xf32>", "l") == []
+    out = check_no_f64("tensor<8xf64>", "l")
+    assert [f.check for f in out] == ["IR.DTYPE.F64"]
+
+
+_SIG_I16 = ('-> (tensor<4x8xi16> {jax.result_info = ".first_id"}, '
+            'tensor<4x8xi16> {jax.result_info = ".last_id"})')
+_SIG_I32 = ('-> (tensor<4x8xi32> {jax.result_info = ".first_id"}, '
+            'tensor<4x8xi16> {jax.result_info = ".last_id"})')
+
+
+def test_claim_planes_stay_s16():
+    assert check_claim_planes(_SIG_I16, "l") == []
+    # DELIBERATE BREAK: a widened plane (the PR-4 regression) fires
+    out = check_claim_planes(_SIG_I32, "l")
+    assert [f.check for f in out] == ["IR.DTYPE.PLANE"]
+    assert "first_id" in out[0].id and "i32" in out[0].id
+    # a missing output is a finding too (contract unverifiable != pass)
+    assert len(check_claim_planes("func @main()", "l")) == 2
+
+
+def test_host_transfer_census_zero_crossings():
+    clean = "%ar = pred[] all-reduce(pred[] %x), channel_id=1"
+    assert check_host_transfers(clean, "l") == []
+    # DELIBERATE BREAK: a send/outfeed pair mid-program (a host callback
+    # or debug print that survived into the compiled step)
+    bad = ("%s = (f32[8], u32[], token[]) send(f32[8] %a, token[] %t)\n"
+           "%o = token[] outfeed(f32[8] %b, token[] %t)\n")
+    out = check_host_transfers(bad, "l")
+    assert sorted(f.id.split(":")[-1] for f in out) == ["outfeed", "send"]
+
+
+def test_collective_budget_scene_dp_two_bytes():
+    ok = {"all-reduce": {"count": 2, "bytes": 2.0}}
+    assert check_collective_budget(2.0, ok, (8, 1), "l") == []
+    # DELIBERATE BREAK 1: a data collective appeared under pure scene-DP
+    bad = {"all-gather": {"count": 1, "bytes": 1024.0}, **ok}
+    out = check_collective_budget(1026.0, bad, (8, 1), "l")
+    assert {f.id.split(":")[-1] for f in out} == {"data", "bytes"}
+    # DELIBERATE BREAK 2: predicate payload crept past 2 bytes
+    out = check_collective_budget(10.0, ok, (8, 1), "l")
+    assert [f.id.split(":")[-1] for f in out] == ["bytes"]
+
+
+def test_collective_budget_frame_sharded_envelope():
+    colls = {"all-gather": {"count": 12, "bytes": 90000.0}}
+    assert check_collective_budget(9e4, colls, (1, 8), "l") == []
+    out = check_collective_budget(2e5, colls, (1, 8), "l")
+    assert [f.check for f in out] == ["IR.COLLECTIVE.FRAME"]
+    # off-canonical shapes carry no envelope (budgets are shape-dependent)
+    assert check_collective_budget(2e5, colls, (1, 8), "l",
+                                   canonical_shape=False) == []
+
+
+def test_donation_aliasing_read_from_a_real_lowering():
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct((128,), jnp.float32)
+    donating = jax.jit(lambda x: x + 1, donate_argnums=(0,)).lower(sds)
+    aliases = donated_param_aliases(donating.as_text())
+    assert aliases.get(0) is not None  # tf.aliasing_output present
+    assert check_donation(donating.as_text(), (0,), "l") == []
+    # DELIBERATE BREAK: the donation dropped from the jit wrapper — no
+    # marker in the lowering, the finding names the missing arg
+    plain = jax.jit(lambda x: x + 1).lower(sds)
+    out = check_donation(plain.as_text(), (0,), "l")
+    assert [f.check for f in out] == ["IR.DONATION"]
+    assert "arg0" in out[0].id
+
+
+def test_donation_wiring_present_in_tree_and_break_detected(tmp_path):
+    # the real tree carries every pinned donate_argnums tuple
+    assert check_donation_wiring(REPO_ROOT) == []
+    # DELIBERATE BREAK: a tree whose donate wiring was deleted
+    for rel in ("maskclustering_tpu/parallel/sharded.py",
+                "maskclustering_tpu/models/postprocess_device.py"):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("def build(): return jax.jit(f)\n")
+    out = check_donation_wiring(str(tmp_path))
+    assert [f.check for f in out] == ["IR.DONATION.WIRING"] * 2
+
+
+def test_source_sync_sites_contract(tmp_path):
+    real = os.path.join(REPO_ROOT, "maskclustering_tpu/models/pipeline.py")
+    assert check_source_sync_sites(real) == []
+    # DELIBERATE BREAK: a third pull sneaks into the device phase
+    p = tmp_path / "pipeline.py"
+    p.write_text(textwrap.dedent("""
+        def run_scene_device(t):
+            obs.count("pipeline.host_sync")
+            obs.count("pipeline.host_sync")
+            obs.count("pipeline.host_sync")
+    """))
+    out = check_source_sync_sites(str(p))
+    assert [f.check for f in out] == ["IR.SYNC.SOURCE"]
+    assert "3" in out[0].message
+
+
+def test_narrowing_ab_detects_a_stuck_counting_path():
+    good = {"bf16": _dots(**{"bf16xbf16->f32": 11, "f32xf32->f32": 3}),
+            "int8": _dots(**{"i8xi8->i32": 11, "f32xf32->f32": 3})}
+    assert check_narrowing_ab(good, "l") == []
+    # DELIBERATE BREAK: count_dtype stopped dispatching — both lowerings
+    # identical means no contraction actually narrows
+    stuck = {"bf16": good["bf16"], "int8": good["bf16"]}
+    out = check_narrowing_ab(stuck, "l")
+    assert [f.check for f in out] == ["IR.DTYPE.NARROW"]
+
+
+# ---------------------------------------------------------------------------
+# Family 1 integration: one real mesh of the lattice
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_ir_scene_dp_clean_modulo_baseline():
+    from maskclustering_tpu.analysis.ir_checks import analyze_ir
+
+    findings, rows = analyze_ir(meshes=[(8, 1)], repo_root=REPO_ROOT)
+    # CPU lowers the fused/groupcounts donations away (unusable) — those
+    # are the committed baseline entries; NOTHING else may fire
+    baseline = load_baseline(os.path.join(REPO_ROOT, "analysis_baseline.json"))
+    extra = [f.id for f in findings if f.id not in baseline]
+    assert extra == []
+    assert all(f.check == "IR.DONATION" for f in findings)
+    # and the scene-DP census itself pins the 2-byte contract
+    assert rows and rows[0]["ici_bytes"] <= 2.0
+
+
+# ---------------------------------------------------------------------------
+# CLI + events + report section
+# ---------------------------------------------------------------------------
+
+
+def test_cli_ast_family_green_on_repo_and_red_on_bad_tree(tmp_path):
+    from maskclustering_tpu.analysis.__main__ import main
+
+    # the repo itself: every AST finding is a justified baseline entry
+    assert main(["--families", "ast", "--root", REPO_ROOT]) == 0
+
+    # a bad tree with no baseline: exit 2 (the gate)
+    pkg = tmp_path / "maskclustering_tpu" / "models"
+    pkg.mkdir(parents=True)
+    (pkg / "pipeline.py").write_text(
+        "def run_scene_device(x):\n    return np.asarray(x)\n")
+    argv = ["--families", "ast", "--root", str(tmp_path)]
+    assert main(argv) == 2
+
+    # ratchet round-trip: --write-baseline, human justifies, gate greens
+    bl = tmp_path / "bl.json"
+    main(argv + ["--write-baseline", str(bl)])
+    doc = json.loads(bl.read_text())
+    with pytest.raises(ValueError):
+        load_baseline(str(bl))  # TODO placeholders are rejected
+    for e in doc["suppressions"]:
+        e["justification"] = "fixture: accepted for the round-trip test"
+    bl.write_text(json.dumps(doc))
+    assert main(argv + ["--baseline", str(bl)]) == 0
+
+
+def test_cli_events_render_in_obs_report(tmp_path):
+    from maskclustering_tpu.analysis.__main__ import main
+    from maskclustering_tpu.obs.report import RunData, render_analysis
+
+    events = tmp_path / "events.jsonl"
+    rc = main(["--families", "ast", "--root", REPO_ROOT,
+               "--events", str(events)])
+    assert rc == 0
+    run = RunData(str(events))
+    assert run.analysis_rows  # one event per finding + a summary row
+    section = render_analysis(run.analysis_rows)
+    assert section is not None and "mct-check" in section
+    assert "clean" in section  # the summary row's verdict
+
+
+def test_report_analysis_section_picks_newest_run():
+    from maskclustering_tpu.obs.report import latest_analysis_run
+
+    rows = [
+        {"check": "A", "suppressed": False}, {"summary": True, "clean": False},
+        {"check": "B", "suppressed": False}, {"summary": True, "clean": True},
+    ]
+    findings, summary = latest_analysis_run(rows)
+    assert [r["check"] for r in findings] == ["B"]
+    assert summary["clean"] is True
+
+
+def test_report_analysis_orphan_rows_not_attributed_to_next_run():
+    from maskclustering_tpu.obs.report import latest_analysis_run
+
+    # pid 1 died before its summary (CI timeout); pid 2 ran clean after —
+    # pid 1's orphans must not render under pid 2's clean summary
+    rows = [
+        {"check": "DEAD", "pid": 1, "suppressed": False},
+        {"check": "B", "pid": 2, "suppressed": True},
+        {"summary": True, "clean": True, "pid": 2},
+    ]
+    findings, summary = latest_analysis_run(rows)
+    assert [r["check"] for r in findings] == ["B"]
+    assert summary["clean"] is True
+    # ...and with no later run at all, the dead run renders summary-less
+    findings, summary = latest_analysis_run(rows[:1])
+    assert [r["check"] for r in findings] == ["DEAD"] and summary is None
+
+
+# ---------------------------------------------------------------------------
+# Family 3: the transfer-guard sanitizer
+# ---------------------------------------------------------------------------
+
+
+def _small_cfg():
+    from maskclustering_tpu.config import PipelineConfig
+
+    return PipelineConfig(config_name="synthetic", dataset="demo",
+                          backend="cpu", distance_threshold=0.03, step=1,
+                          mask_pad_multiple=64, point_chunk=2048)
+
+
+def test_transfer_guard_env_and_arm_precedence(monkeypatch):
+    from maskclustering_tpu.analysis import transfer_guard as tg
+
+    monkeypatch.delenv(tg.ENV_FLAG, raising=False)
+    tg.arm(None)
+    assert not tg.enabled()
+    monkeypatch.setenv(tg.ENV_FLAG, "1")
+    assert tg.enabled()
+    tg.arm(False)  # explicit arm beats the environment
+    try:
+        assert not tg.enabled()
+    finally:
+        tg.arm(None)
+
+
+def test_transfer_guard_trips_on_an_implicit_transfer():
+    import jax
+    import jax.numpy as jnp
+
+    from maskclustering_tpu.analysis import transfer_guard as tg
+
+    x = jnp.arange(8.0)
+    tg.arm(True)
+    try:
+        with tg.device_phase_guard():
+            with pytest.raises(jax.errors.JaxRuntimeError):
+                # an eager python-scalar upload — exactly the io/feed bug
+                # the guard originally surfaced
+                _ = (x * np.float32(2.0)) + 1.0  # noqa: F841
+            with tg.sanctioned_pull("ok"):
+                assert np.asarray(x).shape == (8,)
+    finally:
+        tg.arm(None)
+
+
+def test_transfer_guard_two_scene_pipeline_byte_identity():
+    """ISSUE-6 acceptance: a 2-scene synthetic CPU pipeline end-to-end
+    under the guard, zero violations, artifacts byte-identical."""
+    from maskclustering_tpu.analysis import transfer_guard as tg
+    from maskclustering_tpu.models.pipeline import run_scene
+    from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+    cfg = _small_cfg()
+    scenes = [make_scene(num_boxes=3, num_frames=6, seed=s) for s in (3, 4)]
+
+    def run_all():
+        return [run_scene(to_scene_tensors(s), cfg, k_max=15)
+                for s in scenes]
+
+    plain = run_all()
+    tg.arm(True)
+    try:
+        guarded = run_all()  # any implicit transfer raises here
+    finally:
+        tg.arm(None)
+    for a, b in zip(plain, guarded):
+        assert np.array_equal(a.assignment, b.assignment)
+        assert a.objects.num_points == b.objects.num_points
+        assert len(a.objects.point_ids_list) == len(b.objects.point_ids_list)
+        for pa, pb in zip(a.objects.point_ids_list, b.objects.point_ids_list):
+            assert pa.tobytes() == pb.tobytes()
+        for ma, mb in zip(a.objects.mask_list, b.objects.mask_list):
+            assert ma == mb
+
+
+# ---------------------------------------------------------------------------
+# the full gate, exactly as CI runs it (slow: ~15 s of lattice compiles)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_full_gate_green_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "maskclustering_tpu.analysis"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "mct-check: clean" in proc.stdout

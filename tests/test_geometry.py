@@ -1,6 +1,5 @@
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from maskclustering_tpu.ops.geometry import (
     invert_se3,

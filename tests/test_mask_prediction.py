@@ -144,8 +144,6 @@ class TestPredictSceneMasks:
         assert len(first) == 1 and len(second) == 0
 
     def test_pipeline_masks_step_uses_predictor(self, tmp_path):
-        import jax
-
         from maskclustering_tpu.config import load_config
         from maskclustering_tpu.run import check_masks
         from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout

@@ -77,7 +77,6 @@ def test_auto_k_max_handles_ids_beyond_128(result_and_scene):
     from dataclasses import replace
 
     from maskclustering_tpu.models.pipeline import bucket_k_max
-    from maskclustering_tpu.utils.synthetic import make_scene as _mk
 
     assert bucket_k_max(0) == 63
     assert bucket_k_max(63) == 63

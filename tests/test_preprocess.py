@@ -13,7 +13,7 @@ import zlib
 import numpy as np
 import pytest
 
-from maskclustering_tpu.io.image import read_depth_png, read_mask_png
+from maskclustering_tpu.io.image import read_depth_png
 from maskclustering_tpu.io.ply import read_ply_mesh, read_ply_points
 from maskclustering_tpu.preprocess import (
     SensHeader,

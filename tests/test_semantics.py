@@ -6,7 +6,6 @@ no CLIP weights needed (SURVEY.md §4's fake-backend strategy).
 """
 
 import numpy as np
-import pytest
 
 from maskclustering_tpu.semantics import (
     HashEncoder,

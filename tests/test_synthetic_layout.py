@@ -70,8 +70,6 @@ def test_exact_path_solves_separated_deep_scene(tmp_path):
     noise (as scripts/parity_ab.py applies): the reference pipeline's bbox
     crop assumes non-degenerate view clouds, which analytic depth does not
     produce."""
-    import os
-
     from maskclustering_tpu.config import PipelineConfig
     from maskclustering_tpu.evaluation.ap import evaluate_scans
     from maskclustering_tpu.models.pipeline import run_scene
