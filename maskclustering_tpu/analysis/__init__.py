@@ -9,7 +9,7 @@ back from a real regression (the PR-3 review caught an unlocked metrics
 registry; the PR-4 census found f32 dots that had silently survived).
 This package verifies them from the lowered IR and the source AST on
 every CI run, so the scene-serving daemon and device-resident-tail
-rewrites cannot silently undo them. Three families:
+rewrites cannot silently undo them. Four families:
 
 - **Family 1 — IR invariants** (``ir_checks``): AOT-lowers the fused
   step over CPU virtual devices (the obs/cost.py seam; nothing is ever
@@ -27,6 +27,14 @@ rewrites cannot silently undo them. Three families:
   ``jax.transfer_guard("disallow")`` around ``run_scene_device``
   (``--transfer-guard`` / ``MCT_TRANSFER_GUARD``) so implicit transfers
   the AST lint cannot see become hard errors on CPU in CI.
+- **Family 4 — concurrency** (``concurrency`` + ``lock_sanitizer``,
+  ``--families concurrency``): a whole-program thread-topology model
+  (roots = DaemonFuture / Thread / executor submits / signal handlers /
+  watchdog targets / ``# mct-thread: root`` markers) checked for
+  unguarded multi-root shared state, lock-order cycles, blocking calls
+  under held locks, handler purity, and join/abandon contracts — plus
+  the opt-in instrumented lock shim (``MCT_LOCK_SANITIZER=1``) whose
+  observed acquisition-order graph must embed in the static one.
 
 Findings carry stable ids + ``file:line``; a committed
 ``analysis_baseline.json`` suppresses accepted pre-existing findings

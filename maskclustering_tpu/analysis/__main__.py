@@ -4,8 +4,8 @@
 
     python -m maskclustering_tpu.analysis \
         [--baseline analysis_baseline.json] [--format text|json] \
-        [--families ir,ast] [--mesh SxF ...] [--events out.jsonl] \
-        [--write-baseline PATH]
+        [--families ir,ast,concurrency] [--mesh SxF ...] \
+        [--events out.jsonl] [--write-baseline PATH]
 
 Exit codes: 0 clean (every finding suppressed by the baseline), 2 on any
 unsuppressed finding, 1 on an analyzer crash. Stale baseline entries are
@@ -71,6 +71,10 @@ def run_analysis(families: List[str], meshes, repo_root: str,
         from maskclustering_tpu.analysis.ast_checks import analyze_ast
 
         findings += analyze_ast(repo_root)
+    if "concurrency" in families:
+        from maskclustering_tpu.analysis.concurrency import analyze_concurrency
+
+        findings += analyze_concurrency(repo_root)
     if "ir" in families:
         from maskclustering_tpu.analysis.ir_checks import LATTICE, analyze_ir
 
@@ -84,15 +88,16 @@ def run_analysis(families: List[str], meshes, repo_root: str,
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m maskclustering_tpu.analysis",
-        description="mct-check: static IR + AST invariant analyzer "
-                    "(dtype policy, 2-sync census, donation, collective "
-                    "budgets, host-sync/thread-safety lint)")
+        description="mct-check: static IR + AST + concurrency invariant "
+                    "analyzer (dtype policy, 2-sync census, donation, "
+                    "collective budgets, host-sync lint, thread topology "
+                    "/ lock order / signal safety)")
     p.add_argument("--baseline", default=None,
                    help=f"suppression baseline (default: {DEFAULT_BASELINE} "
                         f"at the repo root when present)")
     p.add_argument("--format", choices=("text", "json"), default="text")
-    p.add_argument("--families", default="ast,ir",
-                   help="comma-subset of {ast,ir} (default both)")
+    p.add_argument("--families", default="ast,ir,concurrency",
+                   help="comma-subset of {ast,ir,concurrency} (default all)")
     p.add_argument("--mesh", action="append", default=None, metavar="SxF",
                    help="IR-family mesh config, repeatable (default: the "
                         "full divisor lattice of 8)")
@@ -115,7 +120,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     repo_root = args.root or _repo_root()
     families = [f for f in args.families.split(",") if f]
-    unknown = set(families) - {"ast", "ir"}
+    unknown = set(families) - {"ast", "ir", "concurrency"}
     if unknown:
         p.error(f"unknown families {sorted(unknown)}")
     meshes = None

@@ -364,7 +364,11 @@ def collect_thread_targets(tree: ast.Module) -> Set[str]:
             for kw in node.keywords:
                 if kw.arg == "target" and isinstance(kw.value, ast.Name):
                     targets.add(kw.value.id)
-        if tail in ("submit", "map") and "ex" in chain.lower() and node.args \
+        # executor-shaped receivers: `ex`/`executor` AND `pool` spellings
+        # (semantics/features.py's io pool is literally `pool.map(...)`)
+        receiver = chain.lower()
+        if tail in ("submit", "map") \
+                and ("ex" in receiver or "pool" in receiver) and node.args \
                 and isinstance(node.args[0], ast.Name):
             targets.add(node.args[0].id)
     return targets
@@ -493,7 +497,9 @@ def check_bare_except(tree: ast.Module, rel: str,
 # the driver
 # ---------------------------------------------------------------------------
 
-SCAN_ROOTS = ("maskclustering_tpu", "scripts")
+# bench.py rides along for the thread/except lints (its supervisor owns a
+# drain thread and the SIGTERM handler the concurrency family audits)
+SCAN_ROOTS = ("maskclustering_tpu", "scripts", "bench.py")
 
 
 def _iter_py_files(repo_root: str,

@@ -392,6 +392,7 @@ def analyze_ir(
     shape: Optional[Dict] = None,
     cfg=None,
     repo_root: Optional[str] = None,
+    lowerings: Optional[Dict[Tuple[int, int], Tuple[str, str]]] = None,
 ) -> Tuple[List[Finding], List[Dict]]:
     """Run Family 1 end-to-end; returns (findings, JSON-able census rows).
 
@@ -400,6 +401,13 @@ def analyze_ir(
     lower-only int8 variant on the first mesh for the narrowing A/B, plus
     the donating group-counts kernel. ~15 s of CPU compiles at the
     canonical shape over the full lattice; never materializes data.
+
+    ``lowerings`` maps a mesh to precomputed ``(stablehlo_text,
+    compiled_hlo_text)`` of the fused step at ``shape`` under the SAME
+    default config — ``obs.cost.observe_costs(..., keep_texts=True)``
+    produces them — so one AOT sweep can serve both the cost rows and
+    this gate (the tier-1 conftest de-duplication). Meshes not in the
+    dict lower here as before.
     """
     from maskclustering_tpu.obs.cost import (
         collective_census,
@@ -427,9 +435,13 @@ def analyze_ir(
             continue
         analyzed += 1
         label = f"fused@{mesh_shape[0]}x{mesh_shape[1]}"
-        lowered = _lower_fused(mesh_shape, cfg, shape)
-        stablehlo = lowered.as_text()
-        compiled_text = lowered.compile().as_text()
+        pre = (lowerings or {}).get(tuple(mesh_shape))
+        if pre is not None:
+            stablehlo, compiled_text = pre
+        else:
+            lowered = _lower_fused(mesh_shape, cfg, shape)
+            stablehlo = lowered.as_text()
+            compiled_text = lowered.compile().as_text()
         dots = dot_census(stablehlo)
         colls = collective_census(compiled_text)
         ici = ici_bytes(colls)

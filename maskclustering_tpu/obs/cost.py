@@ -319,6 +319,7 @@ def observe_costs(
     k_max: int = 7,
     cfg=None,
     sink: Optional[EventSink] = None,
+    keep_texts: bool = False,
 ) -> List[Dict]:
     """AOT-lower every (stage, mesh) pair and return/emit the cost rows.
 
@@ -326,6 +327,12 @@ def observe_costs(
     per scene group — the honest serving shape); ``frames`` must divide by
     every frame axis requested. Rows are plain dicts (JSON-able); when
     ``sink`` is given each row is also emitted as a ``cost`` event.
+
+    ``keep_texts`` attaches each lowering's StableHLO + optimized-HLO text
+    to its row (``"stablehlo"`` / ``"compiled_text"``) so a caller can fan
+    further text analyses over ONE sweep — the seam the tier-1 conftest
+    fixture shares between the cost tests and ``analysis.ir_checks``.
+    The texts never reach the sink (megabytes per event line).
     """
     import jax
 
@@ -390,15 +397,20 @@ def observe_costs(
                 # the dot dtype census reads the pre-optimization StableHLO
                 # (the program a TPU backend receives; the CPU pipeline
                 # rewrites s8 dots to s32 and would misreport the MXU class)
-                row["dots"] = dot_census(lowered.as_text())
+                stablehlo = lowered.as_text()
+                row["dots"] = dot_census(stablehlo)
             except Exception:  # noqa: BLE001 — census is best-effort
+                stablehlo = None
                 row["dots"] = {}
             row.update({"stage": stage, "mesh": list(mesh_shape),
                         "devices": n_dev, "count_dtype": cfg.count_dtype,
                         "fingerprint": fingerprint})
-            rows.append(row)
             if sink is not None:
-                sink.emit(KIND_COST, row)
+                sink.emit(KIND_COST, row)  # before the texts ride along
+            if keep_texts and stablehlo is not None:
+                row["stablehlo"] = stablehlo
+                row["compiled_text"] = compiled.as_text()
+            rows.append(row)
             log.info("cost observatory: %s @ mesh %s: %d collective(s), "
                      "%.0f ICI bytes", stage, mesh_shape,
                      sum(c["count"] for c in row["collectives"].values()),

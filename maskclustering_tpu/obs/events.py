@@ -21,9 +21,13 @@ import io
 import json
 import logging
 import os
-import threading
 import time
 from typing import Dict, Iterator, List, Optional
+
+# stdlib-only; a raw threading.Lock unless MCT_LOCK_SANITIZER is armed.
+# The literal name keys this lock in both the static lock-order graph
+# (analysis/concurrency.py) and the runtime sanitizer's observed one
+from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
 
 log = logging.getLogger("maskclustering_tpu")
 
@@ -72,7 +76,7 @@ class EventSink:
 
     def __init__(self, path: str, *, truncate: bool = False):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = mct_lock("obs.events.EventSink._lock")
         self._dead = False
         d = os.path.dirname(path)
         if d:

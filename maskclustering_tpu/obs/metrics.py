@@ -24,8 +24,13 @@ Design constraints, in order:
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional
+
+# stdlib-only import; off (the default) this returns a RAW threading.Lock,
+# so constraint 1 below still holds on the counter hot path. The literal
+# name is the lock's identity in BOTH the static lock-order graph
+# (analysis/concurrency.py) and the runtime-observed one
+from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
 
 _HIST_CAP = 4096  # per-histogram value cap before stride decimation
 
@@ -80,7 +85,7 @@ class Registry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
-        self._lock = threading.Lock()
+        self._lock = mct_lock("obs.metrics.Registry._lock")
 
     # -- write paths (hot) --------------------------------------------------
     def count(self, name: str, delta: float = 1.0) -> None:

@@ -216,23 +216,36 @@ def render_faults(counters: Dict[str, float]) -> Optional[str]:
     stalls = int(counters.get("run.device_stalls", 0))
     skips = int(counters.get("run.journal_skips", 0))
     failed = int(counters.get("run.scenes_failed", 0))
+    abandoned = int(counters.get("run.abandoned_results", 0))
     degr = {k[len("run.degradations."):]: int(v)
             for k, v in sorted(counters.items())
             if k.startswith("run.degradations.")}
     inj = {k[len("faults.injected."):]: int(v)
            for k, v in sorted(counters.items())
            if k.startswith("faults.injected.")}
-    if not (retries or stalls or skips or degr or inj):
+    # the lock sanitizer's digest (lock_sanitizer.emit_counters, armed
+    # runs only): acquisition volume, distinct nesting edges, long holds
+    lock_acq = int(counters.get("locks.acquisitions", 0))
+    if not (retries or stalls or skips or failed or abandoned or degr
+            or inj or lock_acq):
+        # `failed` matters alone: a terminal-class error is never retried,
+        # so it can be the ONLY fault signal of the run
         return None
     lines = ["== faults ==",
              f"scene retries {retries} | device stalls {stalls} | "
-             f"journal skips {skips} | scenes failed {failed}"]
+             f"journal skips {skips} | scenes failed {failed}"
+             + (f" | abandoned results {abandoned}" if abandoned else "")]
     if degr:
         lines.append("degradations: " + ", ".join(
             f"{name} x{n}" for name, n in degr.items()))
     if inj:
         lines.append("injected (fault plan): " + ", ".join(
             f"{seam} x{n}" for seam, n in inj.items()))
+    if lock_acq:
+        lines.append(
+            f"lock sanitizer: {lock_acq} acquisition(s) | "
+            f"{int(counters.get('locks.order_edges', 0))} order edge(s) | "
+            f"{int(counters.get('locks.long_holds', 0))} long hold(s)")
     return "\n".join(lines)
 
 

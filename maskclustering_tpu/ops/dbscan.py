@@ -23,7 +23,7 @@ except Exception:  # pragma: no cover
     _HAS_NATIVE = False
 
 
-def dbscan_labels(points: np.ndarray, eps: float, min_points: int) -> np.ndarray:
+def dbscan_labels(points: np.ndarray, eps: float, min_points: int) -> np.ndarray:  # mct-thread: root (dbscan_labels_parallel's pool lambda hides this entry from the AST collector)
     """Standard DBSCAN labels; -1 = noise (Open3D cluster_dbscan contract).
 
     min_points counts the point itself, matching Open3D and sklearn.

@@ -435,6 +435,11 @@ def _cluster_scenes_overlapped(cfg: PipelineConfig, seq_names: Sequence[str], *,
             result, err, err_class, t_end = fut.result(
                 cfg.watchdog_host_s if cfg.watchdog_host_s > 0 else None)
         except TimeoutError:
+            # declare the consumer gone: the wedged tail's late result (and
+            # the whole scene's tensors it references) is dropped at
+            # completion instead of living on the future, and the drop is
+            # booked as run.abandoned_results
+            fut.abandon()
             stall = faults.DeviceStallError("host", seq, cfg.watchdog_host_s)
             obs.count("run.device_stalls")
             obs.count("run.scenes_failed")
@@ -1126,6 +1131,12 @@ def _run_pipeline_body(
                 cfg, seq_names, resume=resume, scene_points_cache=pts_cache))
 
     if obs_events and obs.enabled():
+        from maskclustering_tpu.analysis import lock_sanitizer
+
+        if lock_sanitizer.enabled():
+            # book the sanitizer digest (locks.* counters) before the
+            # flush so the report's Faults section renders it
+            lock_sanitizer.emit_counters()
         obs.flush_metrics()
         try:
             from maskclustering_tpu.obs.report import RunData
@@ -1247,6 +1258,14 @@ def main(argv=None) -> int:
                              "Any implicit transfer outside the two "
                              "sanctioned host pulls becomes a hard error "
                              "— CI/drill knob, results identical")
+    parser.add_argument("--lock-sanitizer", action="store_true",
+                        help="arm the instrumented lock shim for this run "
+                             "(concurrency-family sanitizer; default: "
+                             "$MCT_LOCK_SANITIZER). Records actual lock "
+                             "acquisition orders + hold times against the "
+                             "static lock-order graph — CI/drill knob, "
+                             "results identical, metrics hot path gains "
+                             "a few dict ops per bump")
     parser.add_argument("--fault-plan", default=None,
                         help="deterministic fault injection spec (e.g. "
                              "'load:scene2, stall:scene4.device, "
@@ -1275,6 +1294,13 @@ def main(argv=None) -> int:
         from maskclustering_tpu.analysis import transfer_guard
 
         transfer_guard.arm(True)
+    if args.lock_sanitizer:
+        from maskclustering_tpu.analysis import lock_sanitizer
+
+        lock_sanitizer.arm(True)
+        # the plan/registry locks already exist (import time) — re-wrap
+        # them in place; per-instance locks arm at creation from here on
+        lock_sanitizer.instrument_known_locks()
     if args.fault_plan:
         faults.set_plan(faults.FaultPlan.from_spec(args.fault_plan))
     # SIGTERM-safe shutdown: the scene loops stop at the next scene

@@ -66,7 +66,7 @@ def extract_mask_features(
     if not pairs:
         return {}
 
-    def load_crops(pair):
+    def load_crops(pair):  # mct-thread: root (pool.map dispatches this on io_workers threads)
         frame_id, mask_id = pair
         rgb_path, seg_path = dataset.get_frame_path(frame_id)
         rgb = _imread_rgb(rgb_path)

@@ -9,6 +9,15 @@ threaded pull serializes host compute instead of overlapping it; use
 ``jax.Array.copy_to_host_async()`` for that (see PROFILE.md, round 5).
 The result or the raised error is re-raised in ``result()`` so failures
 attribute to the consuming stage.
+
+Memory-visibility contract (the mct-threads audit, PR 7): ``_value`` /
+``_exc`` are written strictly BEFORE ``_done.set()`` and read only after
+``_done.wait()`` returns true — the Event's internal lock is the
+happens-before edge, so no additional lock is needed. A consumer whose
+``result(timeout)`` expired calls ``abandon()``: the worker then drops a
+late-arriving value instead of pinning it (and everything it references —
+a whole scene's tensors in the executor's host tail) on the future until
+the wedged native call returns.
 """
 
 from __future__ import annotations
@@ -22,26 +31,59 @@ class DaemonFuture:
 
     def __init__(self, fn: Callable, name: str = "daemon-future"):
         self._done = threading.Event()
+        self._abandoned = threading.Event()
         self._value = None
         self._exc: Optional[BaseException] = None
 
         def work():
             try:
-                self._value = fn()
+                value = fn()
             except BaseException as e:  # noqa: BLE001 — re-raised in result()
-                self._exc = e
+                if self._abandoned.is_set():
+                    self._drop_late()  # an abandoned error is a drop too
+                else:
+                    self._exc = e
+            else:
+                if self._abandoned.is_set():
+                    self._drop_late()
+                else:
+                    self._value = value
             finally:
                 self._done.set()
 
-        threading.Thread(target=work, daemon=True, name=name).start()
+        threading.Thread(  # mct-thread: abandon(one-shot daemon worker: result(timeout) bounds the consumer's wait and abandon() drops a late value; a join would re-create the shutdown stall this class exists to avoid)
+            target=work, daemon=True, name=name).start()
+
+    @staticmethod
+    def _drop_late() -> None:
+        """Book an abandoned-result drop. ``faults._count`` owns the
+        never-fault lazy-obs-import semantics (one copy to maintain);
+        faults is stdlib-only at import, so this module stays chip-free
+        for bench.py's supervisor."""
+        from maskclustering_tpu.utils.faults import _count
+
+        _count("run.abandoned_results")
+
+    def abandon(self) -> None:
+        """Declare this future's consumer gone (its ``result`` timed out).
+
+        The worker cannot be cancelled — only outwaited — but a value it
+        produces after this call is dropped immediately instead of living
+        on the future for the daemon thread's remaining lifetime.
+        """
+        self._abandoned.set()
+
+    def done(self) -> bool:
+        """Non-blocking completion probe."""
+        return self._done.is_set()
 
     def result(self, timeout: Optional[float] = None):
         """Block for the value (re-raising the worker's error).
 
         ``timeout`` (seconds) raises ``TimeoutError`` when the worker has
         not finished in time — the fault layer's host-tail watchdog turns
-        that into a typed ``DeviceStallError`` and abandons this thread
-        (daemon: it can never stall shutdown).
+        that into a typed ``DeviceStallError``, calls ``abandon()``, and
+        leaves this thread behind (daemon: it can never stall shutdown).
         """
         if not self._done.wait(timeout):
             raise TimeoutError(

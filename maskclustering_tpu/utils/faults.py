@@ -47,6 +47,11 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Set
 
+# stdlib-only (the shim returns a raw threading.Lock unless the sanitizer
+# is armed); the literal names are the shared vocabulary between the
+# static lock-order graph and the runtime-observed one
+from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+
 log = logging.getLogger("maskclustering_tpu")
 
 # seams a FaultPlan can target; these are the places run.py / models/
@@ -149,18 +154,33 @@ def call_with_deadline(fn: Callable, budget_s: float, *, seam: str = "device",
         return fn()
     box: Dict[str, object] = {}
     done = threading.Event()
+    abandoned = threading.Event()
 
     def work():
+        # a call that finishes AFTER the deadline expired is abandoned
+        # work: drop the value on the floor immediately (and count it)
+        # instead of parking it — and the scene tensors it references —
+        # in `box` for the rest of the daemon thread's life
         try:
-            box["value"] = fn()
+            value = fn()
         except BaseException as e:  # noqa: BLE001 — re-raised below
-            box["error"] = e
+            if abandoned.is_set():
+                _count("run.abandoned_results")
+            else:
+                box["error"] = e
+        else:
+            if abandoned.is_set():
+                _count("run.abandoned_results")
+            else:
+                box["value"] = value
         finally:
             done.set()
 
-    threading.Thread(target=work, daemon=True,
-                     name=f"watchdog-{seam}-{scene}").start()
+    worker = threading.Thread(  # mct-thread: abandon(a wedged native call can only be outwaited, never cancelled; the daemon flag keeps it off the shutdown path and the `abandoned` event drops its late result)
+        target=work, daemon=True, name=f"watchdog-{seam}-{scene}")
+    worker.start()
     if not done.wait(budget_s):
+        abandoned.set()
         _count("run.device_stalls")
         raise DeviceStallError(seam, scene, budget_s)
     if "error" in box:
@@ -191,7 +211,7 @@ class Heartbeat:
         self.budget_s = budget_s
         self.seam = seam
         self.scene = scene
-        self._lock = threading.Lock()
+        self._lock = mct_lock("faults.Heartbeat._lock")
         self._last = time.monotonic()
 
     def beat(self) -> None:
@@ -342,7 +362,7 @@ class _FaultEntry:
         self.seam = seam
         self.scene = scene
         self.remaining = count  # None = every attempt
-        self.lock = threading.Lock()
+        self.lock = mct_lock("faults._FaultEntry.lock")
 
     def take(self) -> bool:
         """Consume one firing; False once the count is exhausted."""
@@ -458,7 +478,7 @@ class FaultPlan:
 
 _PLAN: Optional[FaultPlan] = None
 _PLAN_LOADED = False
-_PLAN_LOCK = threading.Lock()
+_PLAN_LOCK = mct_lock("faults._PLAN_LOCK")
 
 
 def active_plan() -> Optional[FaultPlan]:
@@ -496,18 +516,46 @@ def inject(seam: str, scene: Optional[str]) -> None:
 
 _STOP = threading.Event()
 _STOP_REASON = ""
+_STOP_ANNOUNCED = threading.Event()
 
 
-def request_stop(reason: str = "") -> None:
+def _set_stop(reason: str) -> None:
+    """Flag-only stop: Event + string assignment, nothing else.
+
+    This is the whole async-signal-safe surface — the SIGTERM handler
+    calls it mid-anything, so it must not log (the interrupted thread may
+    hold the logging module's lock), allocate containers, or do IO
+    (CONC.SIGNAL, analysis/concurrency.py). The announcement is deferred
+    to the first ``stop_requested()`` poll on a normal thread.
+    """
     global _STOP_REASON
     if not _STOP.is_set():
         _STOP_REASON = reason
-        log.warning("stop requested%s: finishing in-flight scenes, "
-                    "journaling the rest", f" ({reason})" if reason else "")
     _STOP.set()
 
 
+def _announce_stop() -> None:
+    """One-shot stop warning, from a NORMAL thread only (never the
+    handler). The check-then-set is not atomic — two first polls can in
+    principle both announce — but the worst case is a duplicate log line,
+    accepted for a lock-free poll path."""
+    if not _STOP_ANNOUNCED.is_set():
+        _STOP_ANNOUNCED.set()
+        log.warning("stop requested%s: finishing in-flight scenes, "
+                    "journaling the rest",
+                    f" ({_STOP_REASON})" if _STOP_REASON else "")
+
+
+def request_stop(reason: str = "") -> None:
+    _set_stop(reason)
+    _announce_stop()
+
+
 def stop_requested() -> bool:
+    # the deferred half of the handler's contract: the first scene-boundary
+    # poll after a signal announces the stop from a safe (normal) thread
+    if _STOP.is_set():
+        _announce_stop()
     return _STOP.is_set()
 
 
@@ -518,6 +566,7 @@ def stop_reason() -> str:
 def clear_stop() -> None:
     global _STOP_REASON
     _STOP.clear()
+    _STOP_ANNOUNCED.clear()
     _STOP_REASON = ""
 
 
@@ -533,7 +582,7 @@ def install_sigterm_handler() -> Callable:
     def _handler(signum, frame):  # noqa: ARG001 — signal API shape
         if _STOP.is_set():
             os._exit(143)  # second signal: the polite path already ran
-        request_stop(f"signal {signum}")
+        _set_stop(f"signal {signum}")  # flag-only; logging is deferred
 
     return signal.signal(signal.SIGTERM, _handler)
 

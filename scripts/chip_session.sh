@@ -154,7 +154,15 @@ run dtype_census 900 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.obs.cost
 # a recovery window should be read in mct_check.out after the capture, not
 # cost chip minutes; scripts/ci.sh is where the same check is fatal
 run mct_check 120 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.analysis \
-  --events "$OUT/analysis_events.jsonl"
+  --families ast,ir --events "$OUT/analysis_events.jsonl"
+# mct-threads: the concurrency family on its own (thread topology, lock
+# order, blocking-under-lock, signal/join contracts) — pure stdlib AST,
+# no compiles, so its verdict is one grep away in conc_check.out even
+# when the full mct_check above timed out mid-lattice; fatal in ci.sh.
+# Its OWN events file: obs.report renders only the newest analysis run
+# per file, so appending here would mask the full run's IR/AST findings
+run conc_check 60 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.analysis \
+  --families concurrency --events "$OUT/conc_events.jsonl"
 # perf ledger: render the trajectory the bench steps above just appended
 # to, and gate against the last committed good verdict when present
 if [ -f BENCH_builder_r05.json ]; then

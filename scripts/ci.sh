@@ -20,6 +20,11 @@
 #    donation aliasing/wiring, collective budgets, host-sync/thread lint —
 #    against analysis_baseline.json, CPU-only, budgeted under 90 s
 #    (MCT_CHECK=0 skips). FATAL: an unsuppressed finding fails CI.
+# 3b. runs the mct-check CONCURRENCY family as its own gate (distinct
+#    exit code 5, so triage points at thread safety, not dtype/sync):
+#    thread topology, shared-state reachability, lock-order acyclicity,
+#    blocking-under-lock, signal-handler and join/abandon contracts —
+#    pure stdlib AST, sub-5 s (MCT_CHECK=0 skips this too). FATAL.
 # 4. runs ruff (the style/correctness front-end pinned in pyproject.toml)
 #    when the PINNED version is installed (fatal); an unpinned ruff runs
 #    advisory-only — a floating linter's new rules must not flip CI red,
@@ -33,8 +38,9 @@
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
 # Exits non-zero on test failures (1), a fault-matrix failure (3), an
-# mct-check finding or ruff violation (4), or a perf regression (2), so it
-# gates correctness, fault tolerance, the invariants AND the trajectory.
+# mct-check finding or ruff violation (4), a concurrency-family finding
+# (5), or a perf regression (2), so it gates correctness, fault
+# tolerance, the invariants, thread safety AND the trajectory.
 # Every gate still RUNS after a failure, but the exit code is the FIRST
 # failing gate's — triage by exit code points at the right gate.
 set -u -o pipefail
@@ -75,10 +81,18 @@ fi
 if [ "${MCT_CHECK:-1}" != "0" ]; then
     echo "== ci: mct-check static invariant gate (IR + AST, CPU, <90s) =="
     if ! timeout -k 10 90 env JAX_PLATFORMS=cpu \
-            python -m maskclustering_tpu.analysis; then
+            python -m maskclustering_tpu.analysis --families ast,ir; then
         echo "ci: mct-check FAILED (fix the finding at its file:line, or" \
              "baseline it in analysis_baseline.json with a justification)" >&2
         fail 4
+    fi
+    echo "== ci: mct-check concurrency gate (thread topology + lock order, <30s) =="
+    if ! timeout -k 10 30 env JAX_PLATFORMS=cpu \
+            python -m maskclustering_tpu.analysis --families concurrency; then
+        echo "ci: mct-check concurrency FAILED (fix the thread-safety" \
+             "finding, annotate with # mct-thread:, or baseline it in" \
+             "analysis_baseline.json with a justification)" >&2
+        fail 5
     fi
 fi
 

@@ -34,3 +34,24 @@ def _hermetic_perf_ledger(tmp_path, monkeypatch):
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def fused_lattice_aot():
+    """ONE AOT sweep of the fused step over the divisor lattice of 8,
+    at the analyzer's canonical shape.
+
+    test_cost.py (collective/dot census assertions) and test_analysis.py
+    (the IR invariant gate) used to each perform their own fused-step
+    lowering+compile sweep; session-scoping the sweep here pays the
+    compiles once per tier-1 run. ``keep_texts`` attaches the StableHLO /
+    optimized-HLO text per row so ``analyze_ir(lowerings=...)`` reads the
+    same programs the cost rows describe.
+    """
+    from maskclustering_tpu.analysis.ir_checks import CANONICAL_SHAPE, LATTICE
+    from maskclustering_tpu.obs.cost import observe_costs
+
+    rows = observe_costs(LATTICE, stages=("fused",), keep_texts=True,
+                         **CANONICAL_SHAPE)
+    assert len(rows) == len(LATTICE), "every lattice mesh must fit the 8 devices"
+    return {tuple(r["mesh"]): r for r in rows}

@@ -304,16 +304,34 @@ def fault_run(tmp_path_factory):
     events = os.path.join(root, "flt_events.jsonl")
     report_path = os.path.join(root, "flt_report.json")
     faults.set_plan(plan)
+    # the faulted run doubles as the lock-sanitizer acceptance drive
+    # (MCT_LOCK_SANITIZER=1): plan locks, watchdogs, the overlapped
+    # executor's metrics bumps and the journal sink all acquire under
+    # instrumentation, and the observed order graph is snapped for the
+    # embeds-in-static cross-check — one expensive 4-scene run, two gates
+    from maskclustering_tpu.analysis import lock_sanitizer
+
+    os.environ[lock_sanitizer.ENV_FLAG] = "1"
+    lock_sanitizer.arm(True)
+    lock_sanitizer.reset()
+    undo_locks = lock_sanitizer.instrument_known_locks()
     try:
         flt = run_pipeline(
             _cfg(root, config_name="flt", watchdog_device_s=WATCHDOG_S),
             SCENES, steps=("cluster",), resume=False,
             report_path=report_path, obs_events=events, ledger=False)
     finally:
+        lock_edges = lock_sanitizer.observed_edges()
+        lock_report = lock_sanitizer.report()
+        undo_locks()
+        lock_sanitizer.arm(None)
+        os.environ.pop(lock_sanitizer.ENV_FLAG, None)
+        lock_sanitizer.reset()
         faults.set_plan(None)
         obs.disable()
     return {"root": root, "ref": ref, "flt": flt, "events": events,
             "report_path": report_path,
+            "lock_edges": lock_edges, "lock_report": lock_report,
             "journal": os.path.join(root, "run_journal.jsonl")}
 
 
@@ -415,6 +433,27 @@ def test_acceptance_obs_faults_surfaces(fault_run):
     assert counters["run.degradations.sequential-executor"] == 1
     assert counters["faults.injected.load"] == 3  # one per attempt
     assert counters["faults.injected.device"] == 3  # 1 stall + 2 flaky
+
+
+def test_acceptance_lock_sanitizer_embeds_in_static_graph(fault_run):
+    """The concurrency-family cross-check: the lock acquisition orders
+    OBSERVED while the canned 4-scene fault plan ran under
+    MCT_LOCK_SANITIZER=1 must embed in the STATIC lock-order graph — an
+    observed edge the AST cannot see is exactly the deadlock surface the
+    sanitizer exists for (and the Faults section renders the digest)."""
+    from maskclustering_tpu.analysis.concurrency import build_lock_order_graph
+    from maskclustering_tpu.analysis.lock_sanitizer import check_embeds
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = fault_run["lock_report"]
+    # the sanitizer was live: the faulted run's plan lock, per-entry fire
+    # locks, watchdog heartbeat and metrics registry all acquired under it
+    assert sum(report["acquisitions"].values()) > 0
+    assert "faults._PLAN_LOCK" in report["acquisitions"]
+    assert "obs.metrics.Registry._lock" in report["acquisitions"]
+    nodes, static_edges = build_lock_order_graph(repo_root)
+    violations = check_embeds(fault_run["lock_edges"], static_edges, nodes)
+    assert violations == [], "\n".join(violations)
 
 
 # ---------------------------------------------------------------------------

@@ -265,6 +265,37 @@ class TestDaemonFuture:
         with pytest.raises(OSError, match="disk gone"):
             fut.result()
 
+    def test_abandoned_late_result_and_error_are_dropped(self):
+        """After a timed-out consumer calls abandon(), a late value (or a
+        late error) is dropped instead of living on the future — and the
+        drop is booked as run.abandoned_results (rendered in Faults)."""
+        import threading
+
+        from maskclustering_tpu.obs import metrics
+        from maskclustering_tpu.utils.daemon_future import DaemonFuture
+
+        before = metrics.registry().snapshot()["counters"].get(
+            "run.abandoned_results", 0.0)
+        for outcome in ("value", "error"):
+            gate = threading.Event()
+
+            def wedged(kind=outcome):
+                gate.wait(5.0)
+                if kind == "error":
+                    raise OSError("late failure")
+                return {"big": "scene tensors"}
+
+            fut = DaemonFuture(wedged, name=f"late-{outcome}")
+            with pytest.raises(TimeoutError):
+                fut.result(timeout=0.02)
+            fut.abandon()
+            gate.set()
+            assert fut._done.wait(5.0)
+            assert fut._value is None and fut._exc is None  # dropped
+        after = metrics.registry().snapshot()["counters"].get(
+            "run.abandoned_results", 0.0)
+        assert after - before == 2.0
+
     def test_runs_on_daemon_thread(self):
         """The whole point vs ThreadPoolExecutor: an abandoned blocking load
         must never stall interpreter shutdown."""
