@@ -106,6 +106,10 @@ def _emit(args, times, error=None, stage_timings=None):
     # the int16 claim-plane layout era in the trajectory
     line["count_dtype"] = getattr(args, "count_dtype", "bf16")
     line["plane_dtype"] = "int16"
+    # same for the post-process path: the --host-postprocess A/B knob must
+    # be attributable in the trajectory (obs.report --regress flags flips)
+    line["postprocess_path"] = (
+        "host" if getattr(args, "host_postprocess", False) else "device")
     if getattr(args, "obs_events", None) and not getattr(args, "no_obs", False):
         # point the record at its own span stream (report CLI renders it)
         line["obs_events"] = args.obs_events
@@ -271,6 +275,15 @@ def _build_parser():
                         "with half the operand bytes; artifacts are byte-"
                         "identical either way (the chip A/B decides the "
                         "default)")
+    p.add_argument("--host-postprocess", action="store_true",
+                   help="A/B knob: run the host numpy post-process "
+                        "(device_postprocess=False) instead of the "
+                        "device-resident split/merge kernels with the "
+                        "emit-only drain. Artifacts are byte-identical "
+                        "either way (tests/test_postprocess_device.py); "
+                        "the verdict line and ledger row stamp "
+                        "postprocess_path so --regress attributes the "
+                        "flip, not code drift")
     p.add_argument("--frame-batch", type=_positive_int, default=1,
                    help="association_frame_batch (frames vectorized per "
                         "association-scan step; A/B knob. Results are "
@@ -352,6 +365,8 @@ def _supervise(args):
         # the A/B attribution the worker would have stamped
         line.setdefault("count_dtype", args.count_dtype)
         line.setdefault("plane_dtype", "int16")
+        line.setdefault("postprocess_path",
+                        "host" if args.host_postprocess else "device")
         return line
 
     def _on_term(signum, frame):
@@ -576,7 +591,8 @@ def main():
                          distance_threshold=args.distance_threshold,
                          few_points_threshold=25, point_chunk=8192,
                          association_frame_batch=args.frame_batch,
-                         count_dtype=args.count_dtype)
+                         count_dtype=args.count_dtype,
+                         device_postprocess=not args.host_postprocess)
 
     times = []
     stage_timings = []

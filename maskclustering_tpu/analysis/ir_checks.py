@@ -59,8 +59,11 @@ WIDE_DOT_CLASSES = frozenset({"f32xf32->f32"})
 # dispatch regressing to a raw f32 dot grows this census and fails here
 EXPECTED_WIDE_DOTS = 3
 
-# run_scene_device's host-sync contract (models/pipeline.py, PR 3)
-EXPECTED_HOST_SYNCS = 2
+# run_scene_device's host-sync contract (models/pipeline.py): exactly ONE
+# mid-program crossing — the mask-table bucket pull. The assignment pull
+# (historical sync 2/2) moved on device with the device-resident
+# post-process (models/postprocess_device.py, PR 8)
+EXPECTED_HOST_SYNCS = 1
 
 # scene-DP collective budget: two 1-byte pred[] while-loop predicates
 # (MESH_BENCH.md "Pure scene-DP moves 2 bytes across chips")
@@ -380,7 +383,7 @@ def _lower_groupcounts(shape: Dict):
     sds = jax.ShapeDtypeStruct
     return _mask_group_counts_kernel_donating.lower(
         sds((f, n), jnp.int16), sds((f, n), jnp.int16),
-        sds((1024,), jnp.int32), sds((1024,), jnp.int32),
+        sds((n, 128), jnp.bfloat16),
         sds((m_pad,), jnp.int32), sds((m_pad,), jnp.int32),
         sds((m_pad,), jnp.int32), k2=k2, s_pad=128,
         count_dtype="bf16")

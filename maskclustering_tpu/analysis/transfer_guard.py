@@ -10,9 +10,10 @@ implicit transfer becomes a hard ``XlaRuntimeError`` at the offending
 line — on CPU, in CI, before a chip ever sees it.
 
 Opt-in via ``run.py --transfer-guard`` or ``MCT_TRANSFER_GUARD=1``; the
-two sanctioned host pulls of the pipeline (mask table, assignment) open a
-``sanctioned_pull`` window that restores ``allow`` — the guard verifies
-the 2-sync contract's COMPLEMENT: nothing else crosses.
+single sanctioned host pull of the pipeline (the mask table — the
+assignment pull moved on device with the device-resident post-process)
+opens a ``sanctioned_pull`` window that restores ``allow`` — the guard
+verifies the 1-sync contract's COMPLEMENT: nothing else crosses.
 
 jax's transfer guard is thread-local, so guarding the device phase on the
 dispatch thread never constrains the overlapped executor's host-tail

@@ -88,10 +88,30 @@ class PipelineConfig:
     # parity mode: run the reference's ball-query association
     # (models/exact_backprojection.py) instead of projective association
     use_exact_ball_query: bool = False
-    # post-process claim/ratio/mask-assign statistics on device (bit-packed
-    # transfers) instead of pulling the (F, N) tensors to host numpy; both
-    # paths produce byte-identical artifacts (tests/test_postprocess_device.py)
+    # post-process entirely on device (routing prep, claim statistics, grid
+    # DBSCAN split, group structures, mask assignment, overlap-merge
+    # intersection counts) with an emit-only drain — the (F, N) claim
+    # planes are consumed in HBM, never pulled, and the only transfer is
+    # the final compact instance planes. False = the host numpy path
+    # (reference-shaped; also the degradation ladder's fallback rung).
+    # Both paths produce byte-identical artifacts
+    # (tests/test_postprocess_device.py)
     device_postprocess: bool = True
+    # capacity ceiling of the device post-process's global DBSCAN-group
+    # axis (groups = per-instance spatial components + one noise slot
+    # each). The compiled group width is the pow2 bucket of the TRUE
+    # total (pulled with the per-rep root counts), so this knob never
+    # costs matmul lanes; a scene splitting into more groups raises
+    # PostprocessCapacityError (device-class) and the ladder's
+    # host-postprocess rung re-runs it on the host path. 512 leaves ~10x
+    # headroom over the honest bench scene
+    post_group_cap: int = 512
+    # static per-pair neighbor window of the device grid-DBSCAN split
+    # (same-instance in-eps neighbors per point, prefix-sum packed).
+    # Overflow drops hits, so the kernel flags it and the drain raises
+    # PostprocessCapacityError -> host-postprocess rung, like the group
+    # cap; 256 covers eps-ball occupancies ~5x the honest bench scene's
+    post_neighbor_cap: int = 256
     # (scene, frame) device-mesh factorization for the fused multi-chip path
     # (parallel/batch.py); empty = single-device host pipeline
     mesh_shape: Tuple[int, ...] = ()
@@ -111,8 +131,9 @@ class PipelineConfig:
     # buffers free in time for scene N+1's dispatch at the same shape bucket
     donate_buffers: bool = True
     # rows per chunked bit-plane device->host pull in the post-process
-    # claims drain (0 = one blocking pull); chunks stream via
-    # copy_to_host_async so unpack overlaps the next chunk's DMA
+    # emit drain (the surviving objects' packed point planes; 0 = one
+    # blocking pull); chunks stream via copy_to_host_async so unpack
+    # overlaps the next chunk's DMA
     claims_pull_chunk: int = 64
 
     # --- fault tolerance (run.py scene supervisor + utils/faults.py) ---
@@ -169,6 +190,13 @@ class PipelineConfig:
         if self.claims_pull_chunk < 0:
             raise ValueError(
                 f"claims_pull_chunk must be >= 0, got {self.claims_pull_chunk}")
+        if self.post_group_cap < 1:
+            raise ValueError(
+                f"post_group_cap must be >= 1, got {self.post_group_cap}")
+        if self.post_neighbor_cap < 1:
+            raise ValueError(
+                f"post_neighbor_cap must be >= 1, "
+                f"got {self.post_neighbor_cap}")
         if self.scene_retries < 0:
             raise ValueError(
                 f"scene_retries must be >= 0, got {self.scene_retries}")

@@ -2,21 +2,21 @@
 
 The TPU analog of the reference's per-scene entry (main.py:9-21). Device
 stages run under jit with static, bucket-padded shapes. The per-scene
-pipeline crosses to host exactly TWICE:
+pipeline crosses to host exactly ONCE mid-program:
 
 1. the mask table — compact indices of valid masks materialize at the top
    of the graph stage (the pull drains the associate dispatch; the table's
-   M_pad bucket is data-dependent, so this crossing is irreducible);
-2. the final cluster assignment — the host prep of the post-process
-   (live-rep routing tables) needs it.
+   M_pad bucket is data-dependent, so this crossing is irreducible).
 
-The observer-percentile schedule, historically a third mid-pipeline host
-round-trip (a 20-float pull + float64 interpolation), is computed on
-device (`observer_schedule_device`, same formulation the fused mesh path
-has always used) so graph -> schedule -> clustering dispatches as one
-uninterrupted device program chain. Each host crossing is marked with a
-``host_pull`` span attr and counted on ``pipeline.host_sync`` — the
-fence-count budget is pinned by tests/test_executor.py.
+Two historical mid-program crossings are gone: the observer-percentile
+schedule computes on device (`observer_schedule_device`, PR 3), and the
+cluster assignment — formerly pulled for the post-process's host routing
+prep — now stays device-resident end to end: the device post-process
+(models/postprocess_device.py) consumes it in HBM and only the final
+compact instance planes drain to host. The remaining crossing is marked
+with a ``host_pull`` span attr and counted on ``pipeline.host_sync`` —
+the fence-count budget (exactly 1 per scene) is pinned by
+tests/test_executor.py and the mct-check IR.SYNC gates.
 
 The pipeline is split into a **device phase** (`run_scene_device`) and a
 **host phase** (`run_scene_host`) joined by an explicit `DeviceHandoff`,
@@ -66,10 +66,10 @@ class SceneResult(NamedTuple):
 class DeviceHandoff(NamedTuple):
     """Everything the host phase needs from the device phase of one scene.
 
-    The contract: ``assignment`` is HOST-resident (the second and last
-    pipeline host sync produced it); ``first_id``/``last_id``/
-    ``node_visible``/``active`` stay DEVICE-resident — the post-process
-    claim kernels consume them in HBM, and only bit-packed planes cross
+    The contract: EVERY tensor stays DEVICE-resident — ``assignment``
+    (since the drain restructure took host syncs 2 -> 1), ``first_id``/
+    ``last_id``/``node_visible``/``active`` — the post-process kernels
+    consume them in HBM, and only the final compact instance planes cross
     back. A handoff therefore pins ~2 x (F, N) int16 of HBM (halved from
     the historical int32 planes) until its host phase finishes; the
     overlapped executor bounds the number of live handoffs to one (double
@@ -77,7 +77,7 @@ class DeviceHandoff(NamedTuple):
     """
 
     table: MaskTable
-    assignment: np.ndarray  # (M_pad,) int32, host
+    assignment: jnp.ndarray  # (M_pad,) int32, device
     active: jnp.ndarray  # (M_pad,) bool, device — valid & not undersegmented
     node_visible: jnp.ndarray  # (M_pad, F) bool, device
     first_id: jnp.ndarray  # (F, N) int16, device
@@ -181,17 +181,21 @@ def run_scene_device(tensors: SceneTensors, cfg: PipelineConfig, *,
     the associate span then measures dispatch and the graph span absorbs
     the associate drain (arm obs for exact attribution).
 
-    Exactly two host syncs per scene, both marked with a ``host_pull``
-    span attr and counted on ``pipeline.host_sync``:
+    Exactly ONE host sync per scene, marked with a ``host_pull`` span attr
+    and counted on ``pipeline.host_sync``:
 
-    - graph start: the mask-valid table materializes (drains associate);
-    - cluster end: the final assignment vector.
+    - graph start: the mask-valid table materializes (drains associate).
+
+    The cluster assignment no longer syncs here — it rides the handoff as
+    a device array and the device post-process consumes it in HBM (its
+    routing prep moved on device), so graph -> schedule -> clustering ->
+    post-process is one uninterrupted dispatch chain after the mask table.
 
     Under ``--transfer-guard`` / ``MCT_TRANSFER_GUARD`` (the Family-3
     sanitizer, analysis/transfer_guard.py) the whole phase runs inside
-    ``jax.transfer_guard("disallow")`` with only the two pulls above
-    opened as sanctioned windows — any OTHER implicit transfer raises at
-    its source line. Off by default; results are identical either way
+    ``jax.transfer_guard("disallow")`` with only the pull above opened as
+    a sanctioned window — any OTHER implicit transfer raises at its
+    source line. Off by default; results are identical either way
     (pinned by tests/test_analysis.py).
     """
     with device_phase_guard():
@@ -238,7 +242,7 @@ def _run_scene_device_impl(tensors: SceneTensors, cfg: PipelineConfig, *,
     timings["associate"] = sp.duration
 
     with tracer.span("graph", scene=seq_name) as sp:
-        # host sync 1/2: the compact mask table's M_pad bucket is
+        # host sync 1/1: the compact mask table's M_pad bucket is
         # data-dependent, so the valid table must materialize before the
         # graph program can be dispatched. A wedged chip stalls exactly
         # here (the drain never completes) — the pull is an injection
@@ -281,15 +285,11 @@ def _run_scene_device_impl(tensors: SceneTensors, cfg: PipelineConfig, *,
             view_consensus_threshold=cfg.view_consensus_threshold,
             count_dtype=cfg.count_dtype,
         )
-        # host sync 2/2: the assignment vector feeds the host-side live-rep
-        # prep of the post-process (same injection seam + device-phase
-        # stall bound as the first pull)
-        faults.inject("pull", seq_name)
-        with sanctioned_pull("assignment"):
-            assignment = np.asarray(sp.sync(result.assignment))
-        obs.count("pipeline.host_sync")
-        sp.set(host_pull="assignment")
-        obs.count_transfer("d2h", assignment.nbytes, "cluster")
+        # NO host sync here anymore: the assignment stays device-resident
+        # (the post-process's routing prep runs on device, and the host
+        # copy for reporting rides the post-process's final drain). The
+        # armed-obs fence below is timing attribution only.
+        assignment = sp.sync(result.assignment)
     timings["cluster"] = sp.duration
 
     return DeviceHandoff(
@@ -325,7 +325,14 @@ def run_scene_host(handoff: DeviceHandoff, cfg: PipelineConfig, *,
             cfg, handoff.scene_points, handoff.first_id, handoff.last_id,
             handoff.table.frame, handoff.table.mask_id, handoff.active,
             handoff.assignment, handoff.node_visible, handoff.frame_ids,
-            k_max=handoff.k_max, timings=post_timings, n_real=handoff.n_real)
+            k_max=handoff.k_max, timings=post_timings, n_real=handoff.n_real,
+            seq_name=seq_name)
+        # the report/SceneResult copy of the assignment rides the tail of
+        # the post-process drain: every device kernel has retired by now,
+        # so this O(M_pad) pull costs one small DMA, not a pipeline stall
+        with obs.span("post.assignment.pull"):
+            assignment = np.asarray(handoff.assignment)
+        obs.count_transfer("d2h", assignment.nbytes, "post.drain")
     timings["postprocess"] = sp.duration
     for k, v in post_timings.items():
         # phase wall times measured by the postprocess _PhaseTimer become
@@ -346,7 +353,7 @@ def run_scene_host(handoff: DeviceHandoff, cfg: PipelineConfig, *,
     log.info("scene %s: %d objects, timings %s", seq_name, len(objects.point_ids_list),
              {k: round(v, 3) for k, v in timings.items()})
     return SceneResult(objects=objects, table=handoff.table,
-                       assignment=handoff.assignment, timings=timings)
+                       assignment=assignment, timings=timings)
 
 
 def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int] = None,

@@ -278,6 +278,39 @@ def _merge_overlapping(point_ids_list, bbox_list, mask_list, overlap_ratio: floa
     return [point_ids_list[k] for k in keep], [mask_list[k] for k in keep]
 
 
+def merge_from_counts(point_ids_list, bbox_list, mask_list, sizes, inter,
+                      overlap_ratio: float):
+    """`_merge_overlapping` with precomputed intersection counts.
+
+    The device post-process computes ``inter[i, j] = |points_i ∩ points_j|``
+    as one mask×mask counting matmul on device (the O(objects² × N) work);
+    this host scan replays the reference's greedy suppression over those
+    exact integers — scan order, the first-passing-test-wins asymmetry and
+    the f64 ratio comparisons are all byte-identical to the set-based
+    loop above (pinned by tests/test_postprocess_device.py).
+    """
+    num = len(point_ids_list)
+    dead = np.zeros(num, dtype=bool)
+    for i in range(num):
+        if dead[i]:
+            continue
+        for j in range(i + 1, num):
+            if dead[j]:
+                continue
+            (imin, imax), (jmin, jmax) = bbox_list[i], bbox_list[j]
+            if not bboxes_overlap(imin, imax, jmin, jmax):
+                continue
+            x = int(inter[i, j])
+            if x / max(int(sizes[i]), 1) > overlap_ratio:
+                dead[i] = True
+                # no break: the reference keeps scanning j with dead i, and a
+                # later j can still die via the elif branch
+            elif x / max(int(sizes[j]), 1) > overlap_ratio:
+                dead[j] = True
+    keep = [k for k in range(num) if not dead[k]]
+    return [point_ids_list[k] for k in keep], [mask_list[k] for k in keep]
+
+
 def representative_masks(mask_info_list: List[Tuple], top_k: int = 5) -> List[Tuple]:
     """Top-k masks by object coverage (reference post_process.py:126-128)."""
     return sorted(mask_info_list, key=lambda t: t[2], reverse=True)[:top_k]

@@ -1,4 +1,4 @@
-"""Device-side post-process: node/claim statistics as on-TPU tensor passes.
+"""Device-resident post-process: split + merge as on-TPU tensor passes.
 
 The host post-process (models/postprocess.py) reproduces the reference's
 pipeline (reference utils/post_process.py:40-170) with vectorized numpy over
@@ -7,30 +7,48 @@ COO claim structures — but building those structures requires pulling the
 scene) and running multi-million-row nonzero/sort passes on host. At bench
 scale that is 12-16 s/scene, the dominant pipeline cost.
 
-Everything except the per-object DBSCAN split is segment arithmetic over
-tensors the device already holds, so this module keeps it there:
+Since the claims-drain restructure, EVERYTHING up to the final compact
+instances runs on device and the claim planes are consumed in HBM — the
+drain is emit-only:
 
+- ``_prep_kernel``: the live-representative routing tables (historically
+  host prep over a pulled assignment vector) as device scatters; the
+  live-rep axis is sized by the 4-byte ``_live_count_kernel`` scalar pull
+  — so the cluster assignment never crosses to host mid-pipeline and
+  ``pipeline.host_sync`` drops to 1.
 - ``_node_stats_kernel``: one lax.scan over frames accumulates, for every
-  (live representative r, point p): ``claimed`` (p is a node point of r),
-  ``num`` (frames where p is claimed by a node mask with node-visibility,
-  the OVIR detection-ratio numerator, reference post_process.py:56-76) and
-  ``den`` (node-visible frames where p is visible at all). Each frame is
-  one (2R, k2) @ (k2, N) MXU matmul of local-id one-hots against per-frame
-  rep-weight rows (no scatters, no gathers from large tables — both slow
-  on TPU, measured in scripts/micro_tpu.py); ``den`` is a single
-  (R, F) @ (F, N) matmul outside the scan.
-- results return as bit-packed uint8 planes (8x smaller transfer).
-- host runs DBSCAN per representative on the compact node point lists
-  (reference post_process.py:104-123 uses Open3D's C++ DBSCAN on host too)
-  and uploads a compact (point id, global group) list back.
-- ``_mask_group_counts_kernel``: a second scan over frames counts each
-  mask's claims per DBSCAN group via (K, N) x (N, S) matmuls on the MXU and
-  reduces to the best group + count per mask on device, replacing the
-  reference's per-(mask x group) intersect1d loop (post_process.py:~150).
+  (live representative r, point p): ``claimed`` (p is a node point of r)
+  and the OVIR detection-ratio test (reference post_process.py:56-76) as
+  (2R, C*k2) @ (C*k2, N) MXU matmuls (ops/counting.py dispatch).
+- ``_dbscan_split_kernel`` (ops/grid_dbscan.py): the node point sets of
+  every live representative split on device by the voxel-grid min-label
+  kernel — the same grid/union-find algorithm as the native C++ host
+  path, with the grid built host-side from the (host-resident) cloud and
+  the candidate window static-shape bucketed per scene.
+- ``_group_structs_kernel`` derives every group structure (sizes,
+  membership planes, bounding boxes, per-mask group ranges) as segment
+  scatters at the pow2 bucket of the pulled group total;
+  ``_mask_group_counts_kernel`` assigns each mask to its best group via
+  (k2, N) x (N, S) MXU matmuls, donating the (F, N) claim planes (their
+  last consumer).
+- ``overlap merge``: the pairwise |i and j| containment counts become ONE
+  device mask x mask ``count_dot`` over the surviving objects' bit-planes
+  (``_survivor_gather_kernel``); only the greedy threshold scan — O(objects
+  squared) trivial work whose f64 ratio comparisons must match the
+  reference bit-for-bit — stays host, consuming the pulled count matrix.
 
-Net device->host traffic: ~2 x R_pad x N/8 bytes + O(M_pad) scalars instead
-of 2-3 (F, N) claim tensors (int16 since the plane narrowing — the
-non-device path's pull halved along with the HBM residency).
+Net device->host traffic per scene: the final compact instance bit-planes
+plus O(M_pad + S) scalars. No (F, N) plane and no (R, N) claim plane is
+ever pulled on this path (span-pinned by tests/test_postprocess_device.py);
+byte-identity with the host path remains the acceptance bar.
+
+Capacity: ``cfg.post_group_cap`` caps the global group total and
+``cfg.post_neighbor_cap`` the per-pair neighbor window (the compiled
+group width itself is the pow2 bucket of the true total — the ceiling
+never costs matmul lanes). A scene that overflows either raises
+``PostprocessCapacityError`` (classified device-class), and the
+degradation ladder's host-postprocess rung is the fallback — the scene
+retries on the host path instead of exporting truncated groups.
 """
 
 from __future__ import annotations
@@ -50,23 +68,52 @@ suppress_unusable_donation_warning()
 
 from maskclustering_tpu import obs
 from maskclustering_tpu.ops import counting
+from maskclustering_tpu.ops.grid_dbscan import (
+    _bucket_pow2,
+    build_grid,
+    grid_dbscan_pairs,
+)
 from maskclustering_tpu.models.postprocess import (
     SceneObjects,
-    _merge_overlapping,
     _PhaseTimer,
+    merge_from_counts,
     postprocess_scene,
 )
-from maskclustering_tpu.ops.dbscan import dbscan_labels_parallel
+
+
+class PostprocessCapacityError(RuntimeError):
+    """The scene overflowed a device post-process capacity bucket.
+
+    Raised at drain time (the group/neighbor scatters already dropped the
+    overflow, so the device results are unusable). Classified as
+    device-class by ``utils/faults.classify_error``: the scene supervisor
+    retries down the degradation ladder until the host-postprocess rung
+    re-runs the scene on the host path — or raise the named knob for good.
+    """
+
+    def __init__(self, what: str, amount: int, cap: int, knob: str):
+        self.what = what
+        self.amount = amount
+        self.cap = cap
+        self.knob = knob
+        over = f"{amount} > {cap}" if amount > 0 else f"over {cap}"
+        super().__init__(
+            f"device postprocess overflowed its {what} bucket ({over}); "
+            f"retry degrades to the host-postprocess rung (or raise "
+            f"cfg.{knob})")
 
 
 def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
                     mask_active, assignment, node_visible, frame_ids, *,
                     k_max: int, timings: Optional[Dict[str, float]] = None,
-                    n_real: Optional[int] = None) -> SceneObjects:
+                    n_real: Optional[int] = None,
+                    seq_name: Optional[str] = None) -> SceneObjects:
     """Single dispatch point for the device/host post-process paths.
 
     Accepts device or host arrays for the large operands; converts to what
-    the selected path needs. Both paths produce byte-identical artifacts.
+    the selected path needs (the device path keeps ``mask_active`` and
+    ``assignment`` device-resident — pulling them was host sync 2/2 before
+    the drain restructure). Both paths produce byte-identical artifacts.
 
     ``n_real``: the scene's true point count when the inputs are padded to a
     shape bucket; enforces the sentinel-pad invariant (no padded point may
@@ -84,14 +131,21 @@ def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
     scene_points = np.asarray(scene_points)
     mask_frame = np.asarray(mask_frame)
     mask_id = np.asarray(mask_id)
-    mask_active = np.asarray(mask_active)
-    assignment = np.asarray(assignment)
     if cfg.device_postprocess:
+        # fault seam: the device post-process chain (utils/faults.FaultPlan);
+        # the host path below deliberately has no seam — it IS the ladder's
+        # fallback rung, and a seam that kept firing there would make the
+        # rung drop unable to heal the scene
+        from maskclustering_tpu.utils import faults
+
+        faults.inject("post", seq_name)
         objects = postprocess_scene_device(
             scene_points, jnp.asarray(first), jnp.asarray(last), mask_frame,
-            mask_id, mask_active, assignment, jnp.asarray(node_visible),
-            frame_ids, pull_chunk=cfg.claims_pull_chunk,
-            donate=cfg.donate_buffers, count_dtype=cfg.count_dtype, **kwargs)
+            mask_id, jnp.asarray(mask_active), jnp.asarray(assignment),
+            jnp.asarray(node_visible), frame_ids,
+            pull_chunk=cfg.claims_pull_chunk, donate=cfg.donate_buffers,
+            count_dtype=cfg.count_dtype, group_cap=cfg.post_group_cap,
+            neighbor_cap=cfg.post_neighbor_cap, n_real=n_real, **kwargs)
     else:
         with obs.span("post.host_pull") as sp:
             # the host path pulls the full (F, N) claim tensors — the very
@@ -105,7 +159,7 @@ def run_postprocess(cfg, scene_points, first, last, mask_frame, mask_id,
                 "postprocess")
         objects = postprocess_scene(
             scene_points, first_h, last_h, first_h > 0, mask_frame,
-            mask_id, mask_active, assignment, nv_h,
+            mask_id, np.asarray(mask_active), np.asarray(assignment), nv_h,
             frame_ids, **kwargs)
     if n_real is not None and objects.num_points != n_real:
         for pids in objects.point_ids_list:
@@ -128,34 +182,50 @@ def _frame_chunk(f: int) -> int:
     return next(c for c in (8, 4, 2, 1) if f % c == 0)
 
 
-def _bucket_pow2(value: int, minimum: int = 8) -> int:
-    """Smallest power-of-two >= max(value, minimum) — jit shape buckets."""
-    b = minimum
-    while b < value:
-        b *= 2
-    return b
+def _rep_bucket(live: int) -> int:
+    """Live-representative shape bucket (pow2 of the live count).
+
+    Floor 64: 2*r_pad = 128 fills the MXU's systolic dimension, so padding
+    small scenes up is compute-free and collapses the small-scene compile
+    variants. The live count comes from ``_live_count_kernel`` — a 4-byte
+    scalar pull, NOT the assignment vector: the worst-case static bound
+    (``m_pad // min_masks``) would be ~64x the typical live count at the
+    honest shape and multiply the node-stats matmul rows with it.
+    """
+    return _bucket_pow2(max(int(live), 1), minimum=64)
+
+
+@functools.partial(jax.jit, static_argnames=("min_masks_per_object",))
+def _live_count_kernel(assignment, mask_active, *, min_masks_per_object):
+    """Number of clusters with >= min_masks_per_object active members.
+
+    The only data-dependent shape input of the post-process program: its
+    4-byte pull sizes the ``r_pad`` bucket (the analog of the mask-table
+    bucket pull at graph start). Everything heavier stays in HBM.
+    """
+    m_pad = assignment.shape[0]
+    sizes = jnp.zeros(m_pad, jnp.int32).at[
+        jnp.where(mask_active, assignment, m_pad)].add(1, mode="drop")
+    return jnp.sum(sizes >= jnp.int32(min_masks_per_object),
+                   dtype=jnp.int32)
 
 
 def _live_rep_prep(mask_frame, mask_id, mask_active, assignment, f, k2,
                    min_masks_per_object):
-    """Host prep for `_node_stats_kernel`: live reps + claim routing table.
+    """HOST reference of `_prep_kernel` (kept for scripts/claims_diag.py,
+    which times the node-stats kernel standalone at pipeline shapes).
 
-    Shared with scripts/claims_diag.py so the diagnostic always times the
-    exact shapes the pipeline runs. Returns None when no cluster reaches
-    ``min_masks_per_object`` members, else
-    ``(reps, r_pad, rep_lut, rep_tab, live_slots, live_valid, r_pull)``.
+    Returns None when no cluster reaches ``min_masks_per_object`` members,
+    else ``(reps, r_pad, rep_lut, rep_tab, live_slots, live_valid,
+    r_pull)``. The pipeline itself runs the device kernel — this helper
+    must mirror its routing exactly (same r_pad bucket, same slot order).
     """
     m_pad = mask_frame.shape[0]
     sizes = np.bincount(assignment[mask_active], minlength=m_pad)
     reps = np.nonzero(sizes >= min_masks_per_object)[0]
     if len(reps) == 0:
         return None
-    # floor 64: 2*r_pad = 128 exactly fills the MXU's systolic dimension, so
-    # padding small scenes up is compute-free — and it collapses the
-    # {8,16,32,64} r_pad compile variants (northstar's "scene 8" paid a
-    # hidden ~10 s _node_stats_kernel compile for being the first 32-rep
-    # scene) into one
-    r_pad = _bucket_pow2(len(reps), minimum=64)
+    r_pad = _rep_bucket(len(reps))
     rep_lut = np.full(m_pad, -1, dtype=np.int32)
     rep_lut[reps] = np.arange(len(reps), dtype=np.int32)
 
@@ -171,10 +241,52 @@ def _live_rep_prep(mask_frame, mask_id, mask_active, assignment, f, k2,
     live_slots[: len(reps)] = reps
     live_valid = np.zeros(r_pad, dtype=bool)
     live_valid[: len(reps)] = True
-    # quantize the row slice to multiples of 8 so the eager device slice op
-    # itself stays within a handful of compiled shapes per r_pad
+    # quantize the row slice to multiples of 8 so an eager device slice op
+    # stays within a handful of compiled shapes per r_pad
     r_pull = min(r_pad, -(-len(reps) // 8) * 8)
     return reps, r_pad, rep_lut, rep_tab, live_slots, live_valid, r_pull
+
+
+@functools.partial(jax.jit, static_argnames=("r_pad", "f", "k2",
+                                             "min_masks_per_object"))
+def _prep_kernel(
+    assignment: jnp.ndarray,  # (M_pad,) int32 final cluster representative
+    mask_active: jnp.ndarray,  # (M_pad,) bool — valid & not undersegmented
+    mask_frame: jnp.ndarray,  # (M_pad,) int32
+    mask_id: jnp.ndarray,  # (M_pad,) int32 (-1 padding)
+    *,
+    r_pad: int,
+    f: int,
+    k2: int,
+    min_masks_per_object: int,
+):
+    """Live-rep routing tables on device (the former host `_live_rep_prep`).
+
+    Dense live-rep indices follow ascending representative slot order
+    (cumsum compaction == np.nonzero order), so every downstream group
+    offset — and therefore the emitted object order — is identical to the
+    host prep's. Returns (rep_tab, live_slots, live_valid, ridx_of_mask,
+    alive, mask_flat).
+    """
+    m_pad = assignment.shape[0]
+    arange_m = jnp.arange(m_pad, dtype=jnp.int32)
+    sizes = jnp.zeros(m_pad, jnp.int32).at[
+        jnp.where(mask_active, assignment, m_pad)].add(1, mode="drop")
+    live = sizes >= jnp.int32(min_masks_per_object)
+    dense = jnp.cumsum(live.astype(jnp.int32)) - 1
+    rep_lut = jnp.where(live, dense, -1)
+    scatter_to = jnp.where(live, dense, r_pad)  # pad slots drop
+    live_slots = jnp.zeros(r_pad, jnp.int32).at[scatter_to].set(
+        arange_m, mode="drop")
+    live_valid = jnp.zeros(r_pad, bool).at[scatter_to].set(True, mode="drop")
+    ridx_of_mask = jnp.take(rep_lut, assignment, mode="clip")
+    slot = mask_frame * k2 + jnp.clip(mask_id, 0, k2 - 1)
+    rep_tab = jnp.full(f * k2, -1, jnp.int32).at[
+        jnp.where(mask_active, slot, f * k2)].set(
+        ridx_of_mask, mode="drop").reshape(f, k2)
+    alive = mask_active & (ridx_of_mask >= 0)
+    mask_flat = jnp.where(alive, slot, 0)
+    return rep_tab, live_slots, live_valid, ridx_of_mask, alive, mask_flat
 
 
 @functools.partial(jax.jit, static_argnames=("r_pad", "point_filter_threshold",
@@ -191,10 +303,11 @@ def _node_stats_kernel(
     point_filter_threshold: float,
     count_dtype: str = "bf16",
 ):
-    """Per-(rep, point) claim statistics, bit-packed.
+    """Per-(rep, point) claim statistics.
 
-    Returns (claimed_packed, ratio_packed, nv_rep): (r_pad, N8/8) uint8 x2
-    plus the (r_pad, F) bool node-visibility rows for the live reps.
+    Returns (claimed, ratio_ok, nv_rep): (r_pad, N) bool x2 plus the
+    (r_pad, F) bool node-visibility rows for the live reps — all consumed
+    ON DEVICE by the DBSCAN/group kernels (nothing here is pulled).
 
     Frames are processed in chunks of C: each scan step contracts one
     (2R, C*k2) @ (C*k2, N) matmul — local-id one-hots of the claim
@@ -267,7 +380,7 @@ def _node_stats_kernel(
     den = counting.count_dot(nv_rep, first > 0, count_dtype=count_dtype)
 
     ratio_ok = num / (den + 1e-6) > point_filter_threshold
-    return _pack_bits(claimed), _pack_bits(ratio_ok), nv_rep
+    return claimed, ratio_ok, nv_rep
 
 
 def _pack_bits(x: jnp.ndarray) -> jnp.ndarray:
@@ -303,11 +416,116 @@ def _start_host_copy(arr) -> None:
         pass
 
 
+@functools.partial(jax.jit, static_argnames=("c_pad", "cell_cap",
+                                             "neighbor_cap", "eps",
+                                             "min_points"))
+def _dbscan_split_kernel(
+    claimed: jnp.ndarray,  # (r_pad, N) bool node membership per live rep
+    nv_rep: jnp.ndarray,  # (r_pad, F) bool node visibility rows
+    live_valid: jnp.ndarray,  # (r_pad,) bool
+    points: jnp.ndarray,  # (N, 3) f32 scene cloud (uploaded once)
+    order: jnp.ndarray,  # grid structure (ops/grid_dbscan.build_grid)
+    start: jnp.ndarray,
+    length: jnp.ndarray,
+    *,
+    c_pad: int,
+    cell_cap: int,
+    neighbor_cap: int,
+    eps: float,
+    min_points: int,
+):
+    """Grid-DBSCAN split over compacted (rep, point) pairs, on device.
+
+    Candidate reps (live, non-empty node, some node visibility — the host
+    path's exact filter) flatten into compacted (rep, point) pairs
+    (``c_pad`` bucketed from the tiny node-size pull) and split via
+    :func:`grid_dbscan_pairs`. Returns the pair naming
+    (``pair_rep``/``pair_pt``/``pair_valid``), per-pair dense local labels,
+    per-rep root counts and the neighbor-window overflow flag; the
+    O(r_pad) count pull sizes the group axis TIGHTLY before the
+    structures/assign kernels compile (their matmul width rides it)."""
+    r_pad, n = claimed.shape
+    candidate = live_valid & jnp.any(claimed, axis=1) & jnp.any(nv_rep, axis=1)
+    valid_rows = claimed & candidate[:, None]
+    (pair_idx,) = jnp.nonzero(valid_rows.reshape(-1), size=c_pad,
+                              fill_value=r_pad * n)
+    pair_valid = pair_idx < r_pad * n
+    pair_rep = jnp.where(pair_valid, pair_idx // n, r_pad).astype(jnp.int32)
+    pair_pt = jnp.where(pair_valid, pair_idx % n, 0).astype(jnp.int32)
+    dense_local, root_count, nb_overflow = grid_dbscan_pairs(
+        points, order, start, length, pair_rep, pair_pt, pair_valid,
+        r_pad=r_pad, cell_cap=cell_cap, neighbor_cap=neighbor_cap,
+        eps=eps, min_points=min_points)
+    return (pair_rep, pair_pt, pair_valid, dense_local,
+            jnp.where(candidate, root_count + 1, 0), nb_overflow)
+
+
+@functools.partial(jax.jit, static_argnames=("s_pad", "count_dtype"))
+def _group_structs_kernel(
+    pair_rep: jnp.ndarray,  # (C_pad,) int32 (pad: r_pad)
+    pair_pt: jnp.ndarray,  # (C_pad,) int32 (pad: 0)
+    pair_valid: jnp.ndarray,  # (C_pad,) bool
+    dense_local: jnp.ndarray,  # (C_pad,) int32 per-rep DBSCAN label (-1 noise)
+    goff: jnp.ndarray,  # (r_pad,) int32 global group offset per rep (host built)
+    ngrp: jnp.ndarray,  # (r_pad,) int32 groups per rep incl. noise slot
+    ratio_ok: jnp.ndarray,  # (r_pad, N) bool OVIR detection-ratio pass
+    points: jnp.ndarray,  # (N, 3) f32
+    ridx_of_mask: jnp.ndarray,  # (M_pad,) int32 dense live-rep index or -1
+    alive: jnp.ndarray,  # (M_pad,) bool active & live
+    *,
+    s_pad: int,
+    count_dtype: str = "bf16",
+):
+    """Every group structure as segment scatters over the split's pairs.
+
+    Global group ids follow ``goff`` (host-accumulated in ascending
+    rep-slot order from the pulled root counts — the host path's group
+    numbering; noise rides slot ``goff[rep]``, clusters follow):
+
+    - ``goh`` (N, s_pad): the group one-hot plane the mask-assign matmul
+      consumes (node points, NOT ratio-filtered — like the host path);
+    - ``obj_plane`` packed (s_pad, ceil(N/8)): the ratio-filtered object
+      membership — the ONLY per-point payload the drain ever pulls;
+    - ``group_size``/``npts_ratio``/``bb_min``/``bb_max``: O(S) stats;
+    - ``glo``/``ghi``: each mask's own rep's global group range.
+
+    ``s_pad`` is the pow2 bucket of the TRUE group total (floor 128 fills
+    MXU lanes), so the (k2, N) x (N, s_pad) assign matmuls never pay for
+    the capacity ceiling — ``cfg.post_group_cap`` is only the raise
+    threshold, checked before this kernel is dispatched.
+    """
+    r_pad = goff.shape[0]
+    n = points.shape[0]
+    od = counting.operand_dtype(count_dtype)
+    rep_clip = jnp.clip(pair_rep, 0, r_pad - 1)
+    gg = jnp.where(pair_valid,
+                   jnp.take(goff, rep_clip) + dense_local + 1, s_pad)
+    ratio_pair = jnp.take(
+        ratio_ok.reshape(-1),
+        jnp.clip(rep_clip * n + pair_pt, 0, r_pad * n - 1))
+    gg_ratio = jnp.where(ratio_pair & pair_valid, gg, s_pad)
+    group_size = jnp.zeros(s_pad, jnp.int32).at[gg].add(1, mode="drop")
+    npts_ratio = jnp.zeros(s_pad, jnp.int32).at[gg_ratio].add(1, mode="drop")
+    goh = jnp.zeros((n, s_pad), od).at[pair_pt, gg].set(1, mode="drop")
+    obj_plane = jnp.zeros((s_pad, n), bool).at[gg_ratio, pair_pt].set(
+        True, mode="drop")
+    pair_pts3 = jnp.take(points, pair_pt, axis=0)  # (C, 3)
+    bb_min = jnp.full((s_pad, 3), jnp.inf, jnp.float32).at[gg].min(
+        pair_pts3, mode="drop")
+    bb_max = jnp.full((s_pad, 3), -jnp.inf, jnp.float32).at[gg].max(
+        pair_pts3, mode="drop")
+
+    ridx = jnp.clip(ridx_of_mask, 0, r_pad - 1)
+    glo = jnp.where(alive, jnp.take(goff, ridx), 0)
+    ghi = glo + jnp.where(alive, jnp.take(ngrp, ridx), 0)
+    return (group_size, npts_ratio, goh, _pack_bits(obj_plane),
+            bb_min, bb_max, glo, ghi)
+
+
 def _mask_group_counts_impl(
     first: jnp.ndarray,  # (F, N) int16
     last: jnp.ndarray,  # (F, N) int16
-    pt_ids: jnp.ndarray,  # (C_pad,) int32 node point ids (pad: N — dropped)
-    pt_group: jnp.ndarray,  # (C_pad,) int32 global group ids (pad: s_pad — dropped)
+    goh: jnp.ndarray,  # (N, s_pad) group one-hot plane (operand dtype)
     mask_flat: jnp.ndarray,  # (M_pad,) int32 = frame * k2 + id of each mask slot
     group_lo: jnp.ndarray,  # (M_pad,) int32 first global group of the mask's rep
     group_hi: jnp.ndarray,  # (M_pad,) int32 one past the rep's last group (0 width = dead)
@@ -326,9 +544,6 @@ def _mask_group_counts_impl(
     coverage floats are bit-identical across count_dtype.
     """
     f, n = first.shape
-    od = counting.operand_dtype(count_dtype)
-    goh = jnp.zeros((n, s_pad), od)
-    goh = goh.at[pt_ids, pt_group].set(1, mode="drop")
 
     def step(_, inp):
         a, b = inp
@@ -364,14 +579,39 @@ _mask_group_counts_kernel_donating = functools.partial(
     donate_argnums=(0, 1))(_mask_group_counts_impl)
 
 
+@functools.partial(jax.jit, static_argnames=("count_dtype",))
+def _survivor_gather_kernel(
+    obj_packed: jnp.ndarray,  # (s_pad, ceil(N/8)) uint8 object bit-planes
+    surv_idx: jnp.ndarray,  # (O_pad,) int32 surviving global groups (pad: 0)
+    *,
+    count_dtype: str = "bf16",
+):
+    """Compact the surviving objects + their pairwise intersection counts.
+
+    ``rows`` are the emit-only drain payload (bit-packed point membership
+    of each surviving object); ``inter[i, j] = |points_i and points_j|``
+    is the overlap-merge containment numerator, computed as ONE
+    mask x mask ``count_dot`` on the MXU — the O(objects^2 x N) work the
+    host merge used to spend in python set intersections. Padded rows
+    beyond the true survivor count produce junk the host never reads.
+    """
+    rows = jnp.take(obj_packed, surv_idx, axis=0)  # (O_pad, N8/8)
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (rows[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1)
+    flat = bits.reshape(rows.shape[0], -1).astype(
+        counting.operand_dtype(count_dtype))
+    inter = counting.count_dot(flat, flat.T, count_dtype=count_dtype)
+    return rows, inter
+
+
 def postprocess_scene_device(
     scene_points: np.ndarray,  # (N, 3) float32, host
     first: jnp.ndarray,  # (F, N) int16, device
     last: jnp.ndarray,  # (F, N) int16, device
     mask_frame: np.ndarray,  # (M_pad,) int32, host
     mask_id: np.ndarray,  # (M_pad,) int32, host (-1 padding)
-    mask_active: np.ndarray,  # (M_pad,) bool, host
-    assignment: np.ndarray,  # (M_pad,) int32, host
+    mask_active: jnp.ndarray,  # (M_pad,) bool, device
+    assignment: jnp.ndarray,  # (M_pad,) int32, device
     node_visible: jnp.ndarray,  # (M_pad, F) bool, device
     frame_ids: Sequence,  # original frame identifiers, len >= F real frames
     *,
@@ -385,20 +625,26 @@ def postprocess_scene_device(
     pull_chunk: int = 0,
     donate: bool = False,
     count_dtype: str = "bf16",
+    group_cap: int = 512,
+    neighbor_cap: int = 256,
+    n_real: Optional[int] = None,
 ) -> SceneObjects:
-    """Same contract and outputs as postprocess_scene, minus the (F, N) pulls.
+    """Same contract and outputs as postprocess_scene; emit-only drain.
 
-    first/last/node_visible stay on device; only bit-packed (R, N/8) planes
-    and O(M_pad) scalars cross the host boundary. The DBSCAN split and the
-    final merge/emit run on host exactly as in the host path, so artifacts
-    are byte-identical (asserted by tests/test_postprocess_device.py).
+    The whole split/assign/merge chain — routing prep, node statistics,
+    grid DBSCAN, group structures, mask->group assignment, object
+    intersection counts — dispatches as an uninterrupted device program
+    sequence; the only device->host transfers are the final drain (O(M+S)
+    scalars + the surviving objects' bit-packed point planes). The
+    assignment and claim planes are consumed in HBM, never pulled. The
+    greedy overlap-merge threshold scan and artifact assembly run on host
+    over the drained compact results, so artifacts are byte-identical to
+    the host path (asserted by tests/test_postprocess_device.py).
 
-    ``pull_chunk`` > 0 drains the claimed bit-planes in row chunks of that
+    ``pull_chunk`` > 0 drains the object bit-planes in row chunks of that
     size: every chunk's ``copy_to_host_async`` is issued up front, then
     chunks materialize and unpack in order — the unpack of chunk i rides
-    under chunk i+1's DMA, splitting ``post.claims`` into overlapping
-    kernel/transfer/unpack slices (the structural answer to the
-    kernel-vs-tunnel attribution question; identical bytes either way).
+    under chunk i+1's DMA (byte-identical at any chunk size).
 
     ``donate=True`` donates the (F, N) first/last tensors into the final
     group-counts kernel — their HBM frees mid-postprocess instead of at
@@ -408,141 +654,147 @@ def postprocess_scene_device(
     f, n = first.shape
     m_pad = mask_frame.shape[0]
     k2 = k_max + 2
-
-    prep = _live_rep_prep(mask_frame, mask_id, mask_active, assignment,
-                          f, k2, min_masks_per_object)
-    if prep is None:
-        t.mark("claims")
-        return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
-    reps, r_pad, rep_lut, rep_tab, live_slots, live_valid, r_pull = prep
     from maskclustering_tpu.utils.compile_cache import record_shape_bucket
 
+    # ---- r_pad sizing: a 4-byte scalar pull, not an assignment pull ----
+    # The live-rep axis must be static before the prep/node-stats kernels
+    # compile, and its tight bucket is device data. Pulling the one count
+    # scalar keeps r_pad at the host prep's historical bucket (pow2 of the
+    # live count, floor 64) without the (M_pad,) assignment ever crossing.
+    with obs.span("post.prep.pull"):
+        live = int(_live_count_kernel(
+            assignment, mask_active,
+            min_masks_per_object=int(min_masks_per_object)))
+        obs.count_transfer("d2h", 4, "post.drain")
+    if live == 0:
+        for phase in ("claims", "dbscan", "mask_assign", "emit", "merge"):
+            t.mark(phase)
+        return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
+    r_pad = _rep_bucket(live)
     record_shape_bucket("post.nodestats", r_pad, m_pad, f, n, k2)
 
-    # The round-5 open question — is post.claims kernel time or transfer
-    # time? — is answered by fencing the two halves separately: with obs
-    # armed, the kernel span syncs on the kernel outputs (pure device
-    # compute) and the pull span owns only the device->host DMA + unpack.
-    # Disarmed, both spans are timing-only no-ops with NO extra sync, so
-    # the async-dispatch overlap this phase depends on is preserved.
+    # ---- device program chain: prep -> node stats -> split -> assign ----
+    # No bulk host transfer anywhere in this block: every kernel consumes
+    # the previous one's device outputs, and the grid is host geometry
+    # (scene_points never left the host) uploaded alongside the mask table.
+    mask_frame_d = jnp.asarray(mask_frame)
+    mask_id_d = jnp.asarray(mask_id)
     with obs.span("post.claims.kernel", r_pad=r_pad, m_pad=m_pad,
                   f=f, n=n) as sp:
-        claimed_p, ratio_p, nv_rep_d = sp.sync(_node_stats_kernel(
-            first, last, jnp.asarray(rep_tab), node_visible,
-            jnp.asarray(live_slots), jnp.asarray(live_valid),
+        rep_tab, live_slots, live_valid, ridx_of_mask, alive, mask_flat = \
+            _prep_kernel(assignment, mask_active, mask_frame_d, mask_id_d,
+                         r_pad=r_pad, f=f, k2=k2,
+                         min_masks_per_object=int(min_masks_per_object))
+        claimed, ratio_ok, nv_rep = sp.sync(_node_stats_kernel(
+            first, last, rep_tab, node_visible, live_slots, live_valid,
             r_pad=r_pad, point_filter_threshold=float(point_filter_threshold),
             count_dtype=count_dtype))
-    # device->host transfers dominate this phase on a narrow link (the
-    # driver rig's tunnel moves ~2-3 MB/s; a TPU-VM's PCIe makes them
-    # ~free). Three cuts: pull only the len(reps) live rows of the
-    # (r_pad, N/8) planes; drain them in double-buffered row chunks (all
-    # asyncs issued up front, so the unpack of chunk i overlaps chunk
-    # i+1's DMA); and start the ratio plane's DMA after them — it isn't
-    # consumed until the emit phase, so the copy rides the link while
-    # dbscan/mask_assign run on the host. copy_to_host_async (not a helper
-    # thread calling np.asarray: the blocking device_get holds the GIL on
-    # this backend, so a threaded "overlap" serialized the dbscan stage's
-    # Python loops — post.dbscan 0.11 -> 2.0 s measured on the driver rig).
-    r_live = len(reps)
-    with obs.span("post.claims.pull", r_pull=r_pull) as sp:
-        chunks = _row_chunks(claimed_p, r_pull, pull_chunk)
-        for c in chunks:
-            _start_host_copy(c)
-        ratio_sliced = ratio_p[:r_pull]
-        _start_host_copy(ratio_sliced)
-        pulled = 0
-        parts = []
-        for c in chunks:
-            h = np.asarray(c)  # already landed (or blocks on the DMA)
-            pulled += h.nbytes
-            parts.append(_unpack_bits(h, n))
-        claimed = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
-        nv_host = np.asarray(nv_rep_d[:r_pull])
-        nv_any = nv_host[:r_live].any(axis=1)
-        sp.set(chunks=len(chunks))
-        obs.count_transfer("d2h", pulled + nv_host.nbytes, "post.claims")
     t.mark("claims")
 
-    # ---- DBSCAN split per live rep (host, native C++/sklearn) ----
-    # group numbering matches the host path: offsets accumulate over reps in
-    # ascending slot order, label 0 (noise) is kept as its own candidate.
-    # The native call releases the GIL, so reps split in a thread pool;
-    # ordered ex.map keeps the offset assembly deterministic.
-    candidates: List[Tuple[int, np.ndarray]] = []
-    for ridx in range(len(reps)):
-        if not nv_any[ridx]:
-            continue
-        node_pts = np.nonzero(claimed[ridx])[0].astype(np.int32)
-        if len(node_pts):
-            candidates.append((ridx, node_pts))
-    labels_list = dbscan_labels_parallel(
-        [scene_points[pts] for _, pts in candidates], dbscan_eps, dbscan_min_points)
+    # ---- pair-bucket sizing: the ONE O(r_pad) metadata pull mid-chain ----
+    # The (rep, point) pair axis must be static before the split kernel
+    # compiles, and its tight bucket is device data (per-rep node sizes).
+    # This pull is a few hundred BYTES of shape metadata — the exact
+    # analog of the mask-table bucket pull — not a claims drain: the
+    # (r_pad, N) planes and (F, N) claim tensors stay in HBM untouched.
+    # The alternative (a worst-case r_pad*N pair pad) would multiply every
+    # split sweep by the dead-rep padding.
+    sizes_d = jnp.sum(claimed, axis=1, dtype=jnp.int32)
+    cand_d = (live_valid & (sizes_d > 0) & jnp.any(nv_rep, axis=1))
+    with obs.span("post.split.pull", r_pad=r_pad) as sp:
+        _start_host_copy(sizes_d)
+        _start_host_copy(cand_d)
+        sizes = np.asarray(sizes_d)
+        cand_pre = np.asarray(cand_d)
+        obs.count_transfer("d2h", sizes.nbytes + cand_pre.nbytes,
+                           "post.drain")
+    num_pairs = int(sizes[cand_pre].sum())
+    if num_pairs == 0:
+        t.mark("dbscan")
+        t.mark("mask_assign")
+        t.mark("emit")
+        t.mark("merge")
+        return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
 
-    rep_slices: List[Tuple[int, int, np.ndarray, np.ndarray]] = []
-    goff_by_ridx = np.zeros(len(reps), dtype=np.int64)
-    ngrp_by_ridx = np.zeros(len(reps), dtype=np.int64)
-    pt_chunks: List[np.ndarray] = []
-    grp_chunks: List[np.ndarray] = []
-    group_offset = 0
-    for (ridx, node_pts), labels in zip(candidates, labels_list):
-        groups = (labels + 1).astype(np.int64)
-        ngrp = int(groups.max()) + 1
-        rep_slices.append((ridx, group_offset, node_pts, groups))
-        goff_by_ridx[ridx] = group_offset
-        ngrp_by_ridx[ridx] = ngrp
-        pt_chunks.append(node_pts)
-        grp_chunks.append(group_offset + groups)
-        group_offset += ngrp
+    # ---- grid DBSCAN split, on device ----
+    # n_real keeps the sentinel pad points out of the voxel grid: they
+    # share ONE coordinate, so binning them would put the whole pad run
+    # into a single cell and multiply the static candidate window
+    # (cell_cap) by orders of magnitude
+    grid = build_grid(scene_points, dbscan_eps, n_real=n_real)
+    c_pad = _bucket_pow2(num_pairs, minimum=256)
+    record_shape_bucket("post.dbscan", r_pad, c_pad, grid.cell_cap, n)
+    points_d = jnp.asarray(scene_points, jnp.float32)
+    with obs.span("post.dbscan.kernel", r_pad=r_pad,
+                  c_pad=c_pad, cell_cap=grid.cell_cap) as sp:
+        (pair_rep, pair_pt, pair_valid, dense_local, ngrp_d,
+         nb_overflow_d) = sp.sync(
+            _dbscan_split_kernel(
+                claimed, nv_rep, live_valid, points_d,
+                jnp.asarray(grid.order), jnp.asarray(grid.start),
+                jnp.asarray(grid.length),
+                c_pad=c_pad, cell_cap=grid.cell_cap,
+                neighbor_cap=int(neighbor_cap), eps=float(dbscan_eps),
+                min_points=int(dbscan_min_points)))
+    # O(r_pad) group-count pull: sizes the group axis TIGHTLY (the assign
+    # matmul width rides it — the capacity ceiling would 4x the MXU work)
+    # and surfaces capacity overflows BEFORE any structure is built
+    with obs.span("post.groups.pull", r_pad=r_pad):
+        _start_host_copy(ngrp_d)
+        _start_host_copy(nb_overflow_d)
+        ngrp = np.asarray(ngrp_d)
+        nb_overflow = bool(np.asarray(nb_overflow_d))
+        obs.count_transfer("d2h", ngrp.nbytes + 1, "post.drain")
+    if nb_overflow:
+        raise PostprocessCapacityError(
+            "DBSCAN neighbor-list", -1, int(neighbor_cap),
+            "post_neighbor_cap")
+    total = int(ngrp.sum())
+    if total > max(int(group_cap), 1):
+        raise PostprocessCapacityError(
+            "DBSCAN group", total, int(group_cap), "post_group_cap")
+    # global offsets accumulate in ascending rep-slot order — the host
+    # path's group numbering; floor 128 fills the MXU's lane dimension
+    goff = np.zeros(r_pad, np.int32)
+    goff[1:] = np.cumsum(ngrp[:-1]).astype(np.int32)
+    s_pad = _bucket_pow2(total, minimum=128)
+    record_shape_bucket("post.groups", r_pad, s_pad, c_pad, n)
+    with obs.span("post.groups.kernel", s_pad=s_pad) as sp:
+        (group_size_d, npts_ratio_d, goh, obj_packed, bb_min_d, bb_max_d,
+         glo_d, ghi_d) = sp.sync(_group_structs_kernel(
+            pair_rep, pair_pt, pair_valid, dense_local,
+            jnp.asarray(goff), jnp.asarray(ngrp.astype(np.int32)),
+            ratio_ok, points_d, ridx_of_mask, alive,
+            s_pad=s_pad, count_dtype=count_dtype))
     t.mark("dbscan")
 
-    if group_offset == 0:
-        # materialize the in-flight ratio copy so a transfer error surfaces
-        # here instead of being dropped with the unconsumed buffer
-        np.asarray(ratio_sliced)
-        return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
-    # floor 128: the group-counts matmul's output width rides MXU lanes, so
-    # widths below 128 waste lanes — and small-scene s_pad compile variants
-    # ({32, 64, ...}) collapse into one
-    s_pad = _bucket_pow2(group_offset, minimum=128)
-    all_pts = np.concatenate(pt_chunks)
-    all_grps = np.concatenate(grp_chunks)
-    group_size = np.bincount(all_grps, minlength=s_pad)
-    c_pad = _bucket_pow2(len(all_pts), minimum=1024)
-    record_shape_bucket("post.groupcounts", s_pad, c_pad, m_pad, f, n, k2)
-    pt_ids = np.full(c_pad, n, dtype=np.int32)  # sentinel n -> dropped scatter
-    pt_grp = np.full(c_pad, s_pad, dtype=np.int32)
-    pt_ids[: len(all_pts)] = all_pts
-    pt_grp[: len(all_pts)] = all_grps
-
-    # per-mask global group range of its rep (0-width for dead masks)
-    ridx_of_mask = rep_lut[assignment]
-    alive = mask_active & (ridx_of_mask >= 0)
-    glo = np.zeros(m_pad, dtype=np.int32)
-    ghi = np.zeros(m_pad, dtype=np.int32)
-    glo[alive] = goff_by_ridx[ridx_of_mask[alive]]
-    ghi[alive] = glo[alive] + ngrp_by_ridx[ridx_of_mask[alive]]
-    mask_flat = (mask_frame.astype(np.int64) * k2
-                 + np.clip(mask_id, 0, k2 - 1)).astype(np.int32)
-    mask_flat[~alive] = 0
-
-    with obs.span("post.mask_assign.kernel", s_pad=s_pad, c_pad=c_pad) as sp:
+    with obs.span("post.mask_assign.kernel", s_pad=s_pad, m_pad=m_pad) as sp:
         # last consumer of first/last: the donating variant hands their HBM
         # back to the allocator for the next scene's same-bucket dispatch
         kernel = (_mask_group_counts_kernel_donating if donate
                   else _mask_group_counts_kernel)
         best_group_d, best_count_d = sp.sync(kernel(
-            first, last, jnp.asarray(pt_ids), jnp.asarray(pt_grp),
-            jnp.asarray(mask_flat), jnp.asarray(glo), jnp.asarray(ghi),
+            first, last, goh, mask_flat, glo_d, ghi_d,
             k2=k2, s_pad=s_pad, count_dtype=count_dtype))
-    best_group = np.asarray(best_group_d)
-    best_count = np.asarray(best_count_d)
-    obs.count_transfer("d2h", best_group.nbytes + best_count.nbytes,
-                       "post.mask_assign")
     t.mark("mask_assign")
 
-    # ---- assemble mask lists per global group (ascending mask order) ----
+    # ---- emit-only drain, stage 1: O(M_pad + S) scalars ----
+    with obs.span("post.drain.pull", s_pad=s_pad, m_pad=m_pad) as sp:
+        small = (group_size_d, npts_ratio_d, best_group_d,
+                 best_count_d, glo_d, ghi_d, bb_min_d, bb_max_d)
+        for arr in small:
+            _start_host_copy(arr)
+        (group_size, npts_ratio, best_group, best_count, glo, ghi,
+         bb_min, bb_max) = (np.asarray(a) for a in small)
+        obs.count_transfer(
+            "d2h", sum(np.asarray(a).nbytes for a in
+                       (group_size, npts_ratio, best_group, best_count,
+                        glo, ghi, bb_min, bb_max)),
+            "post.drain")
+
+    # ---- host: mask lists per group, survivor filter (host-path order) ----
     obj_masks: Dict[int, List[Tuple]] = {}
-    for m in np.nonzero(alive & (ghi > glo))[0]:
+    for m in np.nonzero(ghi > glo)[0]:
         cnt = best_count[m]
         if cnt <= 0:  # no surviving claims (all mid-id overlaps)
             continue
@@ -550,35 +802,51 @@ def postprocess_scene_device(
         obj_masks.setdefault(gl, []).append(
             (frame_ids[mask_frame[m]], int(mask_id[m]),
              float(cnt / group_size[gl])))
+    survivors = [g for g in range(int(total))
+                 if group_size[g] > 0 and npts_ratio[g] > 0
+                 and len(obj_masks.get(g, [])) >= min_masks_per_object]
+    if not survivors:
+        t.mark("emit")
+        t.mark("merge")
+        return SceneObjects(point_ids_list=[], mask_list=[], num_points=n)
 
-    # ---- emit candidate objects (same order/filters as the host path) ----
-    # the async host copy started after the claims pull is resident (or
-    # nearly so) by now; this materializes it without re-transfer
-    ratio_host = np.asarray(ratio_sliced)
-    obs.count_transfer("d2h", ratio_host.nbytes, "post.emit")
-    ratio_ok = _unpack_bits(ratio_host, n)
-    total_point_ids: List[np.ndarray] = []
-    total_bboxes: List[Tuple[np.ndarray, np.ndarray]] = []
-    total_masks: List[List[Tuple]] = []
-    for ridx, goff, node_pts, groups in rep_slices:
-        r_ok = ratio_ok[ridx][node_pts]
-        for g in range(int(groups.max()) + 1):
-            sel = groups == g
-            if not sel.any():
-                continue
-            masks_g = obj_masks.get(goff + g, [])
-            obj_pts_all = node_pts[sel]
-            obj_pts = obj_pts_all[r_ok[sel]]
-            if len(obj_pts) == 0 or len(masks_g) < min_masks_per_object:
-                continue
-            pts3d = scene_points[obj_pts_all]
-            total_point_ids.append(obj_pts)
-            total_bboxes.append((pts3d.min(axis=0), pts3d.max(axis=0)))
-            total_masks.append(masks_g)
+    # ---- drain, stage 2: surviving objects' bit-planes + merge counts ----
+    o = len(survivors)
+    o_pad = _bucket_pow2(o, minimum=8)
+    record_shape_bucket("post.drain", o_pad, s_pad, n)
+    surv_idx = np.zeros(o_pad, np.int32)
+    surv_idx[:o] = survivors
+    with obs.span("post.drain.objpull", objects=o, o_pad=o_pad) as sp:
+        rows_d, inter_d = _survivor_gather_kernel(
+            obj_packed, jnp.asarray(surv_idx), count_dtype=count_dtype)
+        # drain at the o_pad bucket and trim on host: an eager device
+        # slice at the raw survivor count would compile one executable
+        # per distinct o (the compile-variant churn the old r_pull
+        # quantization existed to avoid); the padded rows are junk the
+        # host never reads, a few extra KB of transfer at most
+        chunks = _row_chunks(rows_d, o_pad, pull_chunk)
+        for c in chunks:
+            _start_host_copy(c)
+        _start_host_copy(inter_d)
+        pulled = 0
+        parts = []
+        for c in chunks:
+            h = np.asarray(c)  # already landed (or blocks on the DMA)
+            pulled += h.nbytes
+            parts.append(_unpack_bits(h, n))
+        member = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+        inter = np.asarray(inter_d)[:o, :o]
+        sp.set(chunks=len(chunks))
+        obs.count_transfer("d2h", pulled + np.asarray(inter_d).nbytes,
+                           "post.drain")
     t.mark("emit")
 
-    point_ids_list, mask_list = _merge_overlapping(
-        total_point_ids, total_bboxes, total_masks, overlap_merge_ratio)
+    point_ids = [np.nonzero(member[i])[0].astype(np.int32) for i in range(o)]
+    bboxes = [(bb_min[g], bb_max[g]) for g in survivors]
+    masks = [obj_masks[g] for g in survivors]
+    sizes = npts_ratio[survivors]
+    point_ids_list, mask_list = merge_from_counts(
+        point_ids, bboxes, masks, sizes, inter, overlap_merge_ratio)
     t.mark("merge")
     return SceneObjects(point_ids_list=point_ids_list, mask_list=mask_list,
                         num_points=n)

@@ -85,7 +85,8 @@ def bench_row(verdict: Dict, **extra) -> Dict:
            "value": verdict.get("value"),
            "unit": verdict.get("unit", "s/scene")}
     for k in ("vs_baseline", "spread_pct", "stages", "attempts",
-              "frame_batch", "count_dtype", "plane_dtype", "error"):
+              "frame_batch", "count_dtype", "plane_dtype",
+              "postprocess_path", "error"):
         if verdict.get(k) is not None:
             row[k] = verdict[k]
     row.update(extra)
@@ -198,13 +199,16 @@ def check_regression(current: Optional[Dict], baseline: Optional[Dict], *,
     verdict = "REGRESSION" if rel > threshold else "ok"
     lines.append(f"headline: {cur:.3f} vs baseline {base:.3f} "
                  f"({rel:+.1%}, threshold +{threshold:.0%}) -> {verdict}")
-    # dtype attribution: a headline delta that coincides with a dtype flip
-    # is a knob effect, not code drift — say so next to the verdict (rows
-    # predating the knob have no key and read as the historical defaults)
-    for knob, default in (("count_dtype", "bf16"), ("plane_dtype", "int32")):
+    # knob attribution: a headline delta that coincides with a dtype or
+    # postprocess-path flip is a knob effect, not code drift — say so next
+    # to the verdict (rows predating a knob have no key and read as the
+    # historical defaults; postprocess_path predates as "device": rows
+    # before the knob ran the default device path)
+    for knob, default in (("count_dtype", "bf16"), ("plane_dtype", "int32"),
+                          ("postprocess_path", "device")):
         c, b = current.get(knob, default), baseline.get(knob, default)
         if c != b:
-            lines.append(f"  {knob}: {b} -> {c} [dtype flip — attribute "
+            lines.append(f"  {knob}: {b} -> {c} [knob flip — attribute "
                          f"the delta before blaming code]")
     # fault attribution: run rows stamp retries/degradations (run.py) — a
     # degraded run is slower BY DESIGN, so the gate says so before anyone

@@ -1,0 +1,288 @@
+"""Scene-scale voxel-grid DBSCAN as a static-shape device kernel.
+
+The post-process DBSCAN split historically ran on host (native C++ /
+sklearn over the pulled node point lists). `ops/dbscan.dbscan_fixed_jax`
+exists for per-mask denoising on the exact-parity path, but its O(P^2)
+distance matrix caps it at a few thousand points — a node of a scene-scale
+instance (a floor, a wall) holds tens of thousands. This module is the
+grid/union-find algorithm of ``native/src/mc_native.cpp`` reformulated for
+XLA with static shapes, usable at instance granularity inside the
+post-process program:
+
+- the **grid** is pure scene geometry (cell = eps-sized voxel), so it is
+  built ONCE per scene on host from the host-resident cloud
+  (``build_grid``) and uploaded — candidate enumeration never depends on
+  device data, which is what keeps every shape static. Two points within
+  ``eps`` differ by at most one cell per axis, so the 27-cell stencil is a
+  complete candidate cover; the per-cell candidate window is the
+  power-of-two bucket of the scene's max cell occupancy.
+- the work items are **(instance, point) pairs** — every instance's node
+  membership flattened and compacted to a ``C_pad`` bucket (points
+  claimed by several representatives appear once per representative, like
+  the host path's per-rep point lists). Pair compaction follows ascending
+  (rep slot, point id) order, so min-LABEL arithmetic below is min-INDEX
+  arithmetic within each rep.
+- each pair's in-eps SAME-INSTANCE neighbors compact into a static
+  ``neighbor_cap`` window (one pass over the 27-cell stencil, prefix-sum
+  packing); core/border classification and the iterative min-label
+  propagation with pointer jumping — the same fixpoint
+  `models/clustering.py` runs on device — then sweep (C_pad,
+  neighbor_cap) gathers instead of touching the (27 x cell_cap) stencil
+  again, which is what makes the sweeps cheap at scene scale.
+
+Label semantics are the host dispatch's exactly (ops/dbscan.dbscan_labels,
+both native and sklearn): per instance, clusters numbered 0.. in ascending
+order of their lowest core point index, border points attached to the
+lowest-numbered neighboring core cluster, noise = -1. Min-label
+propagation makes every core pair's label the component's lowest core pair
+index, so ranking root pairs reproduces the scan-order numbering without
+any scan — pinned against the host dispatch by
+tests/test_postprocess_device.py.
+
+Distances compare in f32 on device vs f64 on host; both see the same
+f32 coordinates, so decisions only diverge for pairs within f32 rounding
+of ``eps`` exactly — the same tolerance `dbscan_fixed_jax` already accepts
+on the parity path.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+# the 27 stencil offsets, fixed order (x-major, matching mc_native's loops)
+STENCIL: Tuple[Tuple[int, int, int], ...] = tuple(
+    (dx, dy, dz) for dx in (-1, 0, 1) for dy in (-1, 0, 1)
+    for dz in (-1, 0, 1))
+
+
+def _bucket_pow2(value: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < value:
+        b *= 2
+    return b
+
+
+class GridStructure(NamedTuple):
+    """Host-built, device-consumed candidate structure of one scene.
+
+    ``order`` lists point indices sorted by voxel; ``start[s, i]`` /
+    ``length[s, i]`` delimit, inside ``order``, the points of the cell at
+    stencil offset ``s`` from point ``i``'s cell. ``cell_cap`` is the
+    static candidate window (pow2 bucket of the max cell occupancy), so
+    ``order[start + 0..cell_cap)`` masked by ``length`` enumerates every
+    candidate with static shapes.
+    """
+
+    order: np.ndarray  # (N,) int32
+    start: np.ndarray  # (27, N) int32
+    length: np.ndarray  # (27, N) int32
+    cell_cap: int
+
+
+def build_grid(points: np.ndarray, eps: float, *,
+               cap_minimum: int = 8,
+               n_real: Optional[int] = None) -> GridStructure:
+    """Voxel-bin a host point cloud at cell size ``eps`` (f64 quantization,
+    like the native path). O(27 N log N) numpy; pure geometry — no device
+    data involved, so the post-process can build it before any kernel
+    lands.
+
+    ``n_real``: number of leading REAL points when the cloud is padded to
+    a shape bucket. Padded points share one sentinel coordinate, so
+    binning them would put thousands of points in a single voxel and blow
+    the static candidate window (``cell_cap``) up by orders of magnitude.
+    They can never be node points (the sentinel-pad invariant), so they
+    are excluded from the grid entirely: they never appear in ``order``
+    and the per-point run tables only cover the real prefix (valid pairs
+    only ever index real points)."""
+    pts = np.asarray(points, dtype=np.float64)
+    n = pts.shape[0]
+    if n_real is not None and n_real < n:
+        pts = pts[:n_real]
+    if n == 0 or pts.shape[0] == 0:
+        z = np.zeros((27, n), np.int32)
+        return GridStructure(np.zeros(0, np.int32), z, z.copy(), cap_minimum)
+    cell = np.floor(pts / float(eps)).astype(np.int64)
+    cell -= cell.min(axis=0)
+    cell += 1  # stencil neighbors at -1 stay non-negative
+    dims = cell.max(axis=0) + 2  # covers every neighbor coordinate
+
+    def lin(c):
+        return (c[..., 0] * dims[1] + c[..., 1]) * dims[2] + c[..., 2]
+
+    key = lin(cell)
+    order = np.argsort(key, kind="stable").astype(np.int32)
+    sorted_key = key[order]
+    n = pts.shape[0]  # run tables cover the real (grid-binned) prefix only
+    start = np.empty((27, n), np.int32)
+    length = np.empty((27, n), np.int32)
+    off = np.empty_like(cell)
+    for s, (dx, dy, dz) in enumerate(STENCIL):
+        off[:, 0] = cell[:, 0] + dx
+        off[:, 1] = cell[:, 1] + dy
+        off[:, 2] = cell[:, 2] + dz
+        nk = lin(off)
+        lo = np.searchsorted(sorted_key, nk, side="left")
+        hi = np.searchsorted(sorted_key, nk, side="right")
+        start[s] = lo
+        length[s] = hi - lo
+    # every cell is its own center cell, so the center lengths cover the
+    # max occupancy (any neighbor cell is some point's center cell)
+    cap = _bucket_pow2(int(length[13].max(initial=1)), cap_minimum)
+    return GridStructure(order=order, start=start, length=length,
+                         cell_cap=cap)
+
+
+def grid_dbscan_pairs(points, order, start, length, pair_rep, pair_pt,
+                      pair_valid, *, r_pad: int, cell_cap: int,
+                      neighbor_cap: int, eps: float, min_points: int):
+    """DBSCAN over compacted (rep, point) pairs; call INSIDE a jit.
+
+    ``pair_rep``/``pair_pt``/``pair_valid`` (C_pad,) name the work items in
+    ascending (rep, point) order (padding: valid False). Returns
+    ``(dense_local, root_count, nb_overflow)``:
+
+    - ``dense_local`` (C_pad,) int32 — the pair's DBSCAN label within ITS
+      rep, numbered like the host dispatch (ascending min core point
+      index; -1 = noise/invalid);
+    - ``root_count`` (r_pad,) int32 — clusters per rep (the per-rep group
+      count minus the noise slot);
+    - ``nb_overflow`` () bool — some pair had more than ``neighbor_cap``
+      same-rep in-eps neighbors, so hits were dropped and the labels are
+      unusable: the caller must fail over (the post-process raises
+      ``PostprocessCapacityError`` and the ladder's host rung re-runs).
+
+    One stencil pass packs each pair's same-rep in-eps neighbors into a
+    (C_pad, neighbor_cap) table by prefix-sum compaction; the propagation
+    fixpoint then never touches the grid again. ``degree`` counts the pair
+    itself (its own cell is in the stencil and d2=0), matching the
+    sklearn/Open3D ``min_points`` contract.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = points.shape[0]
+    n_grid = order.shape[0]  # real (grid-binned) prefix; n - n_grid = pads
+    c_pad = pair_rep.shape[0]
+    sent = jnp.int32(c_pad)
+    lanes = jnp.arange(cell_cap, dtype=jnp.int32)
+    arange_c = jnp.arange(c_pad, dtype=jnp.int32)
+    eps2 = jnp.float32(float(eps) * float(eps))
+
+    # (rep, point) -> pair index lookup (sentinel: c_pad); one dump slot
+    # keeps padded pairs' scatters off slot 0
+    flat = jnp.where(pair_valid, pair_rep * n + pair_pt, r_pad * n)
+    pair_of = jnp.full(r_pad * n + 1, c_pad, jnp.int32).at[flat].set(arange_c)
+    own = jnp.take(points, pair_pt, axis=0)  # (C, 3)
+    rep_base = jnp.clip(pair_rep, 0, r_pad - 1) * n
+
+    def pack_step(carry, xs):
+        nb, pos = carry
+        st_s, ln_s = xs  # (N,) each: this stencil direction's runs
+        base = jnp.take(st_s, pair_pt)  # (C,)
+        run = jnp.take(ln_s, pair_pt)
+        idx = jnp.clip(base[:, None] + lanes[None, :], 0, max(n_grid - 1, 0))
+        cand = jnp.take(order, idx)  # (C, L) global point ids
+        delta = jnp.take(points, cand, axis=0) - own[:, None, :]
+        d2 = jnp.sum(delta * delta, axis=-1)
+        q_nb = jnp.take(pair_of, rep_base[:, None] + cand)  # same-rep pair
+        hit = ((d2 <= eps2) & (lanes[None, :] < run[:, None])
+               & pair_valid[:, None] & (q_nb < sent))
+        hpos = pos[:, None] + jnp.cumsum(hit, axis=1) - hit
+        nb = nb.at[arange_c[:, None],
+                   jnp.where(hit, hpos, neighbor_cap)].set(
+            jnp.where(hit, q_nb, sent), mode="drop")
+        return (nb, pos + jnp.sum(hit, axis=1, dtype=jnp.int32)), None
+
+    (nb, degree), _ = jax.lax.scan(
+        pack_step,
+        (jnp.full((c_pad, neighbor_cap), sent, jnp.int32),
+         jnp.zeros(c_pad, jnp.int32)),
+        (start, length))
+    nb_overflow = jnp.any(degree > neighbor_cap)
+
+    core = pair_valid & (degree >= jnp.int32(min_points))
+    core_ext = jnp.concatenate([core, jnp.zeros(1, bool)])
+
+    def neighbor_min(labels):
+        lab_ext = jnp.concatenate([labels, jnp.full(1, sent, jnp.int32)])
+        nblab = jnp.where(jnp.take(core_ext, nb), jnp.take(lab_ext, nb), sent)
+        return jnp.min(nblab, axis=1)
+
+    init = jnp.where(core, arange_c, sent)
+
+    def cond(state):
+        return state[1]
+
+    def body(state):
+        labels, _ = state
+        best = jnp.where(core, jnp.minimum(labels, neighbor_min(labels)),
+                         labels)
+        ext = jnp.concatenate([best, jnp.full(1, sent, jnp.int32)])
+        best = jnp.where(core, jnp.minimum(best, jnp.take(ext, best)), best)
+        return best, jnp.any(best != labels)
+
+    labels, _ = jax.lax.while_loop(cond, body, (init, jnp.bool_(True)))
+
+    # border pairs: lowest neighboring core cluster of the same rep
+    blab = neighbor_min(labels)
+    labels = jnp.where(core, labels,
+                       jnp.where(pair_valid & (blab < sent), blab, sent))
+
+    # densify per rep: pairs are ordered (rep, point)-ascending, so a rep's
+    # roots are contiguous in the global root ranking — local rank = global
+    # rank minus the rep's root offset, and the numbering matches the host
+    # dispatch (ascending min core point index)
+    is_root = core & (labels == arange_c)
+    gcum = jnp.cumsum(is_root.astype(jnp.int32))
+    root_count = jnp.zeros(r_pad, jnp.int32).at[
+        jnp.where(is_root, pair_rep, r_pad)].add(1, mode="drop")
+    roots_before = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(root_count)[:-1]])
+    grank = jnp.take(gcum, jnp.clip(labels, 0, max(c_pad - 1, 0))) - 1
+    dense_local = jnp.where(
+        labels < sent,
+        grank - jnp.take(roots_before, jnp.clip(pair_rep, 0, r_pad - 1)),
+        -1).astype(jnp.int32)
+    return dense_local, root_count, nb_overflow
+
+
+def grid_dbscan_reference(points, valid_rows, grid: GridStructure, *,
+                          neighbor_cap: int, eps: float, min_points: int):
+    """Standalone jitted entry over (R, N) validity rows (tests and
+    diagnostics); the post-process embeds :func:`grid_dbscan_pairs` in its
+    own program with device-side pair compaction instead. Returns (R, N)
+    dense labels (-1 noise/invalid)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    valid_rows = np.asarray(valid_rows)
+    r_pad, n = valid_rows.shape
+    rep, pt = np.nonzero(valid_rows)
+    c_pad = _bucket_pow2(max(len(rep), 1), minimum=8)
+    pair_rep = np.zeros(c_pad, np.int32)
+    pair_pt = np.zeros(c_pad, np.int32)
+    pair_valid = np.zeros(c_pad, bool)
+    pair_rep[: len(rep)] = rep
+    pair_pt[: len(rep)] = pt
+    pair_valid[: len(rep)] = True
+
+    fn = functools.partial(jax.jit, static_argnames=(
+        "r_pad", "cell_cap", "neighbor_cap", "eps", "min_points"))(
+        grid_dbscan_pairs)
+    dense, _, overflow = fn(
+        jnp.asarray(points), jnp.asarray(grid.order),
+        jnp.asarray(grid.start), jnp.asarray(grid.length),
+        jnp.asarray(pair_rep), jnp.asarray(pair_pt),
+        jnp.asarray(pair_valid), r_pad=r_pad, cell_cap=grid.cell_cap,
+        neighbor_cap=neighbor_cap, eps=float(eps),
+        min_points=int(min_points))
+    if bool(overflow):
+        raise ValueError(f"neighbor_cap {neighbor_cap} overflowed")
+    out = np.full((r_pad, n), -1, np.int32)
+    out[rep, pt] = np.asarray(dense)[: len(rep)]
+    return out

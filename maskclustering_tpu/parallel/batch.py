@@ -91,6 +91,7 @@ def pad_scene_batch(tensors_list: Sequence[SceneTensors], f_pad: int, n_pad: int
 def fused_scene_objects(
     out, index: int, tensors: SceneTensors, cfg: PipelineConfig, k_max: int,
     timings: Optional[Dict[str, float]] = None,
+    seq_name: Optional[str] = None,
 ) -> SceneObjects:
     """Host post-process of one scene of a FusedStepResult batch.
 
@@ -110,7 +111,10 @@ def fused_scene_objects(
         cfg, out_scene_points(tensors, n_pad), out.first_id[index],
         out.last_id[index], mask_frame, mask_id, out.mask_active[index],
         out.assignment[index], out.node_visible[index], frame_ids,
-        k_max=k_max, timings=timings, n_real=tensors.num_points)
+        k_max=k_max, timings=timings, n_real=tensors.num_points,
+        # the post fault seam needs the scene identity to fire on the
+        # fused-mesh path too (capacity drills must cover both paths)
+        seq_name=seq_name)
 
 
 def out_scene_points(tensors: SceneTensors, n_pad: int) -> np.ndarray:
@@ -150,6 +154,7 @@ def cluster_scene_batch(
     tensors_list: Sequence[SceneTensors],
     *,
     k_max: Optional[int] = None,
+    seq_names: Optional[Sequence[str]] = None,
 ) -> List[SceneObjects]:
     """Run a batch of scenes through the fused mesh step to SceneObjects.
 
@@ -170,7 +175,10 @@ def cluster_scene_batch(
     step = _cached_step(mesh, cfg, k_max)
     args = pad_scene_batch(tensors_list, f_pad, n_pad, num_scenes)
     out = jax.block_until_ready(step(*args))
-    return [fused_scene_objects(out, i, tensors_list[i], cfg, k_max)
+    names = (list(seq_names) if seq_names is not None
+             else [None] * len(tensors_list))
+    return [fused_scene_objects(out, i, tensors_list[i], cfg, k_max,
+                                seq_name=names[i])
             for i in range(len(tensors_list))]
 
 

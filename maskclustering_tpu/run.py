@@ -564,7 +564,8 @@ def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
                 # run_scene_device) instead of sleeping the supervisor
                 for seq, _, _ in batch:
                     faults.inject("device", seq)
-                return cluster_scene_batch(cfg, mesh, [b[2] for b in batch])
+                return cluster_scene_batch(cfg, mesh, [b[2] for b in batch],
+                                           seq_names=[b[0] for b in batch])
 
             objects_list = faults.call_with_deadline(
                 dispatch_batch, cfg.watchdog_device_s, seam="device",
@@ -678,6 +679,11 @@ def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
     - **retries** failed scenes whose error class is not terminal, up to
       ``cfg.scene_retries`` extra rounds with exponential backoff
       (``cfg.retry_backoff_s`` base, shared faults.RetryPolicy);
+      device-class failures additionally keep retrying while the
+      degradation ladder still has rungs to drop (bounded by the ladder
+      depth), so a deterministic device fault always reaches the rung
+      that heals it — e.g. a post-process capacity overflow reaches the
+      host-postprocess rung even at the default retry budget;
     - **degrades** one ladder rung per round that saw a device-class
       failure (overlapped -> sequential, fused mesh -> single chip,
       donation off, device -> host postprocess) — a sick chip costs
@@ -720,7 +726,17 @@ def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
             if st.status != "failed":
                 continue
             saw_device = saw_device or st.error_class == "device"
-            if (st.error_class != "terminal" and round_no <= cfg.scene_retries
+            # device-class failures keep retrying while the ladder still
+            # has rungs to drop: a deterministic device fault (e.g. a
+            # post-process capacity overflow) needs to reach the rung that
+            # heals it, and with a small scene_retries the budget would
+            # otherwise exhaust one rung short of host-postprocess. The
+            # extension is bounded by the ladder depth (<= 4 extra rounds)
+            in_budget = round_no <= cfg.scene_retries
+            ladder_can_help = (st.error_class == "device"
+                               and not ladder.exhausted)
+            if (st.error_class != "terminal"
+                    and (in_budget or ladder_can_help)
                     and not faults.stop_requested()):
                 retry.append(st.seq_name)
         if not retry:
@@ -1161,10 +1177,13 @@ def _run_pipeline_body(
                                         for s in report.scenes],
                              "obs": report.obs,
                              "faults": report.faults},
-                            # dtype attribution, same keys as bench rows:
+                            # knob attribution, same keys as bench rows:
                             # --regress flags flips instead of blaming code
                             count_dtype=cfg.count_dtype,
-                            plane_dtype="int16"))
+                            plane_dtype="int16",
+                            postprocess_path=("device"
+                                              if cfg.device_postprocess
+                                              else "host")))
         except Exception:  # noqa: BLE001 — the ledger must never fail the run
             log.exception("perf ledger append failed")
     return report

@@ -55,8 +55,11 @@ from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
 log = logging.getLogger("maskclustering_tpu")
 
 # seams a FaultPlan can target; these are the places run.py / models/
-# pipeline.py call inject() (see ARCHITECTURE.md §Fault tolerance)
-SEAMS = ("load", "device", "host", "export", "pull")
+# pipeline.py / models/postprocess_device.py call inject()
+# (see ARCHITECTURE.md §Fault tolerance); "post" fires at the head of the
+# device post-process chain — the seam that drives the ladder's
+# host-postprocess rung
+SEAMS = ("load", "device", "host", "export", "pull", "post")
 
 # error_class vocabulary stamped on SceneStatus / journal rows:
 #   retryable — transient by default (IO, unknown runtime errors)
@@ -112,6 +115,10 @@ class InjectedFault(RuntimeError):
 _DEVICE_ERROR_NAMES = frozenset({
     "XlaRuntimeError", "DeadlineExceeded", "UnavailableError",
     "InternalError", "ResourceExhaustedError",
+    # a scene overflowing a device post-process capacity bucket
+    # (models/postprocess_device.py) heals on the ladder's
+    # host-postprocess rung, so it must route through the device class
+    "PostprocessCapacityError",
 })
 # a retry cannot fix a programming/config error; fail fast and keep the
 # retry budget for faults that can actually heal
@@ -471,6 +478,18 @@ class FaultPlan:
                 raise InjectedFault(
                     f"injected terminal fault at {seam} seam of {scene}",
                     retryable=False)
+            elif seam == "post":
+                # the post seam's one real failure mode is a capacity
+                # overflow; injecting the production error type drives the
+                # production classification (device class) and therefore
+                # the ladder drop down to the host-postprocess rung
+                from maskclustering_tpu.models.postprocess_device import (
+                    PostprocessCapacityError,
+                )
+
+                raise PostprocessCapacityError(
+                    f"injected ({e.kind} fault at scene {scene})", -1, 0,
+                    "post_group_cap")
             else:  # fail / load / flaky
                 raise InjectedFault(
                     f"injected {e.kind} fault at {seam} seam of {scene}")

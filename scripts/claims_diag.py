@@ -1,30 +1,34 @@
-"""Attribute post.claims wall time: device kernel vs device->host pulls.
+"""Attribute post.claims wall time: device kernel vs device->host drain.
 
 The bench's ``post.claims`` phase (BENCH_builder_r05: ~0.97 s at the honest
-shape) spans three very different costs — the `_node_stats_kernel` dispatch
-+ execution, the bit-packed claimed-plane pull, and host prep. A back-of-
-envelope HBM/FLOP floor for the kernel is tens of ms, so if the phase is
-~1 s the money is either in a fusion failure (visible to a profiler) or in
-the driver rig's ~MB/s tunnel (invisible to one). This script separates
-them on the live chip in one run:
+shape) spans very different costs — the `_node_stats_kernel` dispatch +
+execution and whatever crosses the host boundary. A back-of-envelope
+HBM/FLOP floor for the kernel is tens of ms, so if the phase is ~1 s the
+money is either in a fusion failure (visible to a profiler) or in the
+driver rig's ~MB/s tunnel (invisible to one). This script separates them
+on the live chip in one run:
 
     python scripts/claims_diag.py [--frames 250 --points 196608 --boxes 36]
 
 It replays bench.py's scene through associate -> graph -> cluster, then
 times, over 5 repeats each:
   kernel        `_node_stats_kernel` with a 1-element sync (device time)
-  pull_claimed  np.asarray of the (r_pull, N/8) claimed plane
-  pull_ratio    np.asarray of the ratio plane (what copy_to_host_async hides)
+  postprocess   the full device post-process (emit-only drain path)
   pull_plane16  np.asarray of one full (F, N) int16 claim plane — the
-                non-device-postprocess drain unit, HALVED by the int16
-                narrowing (was int32); reported with its byte size so the
-                record shows what the narrowing saves at the rig's real rate
-  pull_calib    np.asarray of a fresh device buffer of the claimed plane's
+                RETIRED drain unit: the host-postprocess path pulls two of
+                these per scene; the emit-only drain pulls none. Reported
+                with its byte size so the chip-session record shows the
+                before/after next to the emit-drain bytes line
+  pull_calib    np.asarray of a fresh device buffer of the emit drain's
                 byte size (pure tunnel rate at that size, for comparison)
 
+The emit-only drain line reports the bytes the device path ACTUALLY moves
+per scene (surviving objects' bit-packed point planes + the intersection
+matrix + O(M_pad + S) scalars) next to the retired int16 plane-pull line.
+
 Interpretation: if kernel >> floor, capture a trace (bench --profile-dir)
-and look at the one-hot/dot fusion; if pull_* ~ pull_calib dominates, the
-phase is tunnel-bound — a rig artifact that PCIe on a real TPU-VM removes.
+and look at the one-hot/dot fusion; if the drain ~ pull_calib dominates,
+the phase is tunnel-bound — a rig artifact PCIe on a real TPU-VM removes.
 """
 
 import argparse
@@ -82,9 +86,13 @@ def main():
                                                     resize_scene_points)
 
     setup_compilation_cache()
+    # donate_buffers=False: the script re-times the post-process (and the
+    # retired plane pull) against the SAME first/last planes repeatedly —
+    # the production donation would delete them after the first call on
+    # any backend where the aliasing is usable
     cfg = PipelineConfig(config_name="bench", dataset="demo",
                          distance_threshold=0.01, few_points_threshold=25,
-                         point_chunk=8192)
+                         point_chunk=8192, donate_buffers=False)
 
     print(f"[claims_diag] scene: F={args.frames} N={args.points} "
           f"boxes={args.boxes}", flush=True)
@@ -144,18 +152,51 @@ def main():
         _sync(out[0])
         return out
 
-    claimed_p, ratio_p, nv_rep = kernel()
+    kernel()
+
+    # the production emit-only drain: run the whole device post-process and
+    # account its actual per-scene transfer payload
+    from maskclustering_tpu.models.postprocess_device import (
+        _bucket_pow2, run_postprocess)
+
+    def postprocess():
+        # n_real keeps the shape-bucket sentinel pads out of the voxel
+        # grid (a pad run binned into one cell would blow cell_cap up by
+        # orders of magnitude and poison exactly this timing)
+        return run_postprocess(
+            cfg, np.asarray(tensors.scene_points), assoc.first_id,
+            assoc.last_id, table.frame, table.mask_id, jnp.asarray(active),
+            result.assignment, result.node_visible,
+            list(range(f)), k_max=args.k_max, n_real=args.points)
+
+    # measure the drain bytes the path ACTUALLY books (obs counters are
+    # unconditional), not an estimate — the group axis is sized from the
+    # true total at runtime, so a static guess would overstate the drain
+    from maskclustering_tpu.obs.metrics import registry
+
+    postprocess()  # warm (compile) outside the measured call
+    registry().reset()
+    objects = postprocess()
+    emit_b = int(registry().snapshot()["counters"].get(
+        "d2h.bytes.post.drain", 0))
+    registry().reset()
+    o = len(objects.point_ids_list)
+    o_pad = _bucket_pow2(o, minimum=8)
+    emit_mb = emit_b / 1e6
+
     # calibration source: XOR with a fresh constant per call so every
     # np.asarray transfers a NEW device array of the same byte size —
     # jax.Array caches its host copy, so re-pulling one array is ~free
     # and would read as a fantasy tunnel rate
     calib_seq = iter(range(1, 1000))
+    calib_rows = max(1, emit_b // max(n // 8, 1))
+    calib_src = jnp.zeros((calib_rows, n // 8), jnp.uint8)
 
     def pull_calib():
-        return np.asarray(claimed_p[:r_pull] ^ np.uint8(next(calib_seq)))
+        return np.asarray(calib_src ^ np.uint8(next(calib_seq)))
 
-    # full (F, N) int16 claim plane: the drain unit of the non-device
-    # postprocess path (and the byte size the int16 narrowing halved).
+    # full (F, N) int16 claim plane: the RETIRED drain unit of the
+    # host-postprocess path (and the byte size the int16 narrowing halved).
     # Same fresh-buffer XOR trick — jax.Array caches its host copy.
     def pull_plane16():
         return np.asarray(assoc.first_id ^ jnp.int16(next(calib_seq)))
@@ -164,19 +205,23 @@ def main():
     plane_mb = (f * n * 2) / 1e6
     print("[claims_diag] timings (median of 5):", flush=True)
     t_kernel = timeit("kernel", kernel)
-    t_claim = timeit("pull_claimed", lambda: np.asarray(claimed_p[:r_pull]))
-    t_ratio = timeit("pull_ratio", lambda: np.asarray(ratio_p[:r_pull]))
+    t_post = timeit("postprocess", postprocess)
     t_plane = timeit("pull_plane16", pull_plane16)
     t_calib = timeit("pull_calib", pull_calib)
-    mb = (r_pull * (n // 8)) / 1e6
     print(f"[claims_diag] kernel={t_kernel*1e3:.0f}ms "
-          f"claimed_pull={t_claim*1e3:.0f}ms ratio_pull={t_ratio*1e3:.0f}ms "
-          f"calib({mb:.2f}MB)={t_calib*1e3:.0f}ms "
-          f"-> tunnel {mb/max(t_calib,1e-9):.1f} MB/s", flush=True)
-    print(f"[claims_diag] int16 claim plane drain: {plane_mb:.1f} MB/plane "
-          f"(int32 layout would be {plane_mb*2:.1f} MB) in "
-          f"{t_plane*1e3:.0f}ms -> {plane_mb/max(t_plane,1e-9):.1f} MB/s; "
-          f"x2 planes/scene on the host-postprocess path", flush=True)
+          f"postprocess={t_post*1e3:.0f}ms "
+          f"calib({emit_mb:.2f}MB)={t_calib*1e3:.0f}ms "
+          f"-> tunnel {emit_mb/max(t_calib,1e-9):.1f} MB/s", flush=True)
+    print(f"[claims_diag] emit-only drain: {emit_mb:.2f} MB/scene "
+          f"({o} objects -> {o_pad} x {n//8}B packed planes + "
+          f"{o}x{o} inter + O(M+S) scalars); claim planes stay in HBM",
+          flush=True)
+    print(f"[claims_diag] retired int16 claim plane pull: {plane_mb:.1f} "
+          f"MB/plane x2/scene on the host-postprocess path (int32 layout "
+          f"would be {plane_mb*2:.1f} MB) in {t_plane*1e3:.0f}ms -> "
+          f"{plane_mb/max(t_plane,1e-9):.1f} MB/s; the emit-only drain "
+          f"moves {emit_mb:.2f} MB instead "
+          f"({2*plane_mb/max(emit_mb,1e-9):.0f}x less)", flush=True)
 
 
 if __name__ == "__main__":

@@ -872,7 +872,8 @@ def test_transfer_guard_two_scene_pipeline_byte_identity():
     from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
 
     cfg = _small_cfg()
-    scenes = [make_scene(num_boxes=3, num_frames=6, seed=s) for s in (3, 4)]
+    scenes = [make_scene(num_boxes=3, num_frames=6, seed=s, spacing=0.05)
+              for s in (3, 4)]
 
     def run_all():
         return [run_scene(to_scene_tensors(s), cfg, k_max=15)

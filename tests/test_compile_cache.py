@@ -86,7 +86,7 @@ def test_heterogeneous_scenes_share_buckets():
     cfg = _config()
     reset_shape_buckets()
     for i in range(10):
-        scene = make_scene(num_boxes=3, num_frames=5 + i, seed=i)
+        scene = make_scene(num_boxes=3, num_frames=5 + i, seed=i, spacing=0.05)
         run_scene(to_scene_tensors(scene), cfg, k_max=15)
     buckets = {b for b in seen_shape_buckets() if b[0] == "scene"}
     assert 1 <= len(buckets) <= 3, buckets
@@ -103,7 +103,7 @@ def test_padded_pipeline_matches_exact_shapes():
     points to 8192."""
     from maskclustering_tpu.models.pipeline import bucket_size
 
-    scene = make_scene(num_boxes=4, num_frames=12, seed=21)
+    scene = make_scene(num_boxes=4, num_frames=12, seed=21, spacing=0.04)
     t = to_scene_tensors(scene)
     keep = 6144
     t.scene_points = np.ascontiguousarray(t.scene_points[:keep])

@@ -121,7 +121,7 @@ def test_scene_artifacts_identical_across_count_dtype():
     covering the device postprocess, the chunked int16-plane-era claims
     drain (claims_pull_chunk=1: adversarial 1-row slices), and the host
     postprocess path (which pulls the full int16 planes)."""
-    scene = make_scene(num_boxes=4, num_frames=10, seed=21)
+    scene = make_scene(num_boxes=4, num_frames=10, seed=21, spacing=0.04)
     tensors = to_scene_tensors(scene)
     base = run_scene(tensors, _config(count_dtype="bf16"), k_max=15)
     for kw, tag in (

@@ -7,9 +7,9 @@ Pins the acceptance contract of the async double-buffered pipeline:
 - the obs run report measures an overlap ratio (sum of per-stage span time
   over scene-loop wall time) > 1 on a >= 4-scene CPU run — overlap is
   measured, not argued;
-- the per-scene pipeline performs exactly TWO blocking host pulls
-  (mask table + assignment; the observer schedule's 20-float mid-pipeline
-  round-trip is gone), pinned by span counting;
+- the per-scene pipeline performs exactly ONE blocking host pull (the
+  mask table; the assignment pull moved on device with the
+  device-resident post-process), pinned by span counting;
 - the disk-prefetch lookahead depth is configurable with deterministic
   ordering and failure attribution at depth 0/1/2.
 """
@@ -48,6 +48,7 @@ def pipelined_run(tmp_path_factory):
     names = []
     for i in range(N_SCENES):
         scene = make_scene(num_boxes=3, num_frames=10, image_hw=(60, 80),
+                           spacing=0.06,
                            seed=40 + i)
         names.append(f"scene{i:04d}_00")
         write_scannet_layout(scene, root, names[-1])
@@ -114,25 +115,26 @@ def test_overlap_ratio_measured(pipelined_run):
 
 
 def test_host_sync_budget(pipelined_run):
-    """Span-counting acceptance: exactly TWO pipeline host syncs per scene
-    (graph's mask-table pull + cluster's assignment pull). The graph
-    stage's former observer-histogram pull is gone — no d2h bytes are
-    booked to 'graph' anymore."""
+    """Span-counting acceptance: exactly ONE pipeline host sync per scene
+    (graph's mask-table pull). The cluster stage's former assignment pull
+    is gone — the device post-process consumes the assignment in HBM and
+    the report copy rides the post-process drain (PR 8); the graph stage's
+    former observer-histogram pull is long gone too."""
     run_events = [e for e in obs.read_events(pipelined_run["events"])
                   if e.get("kind") == "span"]
     pulls = [e for e in run_events if (e.get("attrs") or {}).get("host_pull")]
-    # 2 per scene, and only ever in the graph / cluster stages
-    assert len(pulls) == 2 * N_SCENES
-    assert {e["name"] for e in pulls} == {"graph", "cluster"}
+    # 1 per scene, and only ever in the graph stage
+    assert len(pulls) == 1 * N_SCENES
+    assert {e["name"] for e in pulls} == {"graph"}
     by_scene = {}
     for e in pulls:
         by_scene.setdefault(e["attrs"].get("scene"), []).append(e["name"])
-    assert all(sorted(v) == ["cluster", "graph"] for v in by_scene.values())
+    assert all(v == ["graph"] for v in by_scene.values())
 
     from maskclustering_tpu.obs.report import RunData
 
     counters = RunData(pipelined_run["events"]).summary()["counters"]
-    assert counters.get("pipeline.host_sync") == 2 * N_SCENES
+    assert counters.get("pipeline.host_sync") == 1 * N_SCENES
     # the schedule no longer crosses to host mid-pipeline
     summary_stages = RunData(pipelined_run["events"]).stage_rows()
     graph_row = next(r for r in summary_stages if r["stage"] == "graph")

@@ -4,12 +4,14 @@ Pins the acceptance contract of the fault-tolerant scene executor
 (utils/faults.py + the run.py scene supervisor):
 
 - a canned FaultPlan (one persistent load failure, one device stall, one
-  flaky-then-ok scene) through a 4-scene CPU run yields: the flaky scene
-  succeeds on retry, the stalled scene raises DeviceStallError within the
-  watchdog deadline and the run degrades one ladder rung, exactly ONE
-  scene ends failed, the journal replays to the report's exact verdict,
-  and every passing scene's artifacts are byte-identical to a fault-free
-  run;
+  flaky-then-ok scene, one persistent post-seam capacity fault) through a
+  4-scene CPU run yields: the flaky scene succeeds on retry, the stalled
+  scene raises DeviceStallError within the watchdog deadline and the run
+  degrades one ladder rung, the post-capacity scene rides the ladder down
+  to the host-postprocess rung and heals there (its artifacts still
+  byte-identical), exactly ONE scene ends failed, the journal replays to
+  the report's exact verdict, and every passing scene's artifacts are
+  byte-identical to a fault-free run;
 - SIGTERM mid-run journals in-flight scenes, writes a valid partial
   run_report.json, and the rerun skips journaled-done scenes, re-runs
   in-flight ones, and ends with artifacts byte-identical to an
@@ -141,6 +143,16 @@ def test_error_classification():
 
     assert faults.classify_error(XlaRuntimeError("wedged")) == "device"
 
+    # a device post-process capacity overflow must route device-class so
+    # the ladder's host-postprocess rung can heal it
+    from maskclustering_tpu.models.postprocess_device import (
+        PostprocessCapacityError,
+    )
+
+    err = PostprocessCapacityError("DBSCAN group", 600, 512, "post_group_cap")
+    assert faults.classify_error(err) == "device"
+    assert "post_group_cap" in str(err) and "600 > 512" in str(err)
+
 
 def test_fault_plan_parse_and_fire():
     plan = faults.FaultPlan.from_spec(
@@ -170,6 +182,18 @@ def test_fault_plan_parse_and_fire():
     for bad in ("boom:s1", "load:s1.warp", "stall:s1:0", "load:", "justload"):
         with pytest.raises(ValueError):
             faults.FaultPlan.from_spec(bad)
+
+    # the post seam raises the production capacity error type, so the
+    # injected fault classifies device and drives the real ladder path
+    from maskclustering_tpu.models.postprocess_device import (
+        PostprocessCapacityError,
+    )
+
+    post_plan = faults.FaultPlan.from_spec("fail:s7.post:1")
+    with pytest.raises(PostprocessCapacityError) as pe:
+        post_plan.fire("post", "s7")
+    assert faults.classify_error(pe.value) == "device"
+    post_plan.fire("post", "s7")  # count exhausted: no-op
 
 
 def test_fault_plan_env_activation(monkeypatch):
@@ -287,6 +311,9 @@ def fault_run(tmp_path_factory):
     for i, seq in enumerate(SCENES):
         write_scannet_layout(
             make_scene(num_boxes=2, num_frames=6, image_hw=(40, 56),
+                       spacing=0.05,  # ~8k-point clouds: the faulted run
+                       # re-runs scenes up to 4x, and the device
+                       # post-process split is paid per attempt
                        seed=70 + i),  # the tiny bucket (see module doc)
             root, seq)
 
@@ -299,7 +326,8 @@ def fault_run(tmp_path_factory):
     assert [s.status for s in ref.scenes] == ["ok"] * 4
 
     plan = faults.FaultPlan.from_spec(
-        f"load:{SCENES[0]}, stall:{SCENES[1]}.device, flaky:{SCENES[2]}:2",
+        f"load:{SCENES[0]}, stall:{SCENES[1]}.device, flaky:{SCENES[2]}:2, "
+        f"fail:{SCENES[3]}.post",
         stall_s=STALL_S)
     events = os.path.join(root, "flt_events.jsonl")
     report_path = os.path.join(root, "flt_report.json")
@@ -316,6 +344,12 @@ def fault_run(tmp_path_factory):
     lock_sanitizer.reset()
     undo_locks = lock_sanitizer.instrument_known_locks()
     try:
+        # DEFAULT retry budget (scene_retries=2) on purpose: the
+        # persistent post-seam capacity fault needs three degradation
+        # rounds (sequential-executor -> donation-off -> host-postprocess)
+        # and only reaches the healing host rung via the supervisor's
+        # device-class ladder extension — the exact default-config path a
+        # real capacity overflow takes
         flt = run_pipeline(
             _cfg(root, config_name="flt", watchdog_device_s=WATCHDOG_S),
             SCENES, steps=("cluster",), resume=False,
@@ -337,12 +371,15 @@ def fault_run(tmp_path_factory):
 
 def test_acceptance_statuses_and_attribution(fault_run):
     """The ISSUE's acceptance matrix: flaky heals on retry, the stall is a
-    typed in-deadline failure that degrades the run one rung, and exactly
-    one scene (the persistent load failure) ends failed."""
+    typed in-deadline failure that degrades the run one rung, the
+    persistent post-seam capacity fault rides the ladder down to the
+    host-postprocess rung and heals there, and exactly one scene (the
+    persistent load failure) ends failed."""
     by = {s.seq_name: s for s in fault_run["flt"].scenes}
     assert [s.seq_name for s in fault_run["flt"].scenes] == SCENES
     # exactly one scene ends failed: the persistent load failure, after
-    # the full retry budget (1 + 2 retries)
+    # the full RETRYABLE budget (1 + 2 retries — the ladder extension is
+    # device-class only, so the load fault does NOT get a fourth attempt)
     assert [s.seq_name for s in fault_run["flt"].failed] == [SCENES[0]]
     assert by[SCENES[0]].attempts == 3
     assert by[SCENES[0]].error_class == "retryable"
@@ -355,20 +392,25 @@ def test_acceptance_statuses_and_attribution(fault_run):
     # the flaky scene: two scripted failures, third attempt succeeds
     assert by[SCENES[2]].status == "ok"
     assert by[SCENES[2]].attempts == 3
-    # the healthy scene: untouched, full configuration
+    # the post-capacity scene: the device-class PostprocessCapacityError
+    # keeps firing while cfg.device_postprocess holds; the budget covers
+    # rounds 2-3 and the device-class ladder extension grants round 4,
+    # where the host-postprocess rung finally heals it
     assert by[SCENES[3]].status == "ok"
-    assert by[SCENES[3]].attempts == 1
-    assert by[SCENES[3]].degradation_rung == 0
+    assert by[SCENES[3]].attempts == 4
+    assert by[SCENES[3]].degradation_rung == 3
 
     faults_digest = fault_run["flt"].faults
     # exactly one: the injected stall fires once and the pull seams do not
     # nest a second same-budget deadline that would double-count it
     assert faults_digest["device_stalls"] == 1
-    assert faults_digest["degradations"] == {"sequential-executor": 1}
-    assert faults_digest["final_rung"] == 1
+    assert faults_digest["degradations"] == {
+        "sequential-executor": 1, "donation-off": 1, "host-postprocess": 1}
+    assert faults_digest["final_rung"] == 3
     assert not faults_digest["interrupted"]
-    # retry rounds: 3 scenes retried after round 1, 2 after round 2
-    assert faults_digest["scene_retries"] == 5
+    # retry rounds: 4 scenes retried after round 1, 3 after round 2,
+    # 1 (the ladder extension) after round 3
+    assert faults_digest["scene_retries"] == 8
 
 
 def test_acceptance_stall_is_deadline_bounded(fault_run):
@@ -407,7 +449,8 @@ def test_acceptance_journal_replays_report(fault_run):
     run_report.json loses no attribution."""
     replay = faults.replay_journal(fault_run["journal"], config="flt")
     saved = json.load(open(fault_run["report_path"]))
-    assert saved["faults"]["degradations"] == {"sequential-executor": 1}
+    assert saved["faults"]["degradations"] == {
+        "sequential-executor": 1, "donation-off": 1, "host-postprocess": 1}
     for scene in saved["scenes"]:
         r = replay[scene["seq_name"]]
         assert r["status"] == scene["status"], scene
@@ -425,14 +468,20 @@ def test_acceptance_obs_faults_surfaces(fault_run):
     run = RunData(fault_run["events"])
     text = render_report(run)
     assert "== faults ==" in text
-    assert "scene retries 5" in text
+    assert "scene retries 8" in text
     assert "sequential-executor x1" in text
+    assert "host-postprocess x1" in text
     assert "injected (fault plan)" in text
     counters = run.summary()["counters"]
-    assert counters["run.scene_retries"] == 5
+    assert counters["run.scene_retries"] == 8
     assert counters["run.degradations.sequential-executor"] == 1
+    assert counters["run.degradations.donation-off"] == 1
+    assert counters["run.degradations.host-postprocess"] == 1
     assert counters["faults.injected.load"] == 3  # one per attempt
     assert counters["faults.injected.device"] == 3  # 1 stall + 2 flaky
+    # the post-seam capacity fault fired on every device-postprocess
+    # attempt (rungs 1-3); the healed host-rung attempt reaches no seam
+    assert counters["faults.injected.post"] == 3
 
 
 def test_acceptance_lock_sanitizer_embeds_in_static_graph(fault_run):

@@ -90,11 +90,28 @@ def test_check_regression_thresholds():
 
 def test_bench_row_carries_dtype_attribution():
     row = led.bench_row(_verdict(3.2, count_dtype="int8",
-                                 plane_dtype="int16"))
+                                 plane_dtype="int16",
+                                 postprocess_path="host"))
     assert row["count_dtype"] == "int8"
     assert row["plane_dtype"] == "int16"
+    assert row["postprocess_path"] == "host"
     # rows predating the knob simply lack the keys — no synthesized default
     assert "count_dtype" not in led.bench_row(_verdict(3.2))
+    assert "postprocess_path" not in led.bench_row(_verdict(3.2))
+
+
+def test_check_regression_flags_postprocess_path_flip():
+    """A --host-postprocess A/B row must be attributed to the knob, not
+    read as drift; pre-knob rows compare as the device default."""
+    base = {"value": 1.0, "postprocess_path": "device"}
+    ok, lines = led.check_regression(
+        {"value": 1.1, "postprocess_path": "host"}, base)
+    assert ok
+    assert any("postprocess_path: device -> host" in ln for ln in lines)
+    # no flip (current device vs keyless pre-knob baseline) -> no noise
+    ok, lines = led.check_regression(
+        {"value": 1.0, "postprocess_path": "device"}, {"value": 1.0})
+    assert not any("postprocess_path" in ln for ln in lines)
 
 
 def test_check_regression_flags_dtype_flip():

@@ -1,10 +1,17 @@
 """Device postprocess vs host postprocess: byte-identical artifacts.
 
-The device path (models/postprocess_device.py) keeps the (F, N) claim
-tensors in HBM and transfers only bit-packed planes; it must reproduce the
-host path (models/postprocess.py) exactly — same objects, same point ids,
-same mask lists in the same order — because both implement reference
-utils/post_process.py:40-170 semantics.
+The device path (models/postprocess_device.py) consumes the (F, N) claim
+planes in HBM — grid-DBSCAN split, group structures, mask assignment and
+the merge intersection counts all run on device, and only the emit-only
+drain (surviving objects' bit-packed planes + O(M+S) scalars) crosses to
+host. It must reproduce the host path (models/postprocess.py) exactly —
+same objects, same point ids, same mask lists in the same order — because
+both implement reference utils/post_process.py:40-170 semantics.
+
+Budget note: pipeline-running tests here use spacing-0.04/0.05 synthetic
+clouds (10-16k points) — the CPU cost of the grid-DBSCAN pack pass scales
+with cloud density, and the full-density (63k) identity run plus the mesh
+lattice sweep are slow-marked.
 """
 
 import numpy as np
@@ -34,7 +41,11 @@ def test_pack_unpack_roundtrip(rng):
 
 @pytest.mark.parametrize("seed,num_boxes", [(21, 4), (5, 6)])
 def test_device_matches_host_postprocess(seed, num_boxes):
-    scene = make_scene(num_boxes=num_boxes, num_frames=10, seed=seed)
+    # spacing 0.04: ~16k-point clouds keep real DBSCAN structure (~20
+    # in-eps neighbors at eps 0.1) at 1/4 the full-density cloud — the
+    # full-density run is the slow-marked variant below
+    scene = make_scene(num_boxes=num_boxes, num_frames=10, seed=seed,
+                       spacing=0.04)
     tensors = to_scene_tensors(scene)
     res_host = run_scene(tensors, _config(device_postprocess=False), k_max=15)
     res_dev = run_scene(tensors, _config(device_postprocess=True), k_max=15)
@@ -63,7 +74,8 @@ def test_device_postprocess_chunk_fallbacks(num_frames, fpm, expect_chunk):
     f_pad = bucket_size(num_frames, fpm)
     assert _frame_chunk(f_pad) == expect_chunk
 
-    scene = make_scene(num_boxes=3, num_frames=num_frames, seed=11)
+    scene = make_scene(num_boxes=3, num_frames=num_frames, seed=11,
+                       spacing=0.04)
     tensors = to_scene_tensors(scene)
     res_host = run_scene(
         tensors, _config(device_postprocess=False, frame_pad_multiple=fpm),
@@ -80,7 +92,7 @@ def test_device_postprocess_chunk_fallbacks(num_frames, fpm, expect_chunk):
 
 def test_device_postprocess_empty_scene():
     """A scene with no recoverable masks yields an empty object list."""
-    scene = make_scene(num_boxes=2, num_frames=4, seed=3)
+    scene = make_scene(num_boxes=2, num_frames=4, seed=3, spacing=0.04)
     tensors = to_scene_tensors(scene)
     # zero out every segmentation -> no masks -> no live reps
     import dataclasses
@@ -100,8 +112,7 @@ def test_node_stats_kernel_dedupes_same_rep_claims():
     """
     import jax.numpy as jnp
 
-    from maskclustering_tpu.models.postprocess_device import (
-        _node_stats_kernel, _unpack_bits)
+    from maskclustering_tpu.models.postprocess_device import _node_stats_kernel
 
     f, n, k2, r_pad = 3, 16, 6, 8
     first = np.zeros((f, n), np.int32)
@@ -127,11 +138,11 @@ def test_node_stats_kernel_dedupes_same_rep_claims():
     live_valid = np.zeros(r_pad, bool)
     live_valid[:2] = True
 
-    claimed_p, ratio_p, nv_rep = _node_stats_kernel(
+    claimed_d, ratio_d, nv_rep = _node_stats_kernel(
         jnp.asarray(first), jnp.asarray(last), jnp.asarray(rep_tab),
         jnp.asarray(node_visible), jnp.asarray(live_slots),
         jnp.asarray(live_valid), r_pad=r_pad, point_filter_threshold=0.5)
-    claimed = _unpack_bits(np.asarray(claimed_p), n)
+    claimed = np.asarray(claimed_d)
 
     want_claimed = np.zeros((r_pad, n), bool)
     want_claimed[0, [0, 1, 2]] = True  # rep 0 claims points 0 (x2 frames), 1, 2
@@ -140,7 +151,7 @@ def test_node_stats_kernel_dedupes_same_rep_claims():
 
     # ratio numerator must count point 0 / rep 0 as 1 triple in frame 0 plus
     # 1 in frame 1 = 2; denominator = 2 visible frames -> ratio 1.0 > 0.5
-    ratio_ok = _unpack_bits(np.asarray(ratio_p), n)
+    ratio_ok = np.asarray(ratio_d)
     assert ratio_ok[0, 0] and ratio_ok[0, 1] and ratio_ok[0, 2]
     assert ratio_ok[1, 1]
     assert not ratio_ok[0, 5] and not ratio_ok[1, 5]
@@ -148,18 +159,18 @@ def test_node_stats_kernel_dedupes_same_rep_claims():
     # discriminating threshold: a failed dedupe would give point 0 / rep 0
     # num = 3 over den = 2 (ratio 1.5 > 1.25); the correct unique-triple
     # count gives exactly 1.0, which must NOT pass
-    _, ratio_hi_p, _ = _node_stats_kernel(
+    _, ratio_hi_d, _ = _node_stats_kernel(
         jnp.asarray(first), jnp.asarray(last), jnp.asarray(rep_tab),
         jnp.asarray(node_visible), jnp.asarray(live_slots),
         jnp.asarray(live_valid), r_pad=r_pad, point_filter_threshold=1.25)
-    assert not _unpack_bits(np.asarray(ratio_hi_p), n)[0, 0]
+    assert not np.asarray(ratio_hi_d)[0, 0]
 
 
 def test_chunked_claims_pull_identity():
     """The chunked double-buffered bit-plane drain (claims_pull_chunk)
     reproduces the single blocking pull byte-for-byte — 1-row chunks are
     the adversarial maximum (every live rep drains as its own slice)."""
-    scene = make_scene(num_boxes=4, num_frames=10, seed=21)
+    scene = make_scene(num_boxes=4, num_frames=10, seed=21, spacing=0.04)
     tensors = to_scene_tensors(scene)
     res_one = run_scene(tensors, _config(claims_pull_chunk=0), k_max=15)
     res_many = run_scene(tensors, _config(claims_pull_chunk=1), k_max=15)
@@ -183,3 +194,218 @@ def test_row_chunks_cover_exactly():
             np.testing.assert_array_equal(got, np.asarray(arr[:rows]))
             if chunk > 0:
                 assert all(c.shape[0] <= chunk for c in chunks)
+
+
+# ---------------------------------------------------------------------------
+# grid DBSCAN (ops/grid_dbscan.py): device split vs the host dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_grid_dbscan_matches_host_dispatch():
+    """The device voxel-grid kernel reproduces the host DBSCAN dispatch
+    (ops/dbscan.dbscan_labels — native C++ or sklearn) label-for-label:
+    same cluster numbering (ascending min core point index), same border
+    attachment, same noise, per instance row."""
+    from maskclustering_tpu.ops.dbscan import dbscan_labels
+    from maskclustering_tpu.ops.grid_dbscan import (
+        build_grid, grid_dbscan_reference)
+
+    for seed, n, eps, min_pts in [(0, 400, 0.25, 4), (1, 700, 0.15, 6),
+                                  (2, 150, 0.4, 3), (3, 500, 0.08, 2)]:
+        r = np.random.default_rng(seed)
+        pts = (r.random((n, 3)) * 2.0).astype(np.float32)
+        valid = r.random((5, n)) < 0.35
+        valid[4] = False  # an empty instance row must stay all-noise
+        grid = build_grid(pts, eps)
+        out = grid_dbscan_reference(pts, valid, grid, neighbor_cap=512,
+                                    eps=eps, min_points=min_pts)
+        for row in range(5):
+            ids = np.nonzero(valid[row])[0]
+            if len(ids):
+                np.testing.assert_array_equal(
+                    out[row][ids], dbscan_labels(pts[ids], eps, min_pts),
+                    err_msg=f"seed={seed} row={row}")
+            assert np.all(out[row][~valid[row]] == -1)
+
+
+def test_build_grid_excludes_sentinel_pads():
+    """Shape-bucket pad points share ONE sentinel coordinate; binning them
+    would put the whole pad run in a single voxel and blow the static
+    candidate window (cell_cap) up by orders of magnitude. n_real keeps
+    them out of the grid entirely."""
+    from maskclustering_tpu.ops.grid_dbscan import build_grid
+
+    r = np.random.default_rng(0)
+    real = (r.random((500, 3)) * 3.0).astype(np.float32)
+    padded = np.concatenate(
+        [real, np.full((2000, 3), -100.0, np.float32)], axis=0)
+    g_pad = build_grid(padded, 0.25)
+    g_real = build_grid(padded, 0.25, n_real=500)
+    assert g_pad.cell_cap >= 2000  # the pad voxel dominates
+    assert g_real.cell_cap == build_grid(real, 0.25).cell_cap
+    assert len(g_real.order) == 500
+    np.testing.assert_array_equal(g_real.start, build_grid(real, 0.25).start)
+
+
+def test_merge_from_counts_matches_set_merge():
+    """The device-counted merge replays the reference's greedy suppression
+    over precomputed intersection integers — same survivors, same order,
+    as the frozenset loop, including the first-passing-test-wins
+    asymmetry."""
+    from maskclustering_tpu.models.postprocess import (
+        _merge_overlapping, merge_from_counts)
+
+    r = np.random.default_rng(7)
+    for trial in range(8):
+        num = int(r.integers(2, 9))
+        pool = np.arange(300)
+        point_ids, bboxes, masks = [], [], []
+        for i in range(num):
+            k = int(r.integers(5, 120))
+            ids = np.sort(r.choice(pool, size=k, replace=False)).astype(np.int32)
+            point_ids.append(ids)
+            # coordinates proportional to ids so heavy point overlap =>
+            # overlapping bboxes (and disjoint sets can still overlap)
+            lo = np.array([ids.min() / 100.0] * 3, np.float32)
+            hi = np.array([ids.max() / 100.0 + 0.01] * 3, np.float32)
+            bboxes.append((lo, hi))
+            masks.append([("f", i, 1.0)])
+        inter = np.zeros((num, num), np.float32)
+        for i in range(num):
+            for j in range(num):
+                inter[i, j] = len(
+                    frozenset(point_ids[i].tolist())
+                    & frozenset(point_ids[j].tolist()))
+        sizes = np.array([len(p) for p in point_ids])
+        ref_p, ref_m = _merge_overlapping(point_ids, bboxes, masks, 0.6)
+        got_p, got_m = merge_from_counts(point_ids, bboxes, masks, sizes,
+                                         inter, 0.6)
+        assert ref_m == got_m, f"trial {trial}"
+        assert len(ref_p) == len(got_p)
+        for a, b in zip(ref_p, got_p):
+            np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# emit-only drain + capacity ladder
+# ---------------------------------------------------------------------------
+
+
+def _tiny_scene(seed=70):
+    scene = make_scene(num_boxes=2, num_frames=6, image_hw=(40, 56),
+                       spacing=0.05, seed=seed)
+    return to_scene_tensors(scene)
+
+
+def _tiny_config(**kw):
+    return PipelineConfig(
+        config_name="synthetic", dataset="demo", backend="cpu",
+        distance_threshold=0.05, step=1, mask_pad_multiple=32,
+        frame_pad_multiple=4, point_chunk=2048, **kw)
+
+
+def test_emit_only_drain_books_no_plane_pull():
+    """Acceptance: the default (device) path never pulls an (F, N) claim
+    plane — its whole d2h budget is the final compact drain — while the
+    host path's first transfer alone is two full planes. Counter-based
+    twin of test_executor's span pin."""
+    from maskclustering_tpu.obs.metrics import registry
+
+    tensors = _tiny_scene()
+    reg = registry()
+
+    reg.reset()
+    res_dev = run_scene(tensors, _tiny_config(device_postprocess=True),
+                        k_max=15)
+    dev = reg.snapshot()["counters"]
+
+    reg.reset()
+    res_host = run_scene(tensors, _tiny_config(device_postprocess=False),
+                         k_max=15)
+    host = reg.snapshot()["counters"]
+
+    f_pad, n_pad = 8, 2048  # 6 frames -> pad 8; tiny point bucket
+    plane_bytes = f_pad * n_pad * 2  # one (F, N) int16 plane
+    # host path: the host_pull drains BOTH planes (+ node_visible)
+    assert host.get("d2h.bytes.postprocess", 0) >= 2 * plane_bytes
+    # device path: nothing booked to the host-pull stage, and the whole
+    # emit-only drain stays under the host path's pull even at this TINY
+    # shape, where the O(M_pad + S) scalar payload is at its relative
+    # worst (the drain does not scale with F x N — at the honest bucket
+    # the planes are ~98 MB and the drain ~0.1 MB, see claims_diag)
+    assert "d2h.bytes.postprocess" not in dev
+    assert 0 < dev["d2h.bytes.post.drain"] < host["d2h.bytes.postprocess"]
+    # exactly one pipeline host sync (the mask-table bucket pull)
+    assert dev["pipeline.host_sync"] == 1
+    # identity between the two runs (belt and braces at this shape)
+    assert len(res_dev.objects.point_ids_list) == \
+        len(res_host.objects.point_ids_list)
+    for a, b in zip(res_dev.objects.point_ids_list,
+                    res_host.objects.point_ids_list):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_postprocess_capacity_overflow_is_device_class():
+    """Overflowing a device post-process bucket raises the typed capacity
+    error (device class -> the ladder's host-postprocess rung re-runs the
+    scene) instead of exporting truncated groups."""
+    from maskclustering_tpu.models.postprocess_device import (
+        PostprocessCapacityError)
+    from maskclustering_tpu.utils import faults
+
+    tensors = _tiny_scene()
+    with pytest.raises(PostprocessCapacityError) as gi:
+        run_scene(tensors, _tiny_config(post_group_cap=2), k_max=15)
+    assert faults.classify_error(gi.value) == "device"
+    assert "post_group_cap" in str(gi.value)
+
+    with pytest.raises(PostprocessCapacityError) as ni:
+        run_scene(tensors, _tiny_config(post_neighbor_cap=1), k_max=15)
+    assert faults.classify_error(ni.value) == "device"
+    assert "post_neighbor_cap" in str(ni.value)
+
+
+@pytest.mark.slow
+def test_device_matches_host_postprocess_full_density():
+    """Full-density (63k-point cloud) identity at the default synthetic
+    shape — the honest-scale twin of the fast spacing-0.04 tests above.
+    Slow-marked: the CPU grid-DBSCAN pack pass alone is ~6 s here."""
+    scene = make_scene(num_boxes=4, num_frames=10, seed=21)
+    tensors = to_scene_tensors(scene)
+    res_host = run_scene(tensors, _config(device_postprocess=False), k_max=15)
+    res_dev = run_scene(tensors, _config(device_postprocess=True), k_max=15)
+    oh, od = res_host.objects, res_dev.objects
+    assert len(oh.point_ids_list) == len(od.point_ids_list)
+    for ph, pd in zip(oh.point_ids_list, od.point_ids_list):
+        np.testing.assert_array_equal(ph, pd)
+    assert oh.mask_list == od.mask_list
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (4, 2), (8, 1)])
+def test_mesh_device_postprocess_identity_lattice(mesh_shape):
+    """Full-divisor-lattice sweep: the fused mesh path with the
+    device-resident post-process produces artifacts byte-identical to the
+    single-chip HOST post-process, on every (scene, frame) factorization
+    of the 8-device mesh. Slow-marked: 4 fused-step compiles."""
+    from maskclustering_tpu.parallel import make_mesh
+    from maskclustering_tpu.parallel.batch import cluster_scene_batch
+    from maskclustering_tpu.utils.synthetic import make_scene as _ms
+
+    cfg = PipelineConfig(
+        config_name="meshpost", dataset="demo", distance_threshold=0.06,
+        few_points_threshold=10, point_chunk=1024, frame_pad_multiple=8,
+        mask_pad_multiple=8)
+    tensors = [to_scene_tensors(_ms(
+        num_boxes=3, num_frames=8, image_hw=(32, 48), spacing=0.08, seed=s))
+        for s in (0, 1, 2)]
+    refs = [run_scene(t, cfg.replace(device_postprocess=False),
+                      k_max=7).objects for t in tensors]
+    mesh = make_mesh(mesh_shape)
+    objs = cluster_scene_batch(cfg, mesh, tensors, k_max=7)
+    for om, ref in zip(objs, refs):
+        assert om.num_points == ref.num_points
+        assert len(om.point_ids_list) == len(ref.point_ids_list)
+        for a, b in zip(om.point_ids_list, ref.point_ids_list):
+            np.testing.assert_array_equal(a, b)
+        assert om.mask_list == ref.mask_list

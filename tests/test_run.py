@@ -26,7 +26,8 @@ from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
 @pytest.fixture(scope="module")
 def scene_root(tmp_path_factory):
     data_root = str(tmp_path_factory.mktemp("data"))
-    scene = make_scene(num_boxes=3, num_frames=10, image_hw=(60, 80), seed=7)
+    scene = make_scene(num_boxes=3, num_frames=10, image_hw=(60, 80), seed=7,
+                       spacing=0.05)
     write_scannet_layout(scene, data_root, "scene0001_00")
     return data_root
 
@@ -119,7 +120,8 @@ def test_cluster_scenes_mesh_writes_identical_artifacts(tmp_path):
     root = str(tmp_path / "data")
     names = []
     for i in range(3):
-        scene = make_scene(num_boxes=3, num_frames=8, image_hw=(48, 64), seed=20 + i)
+        scene = make_scene(num_boxes=3, num_frames=8, image_hw=(48, 64),
+                           spacing=0.05, seed=20 + i)
         names.append(f"scene{i:04d}_00")
         write_scannet_layout(scene, root, names[-1])
     base = load_config("scannet").replace(
@@ -165,7 +167,8 @@ def test_missing_gt_is_a_recorded_failure(tmp_path):
     from maskclustering_tpu.run import run_pipeline
 
     root = str(tmp_path / "data")
-    scene = make_scene(num_boxes=2, num_frames=8, image_hw=(48, 64), seed=5)
+    scene = make_scene(num_boxes=2, num_frames=8, image_hw=(48, 64), seed=5,
+                       spacing=0.05)
     write_scannet_layout(scene, root, "scene0009_00")
     shutil.rmtree(os.path.join(root, "scannet", "gt"))
     cfg = _cfg(root).replace(config_name="nogt")
@@ -210,7 +213,8 @@ class TestTasmapVariantSteps:
         from maskclustering_tpu.run import TASMAP_STEPS, run_pipeline
         from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
 
-        scene = make_scene(num_boxes=2, num_frames=8, image_hw=(48, 64), seed=11)
+        scene = make_scene(num_boxes=2, num_frames=8, image_hw=(48, 64), seed=11,
+                           spacing=0.05)
         root = str(tmp_path / "data")
         write_scannet_layout(scene, root, "scene0003_00")
         cfg = load_config("scannet").replace(
