@@ -113,6 +113,22 @@ def _emit(args, times, error=None, stage_timings=None):
     if getattr(args, "obs_events", None) and not getattr(args, "no_obs", False):
         # point the record at its own span stream (report CLI renders it)
         line["obs_events"] = args.obs_events
+    from maskclustering_tpu.analysis import retrace_sanitizer
+
+    if retrace_sanitizer.enabled():
+        # compile-surface attribution (armed runs only): the warm-up wall
+        # and any post-freeze retrace ride the verdict so obs.report
+        # --regress can attribute a compile-count delta before blaming
+        # code drift for the headline
+        d = retrace_sanitizer.digest()
+        line["retrace_compiles"] = d["compiles"]
+        repeats = sum(1 for v in d["violations"] if v["kind"] == "repeat")
+        frozen = sum(1 for v in d["violations"]
+                     if v["kind"] == "post_freeze")
+        if repeats:
+            line["retrace_repeats"] = repeats
+        if frozen:
+            line["retrace_post_freeze"] = frozen
     if error is not None:
         line["error"] = str(error)[:300]
         if times:
@@ -529,6 +545,13 @@ def main():
         _supervise(args)
         return
 
+    from maskclustering_tpu.analysis import retrace_sanitizer
+
+    if retrace_sanitizer.enabled():
+        # hook the compile log before backend init so the warm-up's
+        # compiles are on the books; the supervisor's workers inherit
+        # MCT_RETRACE_SANITIZER through the environment
+        retrace_sanitizer.install()
     _init_backend(args)
 
     import numpy as np
@@ -602,6 +625,11 @@ def main():
         run_scene(tensors, cfg, k_max=args.k_max)
         print(f"[bench] warm-up (incl. compile): {time.time()-t0:.1f}s",
               file=sys.stderr, flush=True)
+        if retrace_sanitizer.enabled():
+            # the bench IS the serve-many workload (one bucket, repeated):
+            # after warm-up, any further compile is a retrace — recorded
+            # as a post-freeze violation and stamped on the verdict line
+            retrace_sanitizer.freeze()
 
         if args.profile_dir:
             # manual start/stop rather than the (equivalent) jax.profiler

@@ -9,7 +9,7 @@ back from a real regression (the PR-3 review caught an unlocked metrics
 registry; the PR-4 census found f32 dots that had silently survived).
 This package verifies them from the lowered IR and the source AST on
 every CI run, so the scene-serving daemon and device-resident-tail
-rewrites cannot silently undo them. Four families:
+rewrites cannot silently undo them. Five families:
 
 - **Family 1 — IR invariants** (``ir_checks``): AOT-lowers the fused
   step over CPU virtual devices (the obs/cost.py seam; nothing is ever
@@ -35,6 +35,17 @@ rewrites cannot silently undo them. Four families:
   under held locks, handler purity, and join/abandon contracts — plus
   the opt-in instrumented lock shim (``MCT_LOCK_SANITIZER=1``) whose
   observed acquisition-order graph must embed in the static one.
+- **Family 5 — retrace** (``retrace`` + ``retrace_sanitizer``,
+  ``--families retrace``): the compile-surface gate behind the
+  compile-once/serve-many contract. Static half: traced-closure capture
+  lint (RETRACE.CAPTURE), trace-time shape branching (RETRACE.BRANCH),
+  jit-site hygiene (RETRACE.STATIC), and a compile-surface census —
+  every jit site classified, executables enumerated through the REAL
+  bucket classifier plus the fused-step AOT lowerings, ratcheted against
+  ``compile_surface_baseline.json`` (RETRACE.SURFACE). Dynamic half: the
+  opt-in compile-event sanitizer (``MCT_RETRACE_SANITIZER=1``) hooks
+  jax's compile log per (fn, signature, ladder rung) and asserts a warm
+  same-bucket scene books zero new compiles.
 
 Findings carry stable ids + ``file:line``; a committed
 ``analysis_baseline.json`` suppresses accepted pre-existing findings

@@ -64,9 +64,36 @@ def _render_text(unsuppressed: List[Finding], suppressed: List[Finding],
 
 def run_analysis(families: List[str], meshes, repo_root: str,
                  ) -> tuple:
-    """(findings, analyzed fused@SxF labels | None if ir did not run)."""
+    """(findings, analyzed fused@SxF labels | None if ir did not run,
+    fused lowerings | None).
+
+    The ir and retrace families both read the fused step's AOT texts —
+    ONE ``observe_costs(keep_texts=True)`` sweep here serves both (the
+    same dedup the tier-1 conftest's ``fused_lattice_aot`` fixture does
+    for the tests), so ``--families ir,retrace`` pays the lattice
+    compiles once.
+    """
     findings: List[Finding] = []
     ir_labels = None
+    lowerings = None
+    # a retrace-only run over a fixture tree (no census marker) is pure
+    # AST — don't pay the lattice compiles for a surface check that will
+    # be skipped anyway
+    retrace_needs_lowerings = "retrace" in families and os.path.exists(
+        os.path.join(repo_root, "maskclustering_tpu", "analysis",
+                     "retrace.py"))
+    if "ir" in families or retrace_needs_lowerings:
+        from maskclustering_tpu.analysis.ir_checks import (
+            CANONICAL_SHAPE,
+            LATTICE,
+        )
+        from maskclustering_tpu.obs.cost import ensure_cpu_devices, observe_costs
+
+        ensure_cpu_devices(8)
+        rows = observe_costs(tuple(meshes or LATTICE), stages=("fused",),
+                             keep_texts=True, **CANONICAL_SHAPE)
+        lowerings = {tuple(r["mesh"]): (r["stablehlo"], r["compiled_text"])
+                     for r in rows if "stablehlo" in r}
     if "ast" in families:
         from maskclustering_tpu.analysis.ast_checks import analyze_ast
 
@@ -79,10 +106,16 @@ def run_analysis(families: List[str], meshes, repo_root: str,
         from maskclustering_tpu.analysis.ir_checks import LATTICE, analyze_ir
 
         ir_findings, rows = analyze_ir(meshes or LATTICE,
-                                       repo_root=repo_root)
+                                       repo_root=repo_root,
+                                       lowerings=lowerings)
         findings += ir_findings
         ir_labels = {r["target"] for r in rows}
-    return findings, ir_labels
+    if "retrace" in families:
+        from maskclustering_tpu.analysis.retrace import analyze_retrace
+
+        findings += analyze_retrace(repo_root, lowerings=lowerings,
+                                    lower_missing=False)
+    return findings, ir_labels, lowerings
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -96,8 +129,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help=f"suppression baseline (default: {DEFAULT_BASELINE} "
                         f"at the repo root when present)")
     p.add_argument("--format", choices=("text", "json"), default="text")
-    p.add_argument("--families", default="ast,ir,concurrency",
-                   help="comma-subset of {ast,ir,concurrency} (default all)")
+    p.add_argument("--families", default="ast,ir,concurrency,retrace",
+                   help="comma-subset of {ast,ir,concurrency,retrace} "
+                        "(default all)")
     p.add_argument("--mesh", action="append", default=None, metavar="SxF",
                    help="IR-family mesh config, repeatable (default: the "
                         "full divisor lattice of 8)")
@@ -108,6 +142,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="write a baseline suppressing every current "
                         "finding (new entries get TODO justifications "
                         "that a human must replace)")
+    p.add_argument("--write-surface", default=None, metavar="PATH",
+                   help="write the compile-surface census (retrace "
+                        "family) to PATH — the compile_surface_baseline"
+                        ".json regeneration workflow; audit the diff "
+                        "before committing")
     p.add_argument("--root", default=None,
                    help="repo root to analyze (default: auto-detected)")
     args = p.parse_args(argv)
@@ -120,7 +159,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     repo_root = args.root or _repo_root()
     families = [f for f in args.families.split(",") if f]
-    unknown = set(families) - {"ast", "ir", "concurrency"}
+    unknown = set(families) - {"ast", "ir", "concurrency", "retrace"}
     if unknown:
         p.error(f"unknown families {sorted(unknown)}")
     meshes = None
@@ -142,7 +181,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     t0 = time.perf_counter()
     try:
-        findings, ir_labels = run_analysis(families, meshes, repo_root)
+        findings, ir_labels, lowerings = run_analysis(families, meshes,
+                                                      repo_root)
     except Exception:
         log.exception("mct-check: analyzer crashed")
         return 1
@@ -152,6 +192,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         write_baseline(args.write_baseline, findings, baseline)
         print(f"mct-check: wrote {len(findings)} suppression(s) to "
               f"{args.write_baseline} (replace any TODO justifications)")
+    if args.write_surface:
+        from maskclustering_tpu.analysis.retrace import (
+            compile_surface,
+            fused_surface_rows,
+            write_surface_baseline,
+        )
+
+        fused = fused_surface_rows(lowerings) if lowerings else None
+        write_surface_baseline(args.write_surface, compile_surface(),
+                               fused_rows=fused)
+        print(f"mct-check: wrote the compile-surface census to "
+              f"{args.write_surface}"
+              + ("" if fused else " (no fused rows — run with the ir or "
+                                  "retrace family to lower the lattice)"))
 
     unsuppressed, suppressed, stale = partition_findings(findings, baseline)
     # a family-/mesh-filtered run never re-derives the out-of-scope

@@ -137,7 +137,8 @@ def stale_in_scope(stale: Sequence[str], families: Sequence[str],
     for fid in stale:
         family = ("ir" if fid.startswith("IR.")
                   else "ast" if fid.startswith("AST.")
-                  else "concurrency" if fid.startswith("CONC.") else None)
+                  else "concurrency" if fid.startswith("CONC.")
+                  else "retrace" if fid.startswith("RETRACE.") else None)
         if family is not None and family not in families:
             continue
         if ir_labels is not None and family == "ir":

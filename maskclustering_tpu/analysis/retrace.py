@@ -1,0 +1,936 @@
+"""Family 5: the compile-surface analyzer (retrace, static half).
+
+ROADMAP items 1 (scene-serving daemon) and 3 (persistent AOT executable
+cache) assume a *closed compile surface*: every scene routes through a
+bounded vocabulary of (stage fn, shape bucket, count_dtype, donation)
+executables and a warm process never retraces. One Python scalar leaking
+into a traced closure, or one jit wrapper rebuilt per call, silently
+multiplies compiles — the measured cost is the 48 s/scene eager-retrace
+regression ``_associate_scene_jit``'s docstring records, and the 106.6 s
+warm-up BENCH_r03 measured is what a retrace re-buys per scene. Four
+checks, all source-level (pure stdlib AST) except the census:
+
+- **RETRACE.CAPTURE** — a traced function (jit root) closing over, or a
+  ``jax.jit(functools.partial(...))`` binding, a name outside the
+  compile-stable vocabulary (``COMPILE_STABLE_CAPTURES``: cfg, mesh,
+  bucket params — the names builders are cached by). A per-scene value
+  baked into a traced closure either recompiles per scene or silently
+  serves scene A's constant to scene B.
+- **RETRACE.BRANCH** — Python ``if``/``while``/ternary branching on
+  ``.shape``/``.ndim``/``.size``/``len()`` inside traced code. A
+  trace-time shape branch forks the executable per shape OUTSIDE the
+  bucket vocabulary: within one bucket it is dead weight, across buckets
+  it is compile surface the bucket key cannot see. Shape *reads* are
+  fine (shapes are static); *branching* needs a ``# mct-ok:
+  RETRACE.BRANCH`` audit mark tying it to a bucketed input.
+- **RETRACE.STATIC** — jit-site hygiene: ``static_argnums``/
+  ``static_argnames`` must be literal constants (an expression-valued
+  vocabulary is unauditable), and a ``jax.jit`` call inside a plain
+  function builds a FRESH executable cache per call — it must live at
+  module scope, under ``functools.lru_cache``, or in a builder whose
+  callers cache (``CACHED_BY_CALLER``).
+- **RETRACE.SURFACE** — the census + ratchet: every jit site in the
+  device-path modules must be classified (``SERVING_PROGRAMS`` — the
+  per-scene executables, each with its bucket/dtype/donation key axes —
+  or ``AUX_PROGRAMS`` with a reason), the census of executables a
+  canonical mixed-bucket workload requires is computed through the REAL
+  bucket classifier (``utils/compile_cache.scene_bucket``) plus the
+  fused-step lowerings (the obs/cost.py AOT seam), and the result must
+  equal the committed ``compile_surface_baseline.json`` exactly — growth
+  or shrinkage fails with the offending (fn, bucket, dtype, donation)
+  coordinate. Degradation-ladder rungs that legitimately add surface
+  (donation-off, host-postprocess) are enumerated per rung, which is the
+  vocabulary the runtime sanitizer's context tags check against.
+
+The dynamic half (``retrace_sanitizer``) hooks actual compile events and
+asserts the serve-many contract at run time; fn names here and compile
+log names there are ONE vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from maskclustering_tpu.analysis.ast_checks import (
+    _attr_chain,
+    _line_optout,
+)
+from maskclustering_tpu.analysis.findings import Finding, make_id
+
+# ---------------------------------------------------------------------------
+# policy constants (the contracts, in one place)
+# ---------------------------------------------------------------------------
+
+DEFAULT_SURFACE_BASELINE = "compile_surface_baseline.json"
+SURFACE_VERSION = 1
+
+# the device-path modules whose jit sites ARE the compile surface
+RETRACE_SCAN_ROOTS = (
+    "maskclustering_tpu/models",
+    "maskclustering_tpu/parallel",
+    "maskclustering_tpu/ops",
+    "maskclustering_tpu/io/feed.py",
+)
+
+# names a traced closure / jit-partial may bind: the compile-stable
+# builder parameters (builders are cached per these — lru_cache keys,
+# shape-bucket coordinates, config-derived statics). Anything else baked
+# into a traced program is per-scene state and RETRACE.CAPTURE fires.
+COMPILE_STABLE_CAPTURES = frozenset({
+    "cfg", "mesh", "k_max", "r_pad", "k2", "s_pad", "count_dtype", "donate",
+    "window", "distance_threshold", "depth_trunc", "few_points_threshold",
+    "coverage_threshold", "frame_batch", "max_len", "scale",
+})
+
+# builders that create a jit wrapper per call BY DESIGN, because their
+# callers cache (parallel/batch._cached_step is lru_cached; the cost
+# observatory lowers offline) — a new builder needs a caching story
+# before it joins this set
+CACHED_BY_CALLER = frozenset({"build_fused_step", "build_stage_step"})
+
+# ---------------------------------------------------------------------------
+# the program registry: every jit site classified
+# ---------------------------------------------------------------------------
+
+# the per-scene serving surface (single-chip path), name -> (key, flags):
+#   key: "scene"  = one executable per (k_max, f_pad, n_pad) scene bucket
+#        "masks"  = keyed by the data-dependent m_pad bucket (recorded as
+#                   the "masks" shape-bucket kind; pow2-bounded)
+#        "post"   = keyed by the device post-process's data-dependent pow2
+#                   buckets (recorded as the post.* shape-bucket kinds)
+#        "config" = one executable per config (static scalars only)
+#   flags: subset of {"dtype", "donate"} — extra key axes
+SERVING_PROGRAMS: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
+    ("_decode_depth_jit", "scene", ()),
+    ("_vox_size_jit", "config", ()),
+    ("_associate_scene_impl", "scene", ("dtype", "donate")),
+    ("compute_graph_stats", "masks", ("dtype",)),
+    ("observer_schedule_device", "scene", ()),
+    ("_iterative_clustering_jit", "masks", ("dtype",)),
+    ("_live_count_kernel", "post", ()),
+    ("_prep_kernel", "post", ()),
+    ("_node_stats_kernel", "post", ("dtype",)),
+    ("_dbscan_split_kernel", "post", ()),
+    ("_group_structs_kernel", "post", ()),
+    ("_survivor_gather_kernel", "post", ("dtype",)),
+    ("_mask_group_counts_impl", "post", ("dtype", "donate")),
+)
+
+# jit sites that are NOT per-scene serving executables, with the reason
+# they stay off the census (a new jit site must land in one table or the
+# other — RETRACE.SURFACE flags the unclassified)
+AUX_PROGRAMS: Dict[str, str] = {
+    "estimate_spacing": "traced inside _vox_size_jit / the fused step; "
+                        "standalone dispatch is test-only",
+    "associate_frame": "traced inside the association scan; standalone "
+                       "dispatch is test-only",
+    "ball_query": "exact-parity path (use_exact_ball_query), not the "
+                  "bucketed serving path",
+    "ball_query_pallas": "TPU Pallas kernel, probe-gated benchmark path",
+    "grid_dbscan_pairs": "embedded in _dbscan_split_kernel's program; the "
+                         "standalone jit is the diagnostics dispatch",
+    # the fused mesh path: its executable is the census's "fused" section
+    # (one per mesh, lowered through the obs/cost.py seam)
+    "per_scene": "the fused mesh step (census 'fused' section; cached by "
+                 "parallel/batch._cached_step)",
+    "batched": "jit(vmap(per_scene)) wrapper of the fused mesh step",
+    # build_stage_step's per-stage programs: AOT cost observatory only
+    "fn": "build_stage_step stage program — AOT-lowered by the cost "
+          "observatory, never dispatched in serving",
+    "post": "build_stage_step postprocess stage program — observatory only",
+}
+
+# surface the degradation ladder legitimately ADDS per rung (fn names the
+# runtime sanitizer allows to compile anew under that context tag; rungs
+# absent here add nothing). donation-off rebuilds exactly the donating
+# programs; host-postprocess routes to the numpy path and compiles nothing
+RUNG_SURFACE: Dict[str, Tuple[str, ...]] = {
+    "sequential-executor": (),
+    "single-chip": (),
+    "donation-off": ("_associate_scene_impl", "_mask_group_counts_impl"),
+    "host-postprocess": (),
+}
+
+# the canonical mixed-bucket workload the census enumerates: two distinct
+# scene buckets plus a repeat (the serve-many case the sanitizer pins).
+# Coordinates go through the REAL classifier (compile_cache.scene_bucket),
+# so a bucketing-math change shows up as a census diff
+CANONICAL_WORKLOAD: Tuple[Dict, ...] = (
+    {"scene": "A", "frames": 10, "points": 16000, "max_id": 14},
+    {"scene": "B", "frames": 34, "points": 60000, "max_id": 100},
+    {"scene": "A-repeat", "frames": 10, "points": 16000, "max_id": 14},
+)
+
+
+# ---------------------------------------------------------------------------
+# jit-site collection (shared by the capture/static/surface checks)
+# ---------------------------------------------------------------------------
+
+
+def _is_jit_chain(chain: Optional[str]) -> bool:
+    if not chain:
+        return False
+    tail = chain.rsplit(".", 1)[-1]
+    return tail in ("jit", "pjit")
+
+
+def _is_partial_chain(chain: Optional[str]) -> bool:
+    return bool(chain) and chain.rsplit(".", 1)[-1] == "partial"
+
+
+class JitSite:
+    """One jax.jit/pjit occurrence: where, what it traces, its statics."""
+
+    __slots__ = ("rel", "line", "def_line", "root_names", "root_nodes",
+                 "static_kw", "partial_bound_names", "enclosing",
+                 "decorated")
+
+    def __init__(self, rel: str, line: int, def_line: int = 0):
+        self.rel = rel
+        self.line = line
+        self.def_line = def_line or line
+        self.root_names: List[str] = []  # traced fn names (vocabulary)
+        self.root_nodes: List[ast.AST] = []  # def/lambda nodes when local
+        self.static_kw: List[ast.keyword] = []
+        self.partial_bound_names: List[str] = []  # Names bound via partial
+        self.enclosing: Optional[str] = None  # enclosing FunctionDef name
+        self.decorated = False
+
+
+_IGNORED_ROOTS = frozenset({"jax", "jnp", "np", "functools", "partial",
+                            "lax"})
+
+
+def _collect_defs(tree: ast.Module) -> Dict[str, ast.AST]:
+    """name -> def/lambda/partial-call node for root resolution.
+
+    ``x = functools.partial(f, k=v)`` binds x to the partial Call node, so
+    a later ``jax.jit(x)`` resolves through it to ``f`` (and the bound
+    keyword names are checked like closure captures).
+    """
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.Assign):
+            value = node.value
+            is_partial = (isinstance(value, ast.Call)
+                          and _is_partial_chain(_attr_chain(value.func)))
+            if isinstance(value, ast.Lambda) or is_partial:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = value
+    return out
+
+
+def _resolve_roots(site: JitSite, call_args: Sequence[ast.AST],
+                   defs: Dict[str, ast.AST]) -> None:
+    """Traced-root names of a jit(...) call: Names inside the function
+    argument expression (handles jit(vmap(f)), jit(partial(f, ...)), and
+    names bound to lambdas or partials)."""
+    names: List[str] = []
+    for arg in call_args:
+        if isinstance(arg, ast.Lambda):
+            # a literal lambda argument IS the traced root
+            site.root_names.append("<lambda>")
+            site.root_nodes.append(arg)
+            continue
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) and sub.id not in _IGNORED_ROOTS:
+                names.append(sub.id)
+    resolved = [n for n in names if n in defs]
+    for n in resolved or names[:1]:
+        node = defs.get(n)
+        if isinstance(node, ast.Call):
+            # a partial binding: the real root is the wrapped function;
+            # the bound keyword Names are traced-in values to capture-check
+            _resolve_roots(site, node.args, defs)
+            for kw in node.keywords:
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Name):
+                        site.partial_bound_names.append(sub.id)
+            continue
+        if n not in site.root_names:
+            site.root_names.append(n)
+            if node is not None:
+                site.root_nodes.append(node)
+
+
+def collect_jit_sites(tree: ast.Module, rel: str) -> List[JitSite]:
+    """Every jit occurrence in a module: decorators, direct calls, and
+    ``partial(jax.jit, ...)`` applications, with enclosing-scope info."""
+    defs = _collect_defs(tree)
+    sites: List[JitSite] = []
+    stack: List[str] = []
+
+    def visit(node: ast.AST) -> None:
+        is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        decorators = ()
+        if is_fn:
+            decorators = tuple(node.decorator_list)
+            for dec in decorators:
+                site = _site_from_decorator(dec, node, rel)
+                if site is not None:
+                    site.enclosing = stack[-1] if stack else None
+                    sites.append(site)
+            stack.append(node.name)
+        if isinstance(node, ast.Call):
+            site = _site_from_call(node, defs, rel)
+            if site is not None:
+                site.enclosing = stack[-1] if stack else None
+                sites.append(site)
+        for child in ast.iter_child_nodes(node):
+            if child in decorators:
+                # already classified above — recursing into a call-form
+                # decorator (`@jax.jit(donate_argnums=...)`) would mint a
+                # phantom second site inside the function's own scope
+                continue
+            visit(child)
+        if is_fn:
+            stack.pop()
+
+    visit(tree)
+    return sites
+
+
+def _site_from_decorator(dec: ast.AST, fn_node: ast.AST,
+                         rel: str) -> Optional[JitSite]:
+    chain = _attr_chain(dec)
+    statics: List[ast.keyword] = []
+    if isinstance(dec, ast.Call):
+        chain = _attr_chain(dec.func)
+        if _is_partial_chain(chain) and any(
+                _is_jit_chain(_attr_chain(a)) for a in dec.args):
+            statics = [kw for kw in dec.keywords
+                       if kw.arg in ("static_argnums", "static_argnames")]
+        elif not _is_jit_chain(chain):
+            return None
+        else:
+            statics = [kw for kw in dec.keywords
+                       if kw.arg in ("static_argnums", "static_argnames")]
+    elif not _is_jit_chain(chain):
+        return None
+    # the site anchors at the DECORATOR line (where the jit lives, and
+    # where an inline `# mct-ok:` marker goes); def_line keeps the def
+    # as a second marker anchor
+    site = JitSite(rel, dec.lineno, def_line=fn_node.lineno)
+    site.root_names.append(fn_node.name)
+    site.root_nodes.append(fn_node)
+    site.static_kw = statics
+    site.decorated = True
+    return site
+
+
+def _site_from_call(node: ast.Call, defs: Dict[str, ast.AST],
+                    rel: str) -> Optional[JitSite]:
+    chain = _attr_chain(node.func)
+    if _is_jit_chain(chain):
+        site = JitSite(rel, node.lineno)
+        site.static_kw = [kw for kw in node.keywords
+                          if kw.arg in ("static_argnums", "static_argnames")]
+        _resolve_roots(site, node.args, defs)
+        # jit(functools.partial(f, k=v)): the bound Names are part of the
+        # traced program exactly like closure captures
+        for arg in node.args:
+            if isinstance(arg, ast.Call) \
+                    and _is_partial_chain(_attr_chain(arg.func)):
+                for kw in arg.keywords:
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Name):
+                            site.partial_bound_names.append(sub.id)
+        return site
+    # functools.partial(jax.jit, ...)(f) applications
+    if isinstance(node.func, ast.Call):
+        inner = node.func
+        if _is_partial_chain(_attr_chain(inner.func)) and any(
+                _is_jit_chain(_attr_chain(a)) for a in inner.args):
+            site = JitSite(rel, node.lineno)
+            site.static_kw = [kw for kw in inner.keywords
+                              if kw.arg in ("static_argnums",
+                                            "static_argnames")]
+            _resolve_roots(site, node.args, defs)
+            return site
+    return None
+
+
+# ---------------------------------------------------------------------------
+# RETRACE.CAPTURE
+# ---------------------------------------------------------------------------
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+def _bound_names(fn: ast.AST) -> Set[str]:
+    """Names bound in a function's own scope (args + stores + imports +
+    nested def names), excluding nested function bodies."""
+    out: Set[str] = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        out.add(a.arg)
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    work = list(ast.iter_child_nodes(fn)) if not isinstance(fn, ast.Lambda) \
+        else [fn.body]
+    while work:
+        node = work.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+            continue  # its body is its own scope
+        if isinstance(node, ast.ClassDef):
+            out.add(node.name)
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+        if isinstance(node, ast.ExceptHandler) and node.name:
+            out.add(node.name)
+        if isinstance(node, (ast.comprehension,)):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        work.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _free_names(fn: ast.AST) -> Set[str]:
+    """Free variables of a function node: reads not bound locally, plus
+    the free variables of nested defs minus this scope's bindings."""
+    bound = _bound_names(fn)
+    reads: Set[str] = set()
+    nested: List[ast.AST] = []
+    work = list(ast.iter_child_nodes(fn)) if not isinstance(fn, ast.Lambda) \
+        else [fn.body]
+    while work:
+        node = work.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            nested.append(node)
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            reads.add(node.id)
+        work.extend(ast.iter_child_nodes(node))
+    free = reads - bound
+    for sub in nested:
+        free |= _free_names(sub) - bound
+    return free
+
+
+def _module_names(tree: ast.Module) -> Set[str]:
+    """Module-scope bindings: top-level defs/classes/assigns + ALL imports
+    (an import inside a builder binds a module object — compile-stable)."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(stmt.name)
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                            ast.Name):
+            out.add(stmt.target.id)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+    return out
+
+
+def check_captures(tree: ast.Module, rel: str,
+                   source_lines: Sequence[str]) -> List[Finding]:
+    """Traced closures / jit-partials binding non-compile-stable names."""
+    module_names = _module_names(tree)
+    # a captured name that is itself a function (a sibling nested helper
+    # inside the same cached builder, a lambda binding) is a compile-stable
+    # callable traced into the program, not per-scene state
+    fn_names = set(_collect_defs(tree))
+    findings: List[Finding] = []
+    for site in collect_jit_sites(tree, rel):
+        captured: Set[str] = set()
+        for node in site.root_nodes:
+            if site.enclosing is None and not isinstance(node, ast.Lambda):
+                continue  # a module-level def cannot close over locals
+            captured |= (_free_names(node) - module_names - _BUILTIN_NAMES)
+        captured |= {n for n in site.partial_bound_names
+                     if n not in module_names and n not in _BUILTIN_NAMES}
+        bad = sorted(captured - COMPILE_STABLE_CAPTURES - fn_names)
+        for name in bad:
+            anchor = site.root_nodes[0] if site.root_nodes else None
+            if anchor is not None and _line_optout(source_lines, anchor,
+                                                   "RETRACE.CAPTURE"):
+                continue
+            scope = site.enclosing or "<module>"
+            root = site.root_names[0] if site.root_names else "<anon>"
+            findings.append(Finding(
+                id=make_id("RETRACE.CAPTURE", rel, scope, root, name),
+                check="RETRACE.CAPTURE", family="retrace",
+                message=f"traced function {root!r} (in {scope}) bakes "
+                        f"{name!r} into its program — not in the "
+                        f"compile-stable capture vocabulary, so it either "
+                        f"retraces per call or serves a stale constant",
+                file=rel, line=site.line))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# RETRACE.BRANCH
+# ---------------------------------------------------------------------------
+
+_SHAPE_ATTRS = ("shape", "ndim", "size")
+
+
+def _shape_token_in(test: ast.AST) -> Optional[str]:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) and sub.attr in _SHAPE_ATTRS:
+            base = _attr_chain(sub.value)
+            return f"{base or '<expr>'}.{sub.attr}"
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return "len()"
+    return None
+
+
+def check_shape_branches(tree: ast.Module, rel: str,
+                         source_lines: Sequence[str]) -> List[Finding]:
+    """Trace-time shape/len branching inside traced code (jit roots plus
+    module-local functions they call)."""
+    from maskclustering_tpu.analysis.ast_checks import (
+        _call_graph,
+        _collect_functions,
+        _reachable,
+    )
+
+    funcs = _collect_functions(tree)
+    roots: Set[str] = set()
+    for site in collect_jit_sites(tree, rel):
+        roots.update(n for n in site.root_names if n in funcs)
+    if not roots:
+        return []
+    reachable = _reachable(roots, _call_graph(funcs))
+    findings: List[Finding] = []
+    ordinals: Dict[str, int] = {}
+
+    def walk_own_body(root: ast.AST):
+        """ast.walk minus nested def bodies — a nested function is its own
+        ``funcs`` entry, reached through the call graph; walking it here
+        would report its branches twice under two finding ids."""
+        work = list(ast.iter_child_nodes(root))
+        while work:
+            node = work.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                work.extend(ast.iter_child_nodes(node))
+
+    for fname in sorted(reachable):
+        for node in walk_own_body(funcs[fname]):
+            if isinstance(node, (ast.If, ast.While)):
+                token = _shape_token_in(node.test)
+            elif isinstance(node, ast.IfExp):
+                token = _shape_token_in(node.test)
+            else:
+                continue
+            if token is None or _line_optout(source_lines, node,
+                                             "RETRACE.BRANCH"):
+                continue
+            ordinals[fname] = ordinals.get(fname, 0) + 1
+            findings.append(Finding(
+                id=make_id("RETRACE.BRANCH", rel, fname, ordinals[fname]),
+                check="RETRACE.BRANCH", family="retrace",
+                message=f"trace-time branch on {token} inside {fname} "
+                        f"(reachable from a jit root) — forks the "
+                        f"executable per shape outside the bucket "
+                        f"vocabulary; audit it against a bucketed input "
+                        f"and mark '# mct-ok: RETRACE.BRANCH'",
+                file=rel, line=node.lineno))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# RETRACE.STATIC
+# ---------------------------------------------------------------------------
+
+
+def _is_literal_static(value: ast.AST) -> bool:
+    if isinstance(value, ast.Constant):
+        return True
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return all(isinstance(e, ast.Constant) for e in value.elts)
+    if isinstance(value, ast.IfExp):
+        return _is_literal_static(value.body) and _is_literal_static(
+            value.orelse)
+    return False
+
+
+_CACHE_DECOS = ("lru_cache", "cache")
+
+
+def _has_cache_decorator(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        chain = _attr_chain(dec) or ""
+        if isinstance(dec, ast.Call):
+            chain = _attr_chain(dec.func) or chain
+        if chain.rsplit(".", 1)[-1] in _CACHE_DECOS:
+            return True
+    return False
+
+
+def check_static_hygiene(tree: ast.Module, rel: str,
+                         source_lines: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    funcs = {n.name: n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    seen_lines: Set[int] = set()
+    for site in collect_jit_sites(tree, rel):
+        root = site.root_names[0] if site.root_names else "<anon>"
+        for kw in site.static_kw:
+            if not _is_literal_static(kw.value):
+                findings.append(Finding(
+                    id=make_id("RETRACE.STATIC", rel, root, kw.arg,
+                               "nonliteral"),
+                    check="RETRACE.STATIC", family="retrace",
+                    message=f"{kw.arg} at the {root!r} jit site is a "
+                            f"computed expression — the static-argument "
+                            f"vocabulary must be literal so the compile "
+                            f"surface is auditable",
+                    file=rel, line=site.line))
+        if site.decorated or site.enclosing is None:
+            continue
+        enclosing = funcs.get(site.enclosing)
+        if enclosing is None or _has_cache_decorator(enclosing) \
+                or site.enclosing in CACHED_BY_CALLER:
+            continue
+        if site.line in seen_lines or _line_anchored_optout(
+                source_lines, site.line, "RETRACE.STATIC"):
+            continue
+        seen_lines.add(site.line)
+        findings.append(Finding(
+            id=make_id("RETRACE.STATIC", rel, site.enclosing, root, "fresh"),
+            check="RETRACE.STATIC", family="retrace",
+            message=f"jax.jit inside {site.enclosing} builds a fresh "
+                    f"executable cache on every call (traced root "
+                    f"{root!r}) — hoist to module scope, lru_cache the "
+                    f"builder, or register it in CACHED_BY_CALLER with a "
+                    f"caching story",
+            file=rel, line=site.line))
+    return findings
+
+
+def _line_anchored_optout(source_lines: Sequence[str], line: int,
+                          check: str) -> bool:
+    if not (1 <= line <= len(source_lines)):
+        return False
+    text = source_lines[line - 1]
+    return f"# mct-ok: {check}" in text or "# mct-ok: all" in text
+
+
+# ---------------------------------------------------------------------------
+# RETRACE.SURFACE: the compile-surface census + ratchet
+# ---------------------------------------------------------------------------
+
+
+def classify_jit_sites(parsed: Sequence[Tuple[str, ast.Module,
+                                              Sequence[str]]]
+                       ) -> Tuple[Set[str], List[Finding]]:
+    """(all traced-root names, unclassified-site findings).
+
+    Every jit site's traced root must be a SERVING_PROGRAMS entry or an
+    AUX_PROGRAMS entry — the source-level half of the surface ratchet: a
+    brand-new jit site cannot join the tree without being placed on (or
+    explicitly off) the census.
+    """
+    serving = {name for name, _, _ in SERVING_PROGRAMS}
+    known = serving | set(AUX_PROGRAMS)
+    roots: Set[str] = set()
+    findings: List[Finding] = []
+    for rel, tree, lines in parsed:
+        if tree is None:
+            continue
+        for site in collect_jit_sites(tree, rel):
+            for name in site.root_names or ["<anon>"]:
+                label = name if name != "<lambda>" else \
+                    f"<lambda>@{site.enclosing or rel}"
+                roots.add(label)
+                sanctioned = (_line_anchored_optout(lines, site.line,
+                                                    "RETRACE.SURFACE")
+                              or _line_anchored_optout(
+                                  lines, site.def_line, "RETRACE.SURFACE"))
+                if label not in known and not sanctioned:
+                    findings.append(Finding(
+                        id=make_id("RETRACE.SURFACE", rel, "unclassified",
+                                   label),
+                        check="RETRACE.SURFACE", family="retrace",
+                        message=f"jit site traces {label!r}, which is in "
+                                f"neither SERVING_PROGRAMS nor "
+                                f"AUX_PROGRAMS — a new executable joined "
+                                f"the compile surface unclassified "
+                                f"(analysis/retrace.py registry)",
+                        file=rel, line=site.line))
+    return roots, findings
+
+
+def check_registry_stale(roots: Set[str]) -> List[Finding]:
+    """Registry entries no jit site traces anymore (real-repo runs only —
+    a seeded fixture tree legitimately contains almost no programs)."""
+    serving = {name for name, _, _ in SERVING_PROGRAMS}
+    findings: List[Finding] = []
+    for name in sorted((serving | set(AUX_PROGRAMS)) - roots):
+        findings.append(Finding(
+            id=make_id("RETRACE.SURFACE", "registry", "stale", name),
+            check="RETRACE.SURFACE", family="retrace",
+            message=f"program registry names {name!r} but no jit site in "
+                    f"the scanned tree traces it — the registry (or the "
+                    f"baseline census) is stale",
+            file="maskclustering_tpu/analysis/retrace.py"))
+    return findings
+
+
+def compile_surface(cfg=None) -> Dict:
+    """The census: executables the canonical workload requires, as a
+    JSON-able doc. Bucket coordinates go through the REAL classifier
+    (``utils/compile_cache.scene_bucket``)."""
+    from maskclustering_tpu.utils.compile_cache import scene_bucket
+
+    if cfg is None:
+        from maskclustering_tpu.obs.cost import default_pipeline_cfg
+
+        cfg = default_pipeline_cfg(point_chunk=8192).replace(
+            frame_pad_multiple=32, mask_pad_multiple=256)
+    buckets: List[Tuple[int, int, int]] = []
+    for scene in CANONICAL_WORKLOAD:
+        b = scene_bucket(cfg, scene["frames"], scene["points"],
+                         scene["max_id"])
+        if b not in buckets:
+            buckets.append(b)
+    rows: List[str] = []
+    donate = "on" if cfg.donate_buffers else "off"
+    for name, key, flags in SERVING_PROGRAMS:
+        coords: List[str]
+        if key == "scene":
+            coords = [f"bucket=k{k}:f{f}:n{n}" for k, f, n in buckets]
+        elif key in ("masks", "post"):
+            coords = [f"bucket=<data:{key}>"]
+        else:
+            coords = ["bucket=<config>"]
+        for coord in coords:
+            row = f"fn={name} {coord}"
+            if "dtype" in flags:
+                row += f" dtype={cfg.count_dtype}"
+            if "donate" in flags:
+                row += f" donate={donate}"
+            rows.append(row)
+    return {
+        "version": SURFACE_VERSION,
+        "workload": [dict(s) for s in CANONICAL_WORKLOAD],
+        "config": {"count_dtype": cfg.count_dtype,
+                   "donate_buffers": bool(cfg.donate_buffers),
+                   "frame_pad_multiple": cfg.frame_pad_multiple,
+                   "point_chunk": cfg.point_chunk,
+                   "mask_pad_multiple": cfg.mask_pad_multiple},
+        "surface": sorted(rows),
+        "rungs": {k: sorted(v) for k, v in RUNG_SURFACE.items()},
+    }
+
+
+_MAIN_SIG_RE = re.compile(r"func\.func public @main\((.*?)\)",
+                          re.DOTALL)
+_TENSOR_RE = re.compile(r"tensor<([^>]+)>")
+
+
+def fused_surface_rows(lowerings: Dict[Tuple[int, int],
+                                       Tuple[str, str]]) -> List[str]:
+    """One census row per fused-step lowering: mesh + the argument-shape
+    digest read from the ACTUAL StableHLO main signature (the obs/cost.py
+    AOT seam) — a silent signature change is a surface change."""
+    rows: List[str] = []
+    for mesh, (stablehlo, _) in sorted(lowerings.items()):
+        m = _MAIN_SIG_RE.search(stablehlo)
+        shapes = _TENSOR_RE.findall(m.group(1)) if m else []
+        digest = hashlib.sha1(
+            ";".join(shapes).encode("utf-8")).hexdigest()[:12]
+        rows.append(f"fn=per_scene mesh={mesh[0]}x{mesh[1]} "
+                    f"args={len(shapes)} sig={digest}")
+    return rows
+
+
+def load_surface_baseline(path: str) -> Optional[Dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("version") != SURFACE_VERSION:
+        raise ValueError(f"{path}: expected a compile-surface baseline "
+                         f"with version={SURFACE_VERSION}")
+    return doc
+
+
+def write_surface_baseline(path: str, census: Dict,
+                           fused_rows: Optional[List[str]] = None) -> None:
+    doc = dict(census)
+    if fused_rows is not None:
+        doc["fused"] = sorted(fused_rows)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+
+
+def check_surface(census: Dict, baseline: Dict,
+                  fused_rows: Optional[List[str]] = None) -> List[Finding]:
+    """The ratchet: census == baseline exactly, growth AND shrinkage."""
+    findings: List[Finding] = []
+
+    def diff(kind: str, current: Iterable[str], committed: Iterable[str]):
+        cur, com = set(current), set(committed)
+        for row in sorted(cur - com):
+            findings.append(Finding(
+                id=make_id("RETRACE.SURFACE", kind, "grew", row),
+                check="RETRACE.SURFACE", family="retrace",
+                message=f"compile surface grew: {row} is required by the "
+                        f"canonical workload but absent from the baseline "
+                        f"— a new compile variant appeared; audit it, "
+                        f"then regenerate with --write-surface"))
+        for row in sorted(com - cur):
+            findings.append(Finding(
+                id=make_id("RETRACE.SURFACE", kind, "shrank", row),
+                check="RETRACE.SURFACE", family="retrace",
+                message=f"compile surface shrank: baseline row '{row}' is "
+                        f"no longer produced — the baseline is stale; "
+                        f"regenerate with --write-surface"))
+
+    diff("serving", census["surface"], baseline.get("surface", []))
+    for rung in sorted(set(census["rungs"]) | set(baseline.get("rungs", {}))):
+        diff(f"rung:{rung}", census["rungs"].get(rung, []),
+             (baseline.get("rungs") or {}).get(rung, []))
+    if fused_rows is not None and "fused" in baseline:
+        # a --mesh-filtered run only lowers a lattice subset: compare the
+        # committed rows for the meshes actually analyzed (same scoping as
+        # findings.stale_in_scope), so a filtered run never reports the
+        # other meshes' rows as shrinkage
+        analyzed = {m.group(1) for r in fused_rows
+                    if (m := re.search(r"mesh=(\S+)", r))}
+        committed = [r for r in baseline["fused"]
+                     if (m := re.search(r"mesh=(\S+)", r))
+                     and m.group(1) in analyzed]
+        diff("fused", fused_rows, committed)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+
+
+def _iter_scan_files(repo_root: str) -> Iterable[str]:
+    for root in RETRACE_SCAN_ROOTS:
+        base = os.path.join(repo_root, root)
+        if os.path.isfile(base):
+            yield base
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def analyze_retrace(
+    repo_root: str,
+    *,
+    lowerings: Optional[Dict[Tuple[int, int], Tuple[str, str]]] = None,
+    lower_missing: bool = True,
+    surface_baseline: Optional[str] = None,
+) -> List[Finding]:
+    """Run Family 5's static half end-to-end.
+
+    ``lowerings`` maps a mesh to precomputed (stablehlo, compiled hlo)
+    texts of the fused step at the canonical shape —
+    ``obs.cost.observe_costs(..., keep_texts=True)`` produces them, and
+    the tier-1 conftest's session-scoped ``fused_lattice_aot`` fixture
+    shares ONE sweep between the cost tests, the IR gate and this census.
+    Without them (and with ``lower_missing``) the census lowers the
+    lattice itself (~15 s of CPU AOT). ``lower_missing=False`` skips the
+    fused section entirely (pure-AST mode for fixture trees).
+    """
+    parsed: List[Tuple[str, ast.Module, Sequence[str]]] = []
+    for path in _iter_scan_files(repo_root):
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError) as e:
+            parsed.append((rel, None, [f"{e}"]))
+            continue
+        parsed.append((rel, tree, source.splitlines()))
+
+    findings: List[Finding] = []
+    for rel, tree, lines in parsed:
+        if tree is None:
+            findings.append(Finding(
+                id=make_id("RETRACE.PARSE", rel), check="RETRACE.PARSE",
+                family="retrace", message=f"could not parse: {lines[0]}",
+                file=rel))
+            continue
+        findings += check_captures(tree, rel, lines)
+        findings += check_shape_branches(tree, rel, lines)
+        findings += check_static_hygiene(tree, rel, lines)
+
+    roots, cls_findings = classify_jit_sites(
+        [(r, t, ln) for r, t, ln in parsed if t is not None])
+    findings += cls_findings
+
+    # the census + registry-staleness halves only make sense against the
+    # real repo — the marker below distinguishes it from seeded fixture
+    # trees (which legitimately contain almost no programs)
+    marker = os.path.join(repo_root, "maskclustering_tpu", "analysis",
+                          "retrace.py")
+    if not os.path.exists(marker):
+        return findings
+    findings += check_registry_stale(roots)
+    baseline_path = surface_baseline or os.path.join(
+        repo_root, DEFAULT_SURFACE_BASELINE)
+    try:
+        baseline = load_surface_baseline(baseline_path)
+    except (ValueError, OSError) as e:
+        findings.append(Finding(
+            id=make_id("RETRACE.SURFACE", "baseline", "unreadable"),
+            check="RETRACE.SURFACE", family="retrace",
+            message=f"compile-surface baseline unreadable: {e}"))
+        return findings
+    if baseline is None:
+        findings.append(Finding(
+            id=make_id("RETRACE.SURFACE", "baseline", "missing"),
+            check="RETRACE.SURFACE", family="retrace",
+            message=f"no {DEFAULT_SURFACE_BASELINE} at the repo root — "
+                    f"the surface ratchet is un-gated; generate one with "
+                    f"--write-surface and commit it"))
+        return findings
+    census = compile_surface()
+    fused_rows = None
+    if lowerings is None and lower_missing:
+        from maskclustering_tpu.analysis.ir_checks import (
+            CANONICAL_SHAPE,
+            LATTICE,
+        )
+        from maskclustering_tpu.obs.cost import ensure_cpu_devices, observe_costs
+
+        ensure_cpu_devices(8)
+        rows = observe_costs(LATTICE, stages=("fused",), keep_texts=True,
+                             **CANONICAL_SHAPE)
+        lowerings = {tuple(r["mesh"]): (r["stablehlo"], r["compiled_text"])
+                     for r in rows if "stablehlo" in r}
+    if lowerings:
+        fused_rows = fused_surface_rows(lowerings)
+    findings += check_surface(census, baseline, fused_rows)
+    return findings

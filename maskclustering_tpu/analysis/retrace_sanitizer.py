@@ -1,0 +1,322 @@
+"""Retrace family, dynamic half: the opt-in compile-event sanitizer.
+
+The static analyzer (``analysis/retrace.py``) proves the compile surface
+it can SEE is closed: every jit site classified, every traced closure
+capturing only compile-stable names, the census of executables pinned to
+``compile_surface_baseline.json``. It cannot see a retrace born at run
+time — a jit wrapper rebuilt per call, an eager op chain dispatching tiny
+programs per scene, a cfg field that silently became part of a traced
+closure. This shim records what actually compiles: jax's per-executable
+build log (``jax_log_compiles`` — "Compiling <fn> with global shapes and
+types [...]") is intercepted by a logging filter, keyed
+``(fn, signature-digest, ladder-context)``, and checked against the
+serve-many contract:
+
+- a **repeat key** (the same program compiled twice in one context) is a
+  jit-cache thrash — the exact bug class ``_associate_scene_jit``'s
+  docstring records as a measured 48 s/scene regression — and is always
+  a violation;
+- after ``freeze()`` (a warm process; tests call it once their workload's
+  shape buckets have all been seen) any NEW key is a violation: a warm
+  same-bucket scene books **zero** compiles, which is the economics the
+  scene-serving daemon and the persistent AOT cache are built on;
+- degradation-ladder rungs that legitimately add surface (donation-off,
+  host-postprocess) switch the **context** tag (run.py's supervisor calls
+  ``set_context`` when the ladder drops a rung), so their recompiles are
+  new keys in a new context — surface the baseline enumerates, not
+  repeat-violations.
+
+The bucket classifier is ONE vocabulary across both halves:
+``utils/compile_cache.record_shape_bucket`` notifies this shim of every
+new shape bucket (``note_bucket``), so the digest can say "N compiles
+against M new buckets" — a warm run reads 0/0.
+
+Opt-in via ``run.py --retrace-sanitizer`` or ``MCT_RETRACE_SANITIZER=1``;
+off (the default) nothing is hooked and ``jax_log_compiles`` stays
+untouched. Results are identical either way — the hook only observes.
+
+Stdlib-only at module scope (``utils/compile_cache`` imports this and
+must stay importable without jax; jax is imported inside ``install``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import re
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV_FLAG = "MCT_RETRACE_SANITIZER"
+
+# the jax loggers that carry the jax_log_compiles messages (0.4.x: the
+# "Compiling ..." line is pxla's; the tracing/lowering timing lines are
+# dispatch's — both are intercepted so an armed run stays quiet)
+_JAX_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+
+# "Compiling <fn> with global shapes and types [sig]. Argument mapping: ..."
+# <fn> may contain spaces ("<unnamed wrapped function>") and [sig] spans
+# lines for wide programs, hence the non-greedy DOTALL match
+_COMPILING_RE = re.compile(
+    r"^Compiling (?P<fn>.+?) with global shapes and types "
+    r"(?P<sig>.*)\. Argument mapping", re.DOTALL)
+
+# jax_log_compiles side-chatter suppressed (not recorded) while armed
+_NOISE_PREFIXES = ("Finished tracing + transforming",
+                   "Finished jaxpr to MLIR module conversion",
+                   "Finished XLA compilation")
+
+DEFAULT_CONTEXT = "baseline"
+
+_armed: Optional[bool] = None  # None -> the environment decides
+
+
+def arm(on: Optional[bool]) -> None:
+    """Explicitly enable/disable the sanitizer (``None`` defers to env).
+
+    Arming is observed by ``note_bucket`` immediately; the compile hook
+    itself needs ``install()`` (run.py does both).
+    """
+    global _armed
+    _armed = on
+
+
+def enabled() -> bool:
+    if _armed is not None:
+        return _armed
+    return os.environ.get(ENV_FLAG, "").strip().lower() in ("1", "true",
+                                                            "on", "yes")
+
+
+# ---------------------------------------------------------------------------
+# observed state (process-global, plain lock — compiles are rare events)
+# ---------------------------------------------------------------------------
+
+
+class _State:
+    """Compile events keyed (fn, signature digest, context) since reset."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.keys: Dict[Tuple[str, str, str], int] = {}
+        self.first_sig: Dict[Tuple[str, str, str], str] = {}
+        self.violations: List[Dict] = []
+        self.context = DEFAULT_CONTEXT
+        self.frozen = False
+        self.buckets_new = 0
+        self.backend_compiles = 0
+
+    def on_compile(self, fn: str, sig: str) -> None:
+        digest = hashlib.sha1(sig.encode("utf-8", "replace")).hexdigest()[:12]
+        with self.lock:
+            key = (fn, digest, self.context)
+            n = self.keys.get(key, 0) + 1
+            self.keys[key] = n
+            if n == 1:
+                self.first_sig[key] = sig[:200]
+            if n > 1:
+                # the same (fn, signature, context) built a second
+                # executable: the jit cache that should have served it was
+                # dropped or bypassed — always a violation
+                self.violations.append({
+                    "kind": "repeat", "fn": fn, "sig": digest,
+                    "context": self.context, "count": n})
+            elif self.frozen and not _rung_sanctioned(fn, self.context):
+                self.violations.append({
+                    "kind": "post_freeze", "fn": fn, "sig": digest,
+                    "context": self.context})
+
+
+def _rung_sanctioned(fn: str, context: str) -> bool:
+    """Is a post-freeze compile of ``fn`` enumerated surface under this
+    ladder context? A frozen long-lived process (the serving daemon this
+    gate protects) legitimately degrades — the baseline's per-rung
+    allowance (``analysis.retrace.RUNG_SURFACE``, the same vocabulary the
+    static census commits) says exactly which programs may rebuild there;
+    everything else stays a violation even in a new context."""
+    if context == DEFAULT_CONTEXT:
+        return False
+    try:
+        from maskclustering_tpu.analysis.retrace import RUNG_SURFACE
+    except Exception:  # noqa: BLE001 — no table, no sanction
+        return False
+    allowed: set = set()
+    for rung in context.split("+"):
+        allowed.update(RUNG_SURFACE.get(rung, ()))
+    return fn in allowed
+
+
+_STATE = _State()
+
+
+def reset() -> None:
+    """Drop everything observed so far (test isolation)."""
+    global _STATE
+    _STATE = _State()
+
+
+def set_context(tag: str) -> None:
+    """Tag subsequent compiles with a degradation-ladder context.
+
+    run.py's scene supervisor calls this when the ladder drops a rung
+    (between executor rounds — the queue is drained, so no in-flight
+    compile straddles the switch). Same-signature recompiles under a new
+    tag are new keys, not repeat-violations: donation-off legitimately
+    rebuilds its donating programs, and the surface baseline enumerates
+    exactly which (``compile_surface_baseline.json`` "rungs").
+    """
+    with _STATE.lock:
+        _STATE.context = tag or DEFAULT_CONTEXT
+
+
+def freeze() -> None:
+    """Declare the process warm: every NEW key from here is a violation —
+    except a degradation rung's enumerated programs under their context
+    tag (``_rung_sanctioned``): a frozen serving process that drops to
+    donation-off may rebuild exactly the baselined variants."""
+    with _STATE.lock:
+        _STATE.frozen = True
+
+
+def thaw() -> None:
+    with _STATE.lock:
+        _STATE.frozen = False
+
+
+def note_bucket(new: bool) -> None:
+    """Bucket-classifier seam (utils/compile_cache.record_shape_bucket):
+    counts new shape buckets so the digest reads compiles-vs-buckets."""
+    if not new or not enabled():
+        return
+    with _STATE.lock:
+        _STATE.buckets_new += 1
+
+
+def snapshot_keys() -> Set[Tuple[str, str, str]]:
+    """The (fn, sig digest, context) keys observed since the last reset."""
+    with _STATE.lock:
+        return set(_STATE.keys)
+
+
+def violations() -> List[Dict]:
+    with _STATE.lock:
+        return list(_STATE.violations)
+
+
+def digest() -> Dict:
+    """JSON-able digest of everything observed since the last reset."""
+    with _STATE.lock:
+        by_fn: Dict[str, int] = {}
+        for (fn, _, _), n in _STATE.keys.items():
+            by_fn[fn] = by_fn.get(fn, 0) + n
+        return {
+            "compiles": sum(_STATE.keys.values()),
+            "distinct_keys": len(_STATE.keys),
+            "by_fn": dict(sorted(by_fn.items())),
+            "violations": list(_STATE.violations),
+            "buckets_new": _STATE.buckets_new,
+            "backend_compiles": _STATE.backend_compiles,
+            "context": _STATE.context,
+            "frozen": _STATE.frozen,
+        }
+
+
+def emit_counters() -> None:
+    """Book the digest on the obs metrics registry: the report's Analysis
+    section renders the retrace line from these (obs/report.py)."""
+    try:
+        from maskclustering_tpu.obs import metrics
+    except Exception:  # noqa: BLE001 — accounting never faults the shim
+        return
+    d = digest()
+    metrics.count("retrace.compiles", float(d["compiles"]))
+    metrics.count("retrace.distinct_programs", float(len(d["by_fn"])))
+    metrics.count("retrace.buckets_new", float(d["buckets_new"]))
+    repeats = sum(1 for v in d["violations"] if v["kind"] == "repeat")
+    frozen = sum(1 for v in d["violations"] if v["kind"] == "post_freeze")
+    if repeats:
+        metrics.count("retrace.repeat_compiles", float(repeats))
+    if frozen:
+        metrics.count("retrace.post_freeze_compiles", float(frozen))
+
+
+# ---------------------------------------------------------------------------
+# the hook: a logging filter over jax's compile log + a monitoring counter
+# ---------------------------------------------------------------------------
+
+
+class _CompileLogFilter(logging.Filter):
+    """Captures "Compiling <fn> ..." records, suppresses the chatter.
+
+    Returning False drops the record before handlers AND propagation, so
+    an armed run's stderr stays exactly as quiet as an unarmed one.
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:  # noqa: A003
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 — a bad record is not our problem
+            return True
+        m = _COMPILING_RE.match(msg)
+        if m is not None:
+            if enabled():
+                _STATE.on_compile(m.group("fn"), m.group("sig"))
+            return False
+        return not msg.startswith(_NOISE_PREFIXES)
+
+
+_FILTER: Optional[_CompileLogFilter] = None
+_PREV_LOG_COMPILES: Optional[bool] = None
+_MONITORING_REGISTERED = False
+
+
+def _on_duration_event(event: str, duration: float, **kw) -> None:
+    """jax.monitoring belt-and-braces: counts backend compiles even if a
+    jax upgrade reworded the log line the filter parses."""
+    del duration, kw
+    if event.endswith("/backend_compile_duration") and enabled():
+        with _STATE.lock:
+            _STATE.backend_compiles += 1
+
+
+def install() -> None:
+    """Arm + hook (idempotent): flip ``jax_log_compiles`` on and attach
+    the capture filter to the jax compile loggers."""
+    global _FILTER, _PREV_LOG_COMPILES, _MONITORING_REGISTERED
+    arm(True)
+    if _FILTER is None:
+        _FILTER = _CompileLogFilter()
+        for name in _JAX_COMPILE_LOGGERS:
+            logging.getLogger(name).addFilter(_FILTER)
+    import jax
+
+    if _PREV_LOG_COMPILES is None:
+        _PREV_LOG_COMPILES = bool(jax.config.jax_log_compiles)
+    jax.config.update("jax_log_compiles", True)
+    if not _MONITORING_REGISTERED:
+        try:
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_duration_event)
+            _MONITORING_REGISTERED = True
+        except Exception:  # noqa: BLE001 — the log filter alone suffices
+            pass
+
+
+def uninstall() -> None:
+    """Detach the filter and restore ``jax_log_compiles`` (test cleanup).
+
+    The monitoring listener stays registered (jax offers no single-listener
+    removal) but is inert once disarmed.
+    """
+    global _FILTER, _PREV_LOG_COMPILES
+    arm(None)
+    if _FILTER is not None:
+        for name in _JAX_COMPILE_LOGGERS:
+            logging.getLogger(name).removeFilter(_FILTER)
+        _FILTER = None
+    if _PREV_LOG_COMPILES is not None:
+        import jax
+
+        jax.config.update("jax_log_compiles", _PREV_LOG_COMPILES)
+        _PREV_LOG_COMPILES = None

@@ -460,13 +460,18 @@ def _associate_scene_jit(k_max, window, distance_threshold, depth_trunc,
     coexisting with it. Only safe when the caller owns the uploaded
     buffers exclusively (associate_scene_tensors checks this).
     """
-    return jax.jit(functools.partial(
+    impl = functools.partial(
         _associate_scene_impl, k_max=k_max, window=window,
         distance_threshold=distance_threshold, depth_trunc=depth_trunc,
         few_points_threshold=few_points_threshold,
         coverage_threshold=coverage_threshold, frame_batch=frame_batch,
-        count_dtype=count_dtype),
-        donate_argnums=(1, 2) if donate else ())
+        count_dtype=count_dtype)
+    # name the partial: jax's compile log (and therefore the retrace
+    # sanitizer's per-program attribution) keys executables by __name__ —
+    # an anonymous partial logs as "<unnamed wrapped function>" and every
+    # partial-wrapped program would collide on that one key
+    impl.__name__ = _associate_scene_impl.__name__
+    return jax.jit(impl, donate_argnums=(1, 2) if donate else ())
 
 
 def associate_scene(

@@ -229,11 +229,15 @@ def _run_scene_device_impl(tensors: SceneTensors, cfg: PipelineConfig, *,
             # shape buckets: heterogeneous scenes (ScanNet frame counts and
             # cloud sizes vary per scan) land on a handful of padded shapes, so
             # the jit caches — and the persistent compilation cache — hit
-            # across scenes
-            f_pad = bucket_size(tensors.num_frames, max(cfg.frame_pad_multiple, 1))
-            n_pad = bucket_size(n_real, max(cfg.point_chunk, 1))
+            # across scenes. scene_pads IS the classifier the retrace
+            # family's compile-surface census enumerates with
+            from maskclustering_tpu.utils.compile_cache import (
+                record_shape_bucket,
+                scene_pads,
+            )
+
+            f_pad, n_pad = scene_pads(cfg, tensors.num_frames, n_real)
             tensors = pad_scene_tensors(tensors, f_pad, n_pad)
-            from maskclustering_tpu.utils.compile_cache import record_shape_bucket
 
             record_shape_bucket("scene", k_max, f_pad, n_pad)
             sp.set(f_pad=f_pad, n_pad=n_pad)

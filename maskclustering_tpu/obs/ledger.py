@@ -86,7 +86,8 @@ def bench_row(verdict: Dict, **extra) -> Dict:
            "unit": verdict.get("unit", "s/scene")}
     for k in ("vs_baseline", "spread_pct", "stages", "attempts",
               "frame_batch", "count_dtype", "plane_dtype",
-              "postprocess_path", "error"):
+              "postprocess_path", "retrace_compiles", "retrace_repeats",
+              "retrace_post_freeze", "error"):
         if verdict.get(k) is not None:
             row[k] = verdict[k]
     row.update(extra)
@@ -115,6 +116,20 @@ def run_row(report: Dict, **extra) -> Dict:
     stages = digest.get("stages")
     if stages:
         row["stages"] = {k: v.get("p50_s") for k, v in stages.items()}
+    # compile-surface attribution (retrace-sanitizer-armed runs only): the
+    # summary's counters carry the compile events; stamping them on the
+    # row lets --regress attribute a warm-up/compile delta before anyone
+    # blames code drift (same move as the dtype knobs)
+    counters = digest.get("counters") or {}
+    for src, dst in (("retrace.compiles", "retrace_compiles"),
+                     ("retrace.repeat_compiles", "retrace_repeats"),
+                     ("retrace.post_freeze_compiles", "retrace_post_freeze"),
+                     ("compile_cache.bucket_new", "buckets_new")):
+        if src in counters:
+            # presence, not truthiness: a fully-warm armed run books
+            # retrace.compiles=0, and THAT zero is the baseline row the
+            # 0 -> N regression attribution anchors on
+            row[dst] = int(counters[src])
     faults = report.get("faults") or {}
     # fault attribution: a degraded/retried run's headline is the fault's
     # story, not code drift — stamp it so --regress can say so (keys only
@@ -204,12 +219,35 @@ def check_regression(current: Optional[Dict], baseline: Optional[Dict], *,
     # to the verdict (rows predating a knob have no key and read as the
     # historical defaults; postprocess_path predates as "device": rows
     # before the knob ran the default device path)
+    knob_flips = []
     for knob, default in (("count_dtype", "bf16"), ("plane_dtype", "int32"),
                           ("postprocess_path", "device")):
         c, b = current.get(knob, default), baseline.get(knob, default)
         if c != b:
+            knob_flips.append(knob)
             lines.append(f"  {knob}: {b} -> {c} [knob flip — attribute "
                          f"the delta before blaming code]")
+    # compile-surface attribution (retrace sanitizer, PR-9): a compile
+    # count or warm-up wall that regressed next to the headline is either
+    # a knob flip's new variant or genuine surface growth — the advisory
+    # names which BEFORE anyone reads the delta as code drift
+    cur_rc = current.get("retrace_compiles")
+    base_rc = baseline.get("retrace_compiles")
+    if cur_rc is not None and base_rc is not None \
+            and int(cur_rc) > int(base_rc):
+        cause = ("the flipped knob's variant compiling its own programs"
+                 if knob_flips else
+                 "compile-surface growth or a cold process — check the "
+                 "retrace digest and compile_surface_baseline.json")
+        lines.append(f"  retrace: sanitizer recorded {base_rc} -> {cur_rc} "
+                     f"compile(s) [{cause}]")
+    for key, label in (("retrace_repeats", "repeat compile(s)"),
+                       ("retrace_post_freeze", "post-warm compile(s)")):
+        if current.get(key):
+            lines.append(f"  retrace VIOLATION: current run booked "
+                         f"{current[key]} {label} — the warm path "
+                         f"retraced; fix that before reading the headline "
+                         f"as code drift")
     # fault attribution: run rows stamp retries/degradations (run.py) — a
     # degraded run is slower BY DESIGN, so the gate says so before anyone
     # blames code drift for the fault's wall-clock cost

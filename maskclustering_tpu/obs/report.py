@@ -164,7 +164,8 @@ class RunData:
             "d2h_bytes": self._counters.get("d2h.bytes"),
             "counters": {k: v for k, v in sorted(self._counters.items())
                          if k.startswith(("run.", "bench.", "compile_cache.",
-                                          "pipeline.", "faults."))},
+                                          "pipeline.", "faults.",
+                                          "retrace."))},
         }
         ov = self.overlap()
         if ov is not None:
@@ -310,6 +311,26 @@ def render_analysis(rows: List[Dict]) -> Optional[str]:
     return "\n".join(out)
 
 
+def render_retrace(counters: Dict[str, float]) -> Optional[str]:
+    """The retrace-sanitizer digest line (armed runs only): compile events
+    vs new shape buckets, with violations called out. Lives in the
+    Analysis section — the sanitizer is the retrace family's dynamic
+    half, so its verdict renders next to mct-check's."""
+    compiles = counters.get("retrace.compiles")
+    if compiles is None:
+        return None
+    line = (f"retrace sanitizer: {int(compiles)} compile(s) | "
+            f"{int(counters.get('retrace.distinct_programs', 0))} "
+            f"program(s) | "
+            f"{int(counters.get('retrace.buckets_new', 0))} new bucket(s)")
+    repeats = int(counters.get("retrace.repeat_compiles", 0))
+    frozen = int(counters.get("retrace.post_freeze_compiles", 0))
+    if repeats or frozen:
+        line += (f" | VIOLATIONS: {repeats} repeat, {frozen} post-warm — "
+                 f"the serve-many contract broke")
+    return line
+
+
 def render_report(run: RunData) -> str:
     rows = [[r["stage"], str(r["count"]), _fmt_s(r["p50_s"]), _fmt_s(r["p95_s"]),
              _fmt_s(r["device_p50_s"]), _fmt_s(r["host_p50_s"]),
@@ -348,8 +369,12 @@ def render_report(run: RunData) -> str:
     if faults_sec:
         out.append(faults_sec)
     analysis_sec = render_analysis(run.analysis_rows)
+    retrace_line = render_retrace(run._counters)
     if analysis_sec:
-        out.append(analysis_sec)
+        out.append(analysis_sec + ("\n" + retrace_line if retrace_line
+                                   else ""))
+    elif retrace_line:
+        out.append("== analysis (retrace sanitizer) ==\n" + retrace_line)
     return "\n".join(out)
 
 

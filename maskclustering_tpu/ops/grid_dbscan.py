@@ -47,6 +47,7 @@ on the parity path.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -249,15 +250,28 @@ def grid_dbscan_pairs(points, order, start, length, pair_rep, pair_pt,
     return dense_local, root_count, nb_overflow
 
 
+@functools.lru_cache(maxsize=1)
+def _pairs_jit():
+    """ONE persistent jit of :func:`grid_dbscan_pairs` for the standalone
+    dispatch. A per-call ``jax.jit(...)`` wrapper would rebuild its
+    executable cache on every invocation — the retrace family's
+    RETRACE.STATIC pattern, the measured 48 s/scene bug class
+    ``_associate_scene_jit`` documents. jax stays a lazy import: the
+    module's host-side half (build_grid) must import without it.
+    """
+    import jax
+
+    return functools.partial(jax.jit, static_argnames=(
+        "r_pad", "cell_cap", "neighbor_cap", "eps", "min_points"))(
+        grid_dbscan_pairs)
+
+
 def grid_dbscan_reference(points, valid_rows, grid: GridStructure, *,
                           neighbor_cap: int, eps: float, min_points: int):
     """Standalone jitted entry over (R, N) validity rows (tests and
     diagnostics); the post-process embeds :func:`grid_dbscan_pairs` in its
     own program with device-side pair compaction instead. Returns (R, N)
     dense labels (-1 noise/invalid)."""
-    import functools
-
-    import jax
     import jax.numpy as jnp
 
     valid_rows = np.asarray(valid_rows)
@@ -271,10 +285,7 @@ def grid_dbscan_reference(points, valid_rows, grid: GridStructure, *,
     pair_pt[: len(rep)] = pt
     pair_valid[: len(rep)] = True
 
-    fn = functools.partial(jax.jit, static_argnames=(
-        "r_pad", "cell_cap", "neighbor_cap", "eps", "min_points"))(
-        grid_dbscan_pairs)
-    dense, _, overflow = fn(
+    dense, _, overflow = _pairs_jit()(
         jnp.asarray(points), jnp.asarray(grid.order),
         jnp.asarray(grid.start), jnp.asarray(grid.length),
         jnp.asarray(pair_rep), jnp.asarray(pair_pt),

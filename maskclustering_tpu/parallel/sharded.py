@@ -151,6 +151,15 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
     byte-identical to the non-donating step; backends without sharded
     donation leave the operands intact (both pinned by
     tests/test_parallel.py::test_fused_step_donate_path_identity).
+
+    Retrace contract: this builder returns a FRESH jit wrapper per call —
+    callers must cache per (mesh, cfg, k_max, donate)
+    (parallel/batch._cached_step is the production lru_cache; the cost
+    observatory lowers offline). That caching story is what keeps it in
+    mct-check's ``CACHED_BY_CALLER`` allowlist (analysis/retrace.py); the
+    ``per_scene`` program it traces is registered there too, and the
+    census pins one executable per lattice mesh via the lowered main
+    signature.
     """
 
     def per_scene(scene_points, depths, segs, intrinsics, cam_to_world, frame_valid):

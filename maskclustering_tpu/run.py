@@ -746,6 +746,17 @@ def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
             # the retry round so the SAME fault class cannot burn the
             # whole retry budget at full configuration
             ladder.degrade(reason=f"device-class failure(s) in round {round_no}")
+            from maskclustering_tpu.analysis import retrace_sanitizer
+
+            if retrace_sanitizer.enabled():
+                # tag compile events with the rung: donation-off (and any
+                # future surface-adding rung) legitimately rebuilds its
+                # programs — under a new context those are enumerated
+                # surface (compile_surface_baseline.json "rungs"), not
+                # repeat-compile violations. The switch happens between
+                # executor rounds, when the scene queue is drained
+                retrace_sanitizer.set_context(
+                    "+".join(ladder.applied_names) or "baseline")
         delay = policy.backoff(round_no)
         obs.count("run.scene_retries", len(retry))
         log.warning("retrying %d scene(s) in %.2fs (round %d/%d, rung %d%s)",
@@ -1147,12 +1158,16 @@ def _run_pipeline_body(
                 cfg, seq_names, resume=resume, scene_points_cache=pts_cache))
 
     if obs_events and obs.enabled():
-        from maskclustering_tpu.analysis import lock_sanitizer
+        from maskclustering_tpu.analysis import lock_sanitizer, retrace_sanitizer
 
         if lock_sanitizer.enabled():
             # book the sanitizer digest (locks.* counters) before the
             # flush so the report's Faults section renders it
             lock_sanitizer.emit_counters()
+        if retrace_sanitizer.enabled():
+            # same move for the retrace digest (retrace.* counters): the
+            # report's Analysis section renders the compile-event line
+            retrace_sanitizer.emit_counters()
         obs.flush_metrics()
         try:
             from maskclustering_tpu.obs.report import RunData
@@ -1285,6 +1300,14 @@ def main(argv=None) -> int:
                              "static lock-order graph — CI/drill knob, "
                              "results identical, metrics hot path gains "
                              "a few dict ops per bump")
+    parser.add_argument("--retrace-sanitizer", action="store_true",
+                        help="arm the compile-event sanitizer for this run "
+                             "(retrace-family sanitizer; default: "
+                             "$MCT_RETRACE_SANITIZER). Hooks jax's compile "
+                             "log per (fn, signature, ladder rung), counts "
+                             "retrace.* metrics, and flags repeat compiles "
+                             "— the serve-many contract's runtime half. "
+                             "CI/drill knob, results identical")
     parser.add_argument("--fault-plan", default=None,
                         help="deterministic fault injection spec (e.g. "
                              "'load:scene2, stall:scene4.device, "
@@ -1320,6 +1343,14 @@ def main(argv=None) -> int:
         # the plan/registry locks already exist (import time) — re-wrap
         # them in place; per-instance locks arm at creation from here on
         lock_sanitizer.instrument_known_locks()
+    from maskclustering_tpu.analysis import retrace_sanitizer
+
+    if args.retrace_sanitizer:
+        retrace_sanitizer.arm(True)
+    if retrace_sanitizer.enabled():
+        # hook the compile log before backend init so warm-up compiles
+        # are on the books too (the env flag alone also lands here)
+        retrace_sanitizer.install()
     if args.fault_plan:
         faults.set_plan(faults.FaultPlan.from_spec(args.fault_plan))
     # SIGTERM-safe shutdown: the scene loops stop at the next scene

@@ -77,22 +77,49 @@ def bucket_size(value: int, multiple: int) -> int:
     return m * multiple
 
 
+def scene_pads(cfg, frames: int, points: int) -> Tuple[int, int]:
+    """(f_pad, n_pad) of a scene under ``cfg``'s padding multiples."""
+    return (bucket_size(frames, max(cfg.frame_pad_multiple, 1)),
+            bucket_size(points, max(cfg.point_chunk, 1)))
+
+
+def scene_bucket(cfg, frames: int, points: int, max_id: int) -> Tuple[int, int, int]:
+    """The scene-level compile-cache key: (k_max, f_pad, n_pad).
+
+    THE classifier — ``run_scene_device`` routes every scene through the
+    same ``scene_pads``/``bucket_k_max`` helpers before dispatch, and the
+    retrace family's compile-surface census (analysis/retrace.py)
+    enumerates executables with this composition, so "bucket" means one
+    thing across serving, the static gate and the runtime sanitizer.
+    ``max_id`` is the scene's largest segmentation id.
+    """
+    from maskclustering_tpu.models.pipeline import bucket_k_max
+
+    return (bucket_k_max(max_id), *scene_pads(cfg, frames, points))
+
+
 def record_shape_bucket(kind: str, *bucket) -> bool:
     """Record a jit shape bucket; returns True (and logs) if new.
 
     Doubles as the compile-cache hit-rate metric: a repeat bucket is a
     guaranteed in-process jit-cache hit, a new one is (at best) a
-    persistent-cache deserialize and (at worst) a fresh compile.
+    persistent-cache deserialize and (at worst) a fresh compile. The
+    retrace sanitizer (analysis/retrace_sanitizer.py) is told about new
+    buckets so its digest can read "N compiles against M new buckets" —
+    a warm serve-many process reads 0/0.
     """
     from maskclustering_tpu import obs
+    from maskclustering_tpu.analysis import retrace_sanitizer
 
     key = (kind, *bucket)
     if key in _SEEN_BUCKETS:
         obs.count("compile_cache.bucket_hit")
+        retrace_sanitizer.note_bucket(False)
         return False
     _SEEN_BUCKETS.add(key)
     obs.count("compile_cache.bucket_new")
     obs.gauge("compile_cache.distinct_buckets", len(_SEEN_BUCKETS))
+    retrace_sanitizer.note_bucket(True)
     log.info("new %s shape bucket: %s", kind, bucket)
     return True
 

@@ -38,6 +38,8 @@
 #      (+ per-stage diffs of both A/B runs against the default)
 #   8. cost observatory (CPU AOT; no chip time) -> cost_census.out + cost_events.jsonl
 #      + dtype census (bf16-vs-int8 AOT diff)  -> dtype_census.out
+#      + mct-check advisories (ast/ir, concurrency, retrace) on their own
+#        events files -> mct_check.out / conc_check.out / retrace_check.out
 #   9. perf ledger history + regress gate      -> perf_ledger.out
 #      (bench steps above append rows to PERF_LEDGER.jsonl by default;
 #      rows carry count_dtype/plane_dtype so A/B deltas self-attribute)
@@ -163,6 +165,13 @@ run mct_check 120 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.analysis \
 # per file, so appending here would mask the full run's IR/AST findings
 run conc_check 60 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.analysis \
   --families concurrency --events "$OUT/conc_events.jsonl"
+# mct-retrace: the compile-surface family (closure-capture/branch lint +
+# the census ratchet vs compile_surface_baseline.json) — CPU AOT like the
+# cost census, ADVISORY here and fatal in ci.sh. Its OWN events file for
+# the same reason as conc_check: obs.report renders one analysis run per
+# file, and this verdict must not mask (or be masked by) the others
+run retrace_check 300 env JAX_PLATFORMS=cpu python -m maskclustering_tpu.analysis \
+  --families retrace --events "$OUT/retrace_events.jsonl"
 # perf ledger: render the trajectory the bench steps above just appended
 # to, and gate against the last committed good verdict when present
 if [ -f BENCH_builder_r05.json ]; then

@@ -25,6 +25,13 @@
 #    thread topology, shared-state reachability, lock-order acyclicity,
 #    blocking-under-lock, signal-handler and join/abandon contracts —
 #    pure stdlib AST, sub-5 s (MCT_CHECK=0 skips this too). FATAL.
+# 3c. runs the mct-check RETRACE family as its own gate (distinct exit
+#    code 6, so triage points at the compile surface): traced-closure
+#    captures, trace-time shape branching, jit-site hygiene, and the
+#    compile-surface census ratchet against compile_surface_baseline.json
+#    — an accidental new compile variant fails here with its exact
+#    (fn, bucket, dtype, donation) coordinate. Lowers the fused lattice
+#    on CPU (~15 s); FATAL (MCT_CHECK=0 skips this too).
 # 4. runs ruff (the style/correctness front-end pinned in pyproject.toml)
 #    when the PINNED version is installed (fatal); an unpinned ruff runs
 #    advisory-only — a floating linter's new rules must not flip CI red,
@@ -39,8 +46,9 @@
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
 # Exits non-zero on test failures (1), a fault-matrix failure (3), an
 # mct-check finding or ruff violation (4), a concurrency-family finding
-# (5), or a perf regression (2), so it gates correctness, fault
-# tolerance, the invariants, thread safety AND the trajectory.
+# (5), a retrace-family finding (6), or a perf regression (2), so it
+# gates correctness, fault tolerance, the invariants, thread safety, the
+# compile surface AND the trajectory.
 # Every gate still RUNS after a failure, but the exit code is the FIRST
 # failing gate's — triage by exit code points at the right gate.
 set -u -o pipefail
@@ -93,6 +101,15 @@ if [ "${MCT_CHECK:-1}" != "0" ]; then
              "finding, annotate with # mct-thread:, or baseline it in" \
              "analysis_baseline.json with a justification)" >&2
         fail 5
+    fi
+    echo "== ci: mct-check retrace gate (compile-surface census + capture lint, <240s) =="
+    if ! timeout -k 10 240 env JAX_PLATFORMS=cpu \
+            python -m maskclustering_tpu.analysis --families retrace; then
+        echo "ci: mct-check retrace FAILED (a compile variant joined or" \
+             "left the surface: fix the capture/branch/jit-site finding," \
+             "or audit the census diff and regenerate" \
+             "compile_surface_baseline.json with --write-surface)" >&2
+        fail 6
     fi
 fi
 

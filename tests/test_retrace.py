@@ -1,0 +1,578 @@
+"""Family 5 (retrace): compile-surface analyzer + runtime sanitizer.
+
+Covers the ISSUE-9 acceptance set: seeded-defect fixtures asserting exact
+ids for RETRACE.CAPTURE/BRANCH/STATIC/SURFACE, the census ratchet against
+the committed compile_surface_baseline.json, repo-clean-modulo-baseline
+(sharing the session-scoped ``fused_lattice_aot`` AOT sweep — no second
+lattice lowering), CLI red on a fixture tree with an injected closure
+capture, the serve-many sanitizer contract (a warm same-bucket scene
+books ZERO compiles, across BOTH scene executors), and the
+degradation-rung surface pin (donation-off adds only its baselined
+variants; the exact-set variant runs cold in the slow tier).
+
+Tier-1 wall budget (ISSUE-9): ~20 s for this file net of the
+postprocess-fixture reclaim — the AST/census/report tests are
+sub-second, the sanitizer units compile O(1) tiny programs, and the two
+pipeline tests reuse tiny scenes + the process-warm jit caches.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu.analysis.retrace import (
+    RUNG_SURFACE,
+    analyze_retrace,
+    check_surface,
+    compile_surface,
+    fused_surface_rows,
+    load_surface_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REL = "maskclustering_tpu/models/retrace_fix.py"
+
+
+def _retrace(root, src, rel=_REL):
+    """Write one seeded-defect module into a tmp tree, run the family
+    (pure-AST mode: no census marker, no lowering)."""
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return analyze_retrace(str(root), lower_missing=False)
+
+
+# ---------------------------------------------------------------------------
+# seeded-defect fixtures: exact finding ids
+# ---------------------------------------------------------------------------
+
+
+def test_capture_fixture_flags_per_scene_closure(tmp_path):
+    # DELIBERATE BREAK: a traced closure bakes `tensors` (per-scene state)
+    # into its program; cfg/k_max are compile-stable and stay clean
+    findings = _retrace(tmp_path / "bad", """
+        import jax
+
+        def build(cfg, k_max, tensors):
+            def step(x):
+                return x * tensors.scale + cfg.threshold + k_max
+            return jax.jit(step)
+    """)
+    assert [f.id for f in findings if f.check == "RETRACE.CAPTURE"] == [
+        f"RETRACE.CAPTURE:{_REL}:build:step:tensors"]
+
+    # clean: compile-stable captures only, builder cached
+    clean = _retrace(tmp_path / "ok", """
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=None)
+        def build(cfg, k_max):
+            def step(x):
+                return x * k_max + cfg.threshold
+            return jax.jit(step)
+    """)
+    assert not [f for f in clean
+                if f.check in ("RETRACE.CAPTURE", "RETRACE.STATIC")]
+
+
+def test_capture_fixture_flags_jit_partial_binding(tmp_path):
+    # DELIBERATE BREAK: jit(partial(...)) binds a per-scene value — the
+    # partial route must be checked exactly like a closure
+    findings = _retrace(tmp_path, """
+        import functools
+        import jax
+
+        def impl(x, *, scale):
+            return x * scale
+
+        def build(cfg, scene_scale):
+            return jax.jit(functools.partial(impl, scale=scene_scale))
+    """)
+    ids = [f.id for f in findings if f.check == "RETRACE.CAPTURE"]
+    assert ids == [f"RETRACE.CAPTURE:{_REL}:build:impl:scene_scale"]
+
+
+def test_branch_fixture_flags_shape_branching(tmp_path):
+    # DELIBERATE BREAK: trace-time `.shape` branch in a jit root, a
+    # len() ternary in a module-local helper it calls, and a branch in a
+    # NESTED def (reported once, under the nested fn — not double-counted
+    # under the enclosing root too); the audited (mct-ok) and
+    # dtype-branching functions stay clean
+    findings = _retrace(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            def inner(y):
+                if y.shape[0] > 2:
+                    return y - 1
+                return y
+
+            y = helper(inner(x))
+            if x.shape[0] > 4:
+                return y + 1
+            return y
+
+        def helper(y):
+            return y * 2 if len(y) > 3 else y
+
+        @jax.jit
+        def audited(x):
+            if x.shape[0] > 4:  # mct-ok: RETRACE.BRANCH
+                return x + 1
+            return x
+
+        @jax.jit
+        def dtype_ok(x):
+            if x.dtype == jnp.uint16:
+                return x + 1
+            return x
+    """)
+    ids = sorted(f.id for f in findings if f.check == "RETRACE.BRANCH")
+    assert ids == [f"RETRACE.BRANCH:{_REL}:helper:1",
+                   f"RETRACE.BRANCH:{_REL}:inner:1",
+                   f"RETRACE.BRANCH:{_REL}:step:1"]
+
+
+def test_call_form_decorator_is_one_site_and_helpers_are_stable(tmp_path):
+    """Review regressions: a call-form `@jax.jit(...)` decorator must not
+    mint a phantom second (anonymous, 'fresh') site, and a traced
+    function calling a SIBLING nested helper captures a compile-stable
+    callable, not per-scene state."""
+    findings = _retrace(tmp_path, """
+        import functools
+        import jax
+
+        @jax.jit(donate_argnums=(0,))
+        def kernel(x):
+            return x
+
+        @functools.lru_cache(maxsize=None)
+        def build(cfg):
+            def helper(y):
+                return y * cfg.scale
+
+            def step(x):
+                return helper(x)
+
+            return jax.jit(step)
+    """)
+    assert not [f for f in findings
+                if f.check in ("RETRACE.STATIC", "RETRACE.CAPTURE")]
+    # exactly the two named roots need classification — no "<anon>"
+    assert sorted(f.id for f in findings
+                  if f.check == "RETRACE.SURFACE") == [
+        f"RETRACE.SURFACE:{_REL}:unclassified:kernel",
+        f"RETRACE.SURFACE:{_REL}:unclassified:step"]
+
+
+def test_static_fixture_flags_nonliteral_and_fresh_wrapper(tmp_path):
+    # DELIBERATE BREAKS: a computed static_argnames vocabulary, and a jit
+    # wrapper rebuilt inside a plain (uncached) function
+    findings = _retrace(tmp_path, """
+        import jax
+
+        NAMES = ("a", "b")
+
+        def inner(y):
+            return y
+
+        def rebuild(x):
+            return jax.jit(lambda y: y + 1)(x)
+
+        def computed(x):
+            return jax.jit(inner, static_argnames=NAMES)(x)
+    """)
+    ids = sorted(f.id for f in findings if f.check == "RETRACE.STATIC")
+    assert ids == [
+        f"RETRACE.STATIC:{_REL}:computed:inner:fresh",
+        f"RETRACE.STATIC:{_REL}:inner:static_argnames:nonliteral",
+        f"RETRACE.STATIC:{_REL}:rebuild:<lambda>:fresh",
+    ]
+
+
+def test_surface_fixture_flags_unclassified_jit_site(tmp_path):
+    # DELIBERATE BREAK: a jit site tracing a function in neither
+    # SERVING_PROGRAMS nor AUX_PROGRAMS — the source-level surface ratchet
+    findings = _retrace(tmp_path, """
+        import jax
+
+        @jax.jit
+        def brand_new_kernel(x):
+            return x
+    """)
+    assert [f.id for f in findings if f.check == "RETRACE.SURFACE"] == [
+        f"RETRACE.SURFACE:{_REL}:unclassified:brand_new_kernel"]
+    # ...and the inline audit marker sanctions a classified-elsewhere site
+    clean = _retrace(tmp_path / "ok", """
+        import jax
+
+        @jax.jit  # mct-ok: RETRACE.SURFACE
+        def diagnostics_only(x):
+            return x
+    """)
+    assert not [f for f in clean if f.check == "RETRACE.SURFACE"]
+
+
+# ---------------------------------------------------------------------------
+# the census ratchet vs the committed baseline
+# ---------------------------------------------------------------------------
+
+
+def test_census_matches_committed_baseline(fused_lattice_aot):
+    """The committed compile_surface_baseline.json IS the current census
+    — serving rows, rung surface, and the fused rows read from the SAME
+    session-scoped AOT sweep the cost/IR tests use (no second lowering).
+    """
+    baseline = load_surface_baseline(
+        os.path.join(REPO_ROOT, "compile_surface_baseline.json"))
+    assert baseline is not None, "the surface baseline must stay committed"
+    lows = {mesh: (row["stablehlo"], row["compiled_text"])
+            for mesh, row in fused_lattice_aot.items()}
+    assert check_surface(compile_surface(), baseline,
+                         fused_surface_rows(lows)) == []
+    # rung vocabulary: baseline and analyzer constant stay ONE vocabulary
+    assert baseline["rungs"] == {k: sorted(v)
+                                 for k, v in RUNG_SURFACE.items()}
+
+
+def test_surface_ratchet_flags_growth_and_shrinkage():
+    census = compile_surface()
+    baseline = json.loads(json.dumps(census))
+    removed = baseline["surface"].pop(0)
+    baseline["surface"].append("fn=phantom bucket=<config>")
+    ids = {f.id for f in check_surface(census, baseline)}
+    assert f"RETRACE.SURFACE:serving:grew:{removed}" in ids
+    assert ("RETRACE.SURFACE:serving:shrank:fn=phantom bucket=<config>"
+            in ids)
+    # a rung losing its enumerated variants is growth of the CHECKED set
+    baseline2 = json.loads(json.dumps(census))
+    baseline2["rungs"]["donation-off"] = []
+    assert any(":rung:donation-off:grew:" in f.id
+               for f in check_surface(census, baseline2))
+
+
+def test_analyze_retrace_repo_clean(fused_lattice_aot):
+    """The repo itself is clean — no baseline suppressions needed for the
+    retrace family (defects found while building it were fixed, not
+    baselined: the grid_dbscan_reference fresh-wrapper and the anonymous
+    association partial)."""
+    lows = {mesh: (row["stablehlo"], row["compiled_text"])
+            for mesh, row in fused_lattice_aot.items()}
+    findings = analyze_retrace(REPO_ROOT, lowerings=lows)
+    assert [f.id for f in findings] == []
+
+
+def test_cli_retrace_red_on_fixture_tree_green_on_repo(tmp_path):
+    from maskclustering_tpu.analysis.__main__ import main
+
+    # injected closure capture -> exit 2 (pure AST on a fixture tree: the
+    # census marker is absent, so no lowering happens)
+    pkg = tmp_path / "maskclustering_tpu" / "models"
+    pkg.mkdir(parents=True)
+    (pkg / "pipeline.py").write_text(textwrap.dedent("""
+        import jax
+
+        def build(tensors):
+            def step(x):
+                return x + tensors.n_real
+            return jax.jit(step)
+    """))
+    assert main(["--families", "retrace", "--root", str(tmp_path)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the runtime sanitizer
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sanitizer():
+    from maskclustering_tpu.analysis import retrace_sanitizer as rs
+
+    rs.reset()
+    rs.install()
+    yield rs
+    rs.uninstall()
+    rs.reset()
+
+
+def test_sanitizer_env_and_arm_precedence(monkeypatch):
+    from maskclustering_tpu.analysis import retrace_sanitizer as rs
+
+    monkeypatch.delenv(rs.ENV_FLAG, raising=False)
+    rs.arm(None)
+    assert not rs.enabled()
+    monkeypatch.setenv(rs.ENV_FLAG, "1")
+    assert rs.enabled()
+    rs.arm(False)  # explicit arm beats the environment
+    try:
+        assert not rs.enabled()
+    finally:
+        rs.arm(None)
+
+
+def test_sanitizer_records_repeats_contexts_and_freeze(sanitizer):
+    import jax
+    import jax.numpy as jnp
+
+    def make_step():
+        # a FRESH function object per call — the rebuilt-closure pattern.
+        # (jax dedupes `jax.jit(f)` wrappers of the SAME function object
+        # through its C++ cache, so only a genuinely new trace retraces —
+        # which is exactly what a per-call closure produces.)
+        def retrace_probe(x):
+            return x * 2 + 1
+
+        return jax.jit(retrace_probe)
+
+    make_step()(jnp.ones(3))
+    assert any(fn == "retrace_probe"
+               for fn, _, _ in sanitizer.snapshot_keys())
+    assert sanitizer.violations() == []
+    # rebuilding the closure = same (fn, signature) compiled again =
+    # jit-cache thrash = repeat violation
+    make_step()(jnp.ones(3))
+    assert any(v["kind"] == "repeat" and v["fn"] == "retrace_probe"
+               for v in sanitizer.violations())
+    # a ladder-context switch makes the same rebuild a NEW key (the
+    # donation-off rung's enumerated surface), not another repeat
+    sanitizer.set_context("donation-off")
+    make_step()(jnp.ones(3))
+    repeats = [v for v in sanitizer.violations()
+               if v["fn"] == "retrace_probe" and v["kind"] == "repeat"]
+    assert len(repeats) == 1
+    # frozen: a brand-new signature is a post-freeze violation
+    sanitizer.set_context("baseline")
+    sanitizer.freeze()
+    make_step()(jnp.ones(5))
+    assert any(v["kind"] == "post_freeze" for v in sanitizer.violations())
+    d = sanitizer.digest()
+    assert d["compiles"] >= 4 and d["by_fn"]["retrace_probe"] >= 4
+
+
+def test_frozen_rung_drop_sanctions_only_enumerated_programs(sanitizer):
+    """A FROZEN process that drops a ladder rung (the serving daemon's
+    life story) may rebuild exactly the rung's baselined programs; any
+    other post-freeze compile under that context stays a violation."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones(9)  # eager materialization compiles BEFORE the freeze
+    sanitizer.freeze()
+    sanitizer.set_context("sequential-executor+donation-off")
+
+    def _mask_group_counts_impl(x):  # a RUNG_SURFACE["donation-off"] name
+        return x + 1
+
+    def some_other_kernel(x):
+        return x - 1
+
+    jax.jit(_mask_group_counts_impl)(x)
+    assert sanitizer.violations() == []  # enumerated rung surface
+    jax.jit(some_other_kernel)(x)
+    assert [v["fn"] for v in sanitizer.violations()
+            if v["kind"] == "post_freeze"] == ["some_other_kernel"]
+
+
+def test_sanitizer_suppresses_compile_log_chatter(sanitizer, caplog):
+    import logging
+
+    import jax
+    import jax.numpy as jnp
+
+    with caplog.at_level(logging.DEBUG):
+        jax.jit(lambda x: x - 3)(jnp.ones(7))
+    assert not [r for r in caplog.records
+                if r.getMessage().startswith("Compiling ")]
+
+
+def test_sanitizer_counts_new_buckets_via_classifier(sanitizer):
+    from maskclustering_tpu.utils.compile_cache import record_shape_bucket
+
+    before = sanitizer.digest()["buckets_new"]
+    assert record_shape_bucket("retrace-test", 1, 2, 3) is True
+    assert record_shape_bucket("retrace-test", 1, 2, 3) is False  # repeat
+    after = sanitizer.digest()["buckets_new"]
+    assert after == before + 1
+
+
+def test_serve_many_zero_postwarm_compiles_both_executors(tmp_path,
+                                                          sanitizer):
+    """ISSUE-9 acceptance: a mixed-bucket CPU run books ZERO post-warm
+    compiles for repeated buckets — under the overlapped executor AND the
+    sequential one. Scenes 2/3 are byte-identical re-materializations of
+    scenes 0/1 (same seeds), so every shape bucket repeats.
+
+    Tier-1 budget: bucket A reuses test_executor's exact scene shape and
+    config (seed 40, 10 frames, 60x80, spacing 0.06, the scannet config
+    at mask_pad_multiple 32), so in a full suite run its programs are
+    process-warm; only bucket B's denser cloud compiles cold here."""
+    from maskclustering_tpu.config import load_config
+    from maskclustering_tpu.run import cluster_scenes
+    from maskclustering_tpu.utils.compile_cache import scene_bucket
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    to_scene_tensors,
+                                                    write_scannet_layout)
+
+    root = str(tmp_path)
+    # scene A == test_executor's scene0 byte-for-byte (shared warm shapes);
+    # scene B's thinner 4-box cloud lands one n_pad bucket up; scene A2 is
+    # A re-materialized under a new name (the repeated bucket)
+    specs = [("scene0000_00", 3, 0.06, 40), ("scene0001_00", 4, 0.05, 50),
+             ("scene0002_00", 3, 0.06, 40)]
+    cfg = load_config("scannet").replace(
+        data_root=root, step=1, distance_threshold=0.05,
+        mask_pad_multiple=32)
+    buckets = set()
+    for name, boxes, spacing, seed in specs:
+        sc = make_scene(num_boxes=boxes, num_frames=10,
+                        image_hw=(60, 80), spacing=spacing, seed=seed)
+        t = to_scene_tensors(sc)
+        buckets.add(scene_bucket(cfg, t.num_frames, t.num_points,
+                                 int(np.max(t.segmentations))))
+        write_scannet_layout(sc, root, name)
+    assert len(buckets) == 2, f"workload must be mixed-bucket: {buckets}"
+    names = [s[0] for s in specs]
+
+    warm = cluster_scenes(cfg, names[:2], resume=False)  # overlapped (default)
+    assert [s.status for s in warm] == ["ok", "ok"]
+    sanitizer.freeze()
+    before = sanitizer.snapshot_keys()
+
+    # overlapped executor, warm: the repeated-bucket scene plus a re-run
+    # of B — every bucket repeats, so ZERO compiles may book
+    over = cluster_scenes(cfg, [names[2], names[1]], resume=False)
+    assert [s.status for s in over] == ["ok", "ok"]
+    # sequential executor, warm: same contract on the serialized loop
+    seq = cluster_scenes(cfg.replace(scene_overlap=False), [names[2]],
+                         resume=False)
+    assert [s.status for s in seq] == ["ok"]
+
+    assert sanitizer.snapshot_keys() == before
+    assert sanitizer.violations() == []
+
+
+def test_donation_off_rung_adds_only_baselined_surface(sanitizer):
+    """The ladder's donation-off rung may only compile its enumerated
+    variants (compile_surface_baseline.json "rungs"). In-process jit
+    caches may already hold some variants warm, so tier-1 pins the subset
+    relation; the slow-marked cold-process test pins exact equality."""
+    from maskclustering_tpu.config import PipelineConfig
+    from maskclustering_tpu.models.pipeline import run_scene
+    from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+    baseline = load_surface_baseline(
+        os.path.join(REPO_ROOT, "compile_surface_baseline.json"))
+    cfg = PipelineConfig(config_name="synthetic", dataset="demo",
+                         backend="cpu", distance_threshold=0.03, step=1,
+                         mask_pad_multiple=64, point_chunk=2048)
+
+    def scene():
+        return to_scene_tensors(make_scene(num_boxes=3, num_frames=6,
+                                           seed=3, spacing=0.05))
+
+    run_scene(scene(), cfg, k_max=15)  # warm at full config
+    before = sanitizer.snapshot_keys()
+    sanitizer.set_context("donation-off")
+    run_scene(scene(), cfg.replace(donate_buffers=False), k_max=15)
+    new_fns = {fn for fn, _, _ in sanitizer.snapshot_keys() - before}
+    assert new_fns <= set(baseline["rungs"]["donation-off"])
+    assert sanitizer.violations() == []  # new context, no repeats
+
+
+@pytest.mark.slow
+def test_donation_off_rung_exact_surface_cold_process():
+    """Cold-process exactness: donation-off adds EXACTLY its baselined
+    variants (in-process warmth can hide members of the set, so the exact
+    pin runs in a subprocess with cold jit caches)."""
+    script = textwrap.dedent("""
+        import json, sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from maskclustering_tpu.analysis import retrace_sanitizer as rs
+        rs.install()
+        from maskclustering_tpu.config import PipelineConfig
+        from maskclustering_tpu.models.pipeline import run_scene
+        from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+        cfg = PipelineConfig(config_name="synthetic", dataset="demo",
+                             backend="cpu", distance_threshold=0.03, step=1,
+                             mask_pad_multiple=64, point_chunk=2048)
+        def scene():
+            return to_scene_tensors(make_scene(num_boxes=3, num_frames=6,
+                                               seed=3, spacing=0.05))
+        run_scene(scene(), cfg, k_max=15)
+        before = rs.snapshot_keys()
+        rs.set_context("donation-off")
+        run_scene(scene(), cfg.replace(donate_buffers=False), k_max=15)
+        new_fns = sorted({fn for fn, _, _ in rs.snapshot_keys() - before})
+        print(json.dumps(new_fns))
+    """)
+    proc = subprocess.run([sys.executable, "-c", script], cwd=REPO_ROOT,
+                          capture_output=True, text=True, timeout=420,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    new_fns = json.loads(proc.stdout.strip().splitlines()[-1])
+    baseline = load_surface_baseline(
+        os.path.join(REPO_ROOT, "compile_surface_baseline.json"))
+    assert new_fns == baseline["rungs"]["donation-off"]
+
+
+# ---------------------------------------------------------------------------
+# report + ledger integration
+# ---------------------------------------------------------------------------
+
+
+def test_render_retrace_line_and_violations():
+    from maskclustering_tpu.obs.report import render_retrace
+
+    assert render_retrace({}) is None
+    line = render_retrace({"retrace.compiles": 5.0,
+                           "retrace.distinct_programs": 4.0,
+                           "retrace.buckets_new": 2.0})
+    assert "5 compile(s)" in line and "2 new bucket(s)" in line
+    assert "VIOLATIONS" not in line
+    line2 = render_retrace({"retrace.compiles": 5.0,
+                            "retrace.repeat_compiles": 1.0})
+    assert "VIOLATIONS: 1 repeat" in line2
+
+
+def test_run_row_stamps_retrace_counters():
+    from maskclustering_tpu.obs.ledger import run_row
+
+    report = {"scenes": [{"status": "ok", "seconds": 2.0}],
+              "obs": {"counters": {"retrace.compiles": 7.0,
+                                   "compile_cache.bucket_new": 3.0}}}
+    row = run_row(report)
+    assert row["retrace_compiles"] == 7
+    assert row["buckets_new"] == 3
+    # a fully-warm armed run's ZERO is stamped too — it is the baseline
+    # row the 0 -> N compile-regression attribution anchors on
+    warm = run_row({"scenes": [{"status": "ok", "seconds": 1.0}],
+                    "obs": {"counters": {"retrace.compiles": 0.0}}})
+    assert warm["retrace_compiles"] == 0
+
+
+def test_regress_attributes_retrace_deltas():
+    from maskclustering_tpu.obs.ledger import check_regression
+
+    base = {"value": 1.0, "retrace_compiles": 18}
+    cur = {"value": 1.0, "retrace_compiles": 30, "retrace_repeats": 2}
+    ok, lines = check_regression(cur, base)
+    joined = "\n".join(lines)
+    assert ok  # advisory only: the headline did not regress
+    assert "retrace: sanitizer recorded 18 -> 30" in joined
+    assert "surface growth or a cold process" in joined
+    assert "retrace VIOLATION" in joined and "2 repeat compile(s)" in joined
+    # with a knob flip on record, the advisory attributes the flip first
+    cur2 = {"value": 1.0, "retrace_compiles": 30, "count_dtype": "int8"}
+    _, lines2 = check_regression(cur2, base)
+    assert "flipped knob" in "\n".join(lines2)
