@@ -4,12 +4,14 @@ The pipeline is genuinely multi-threaded — prefetch daemons
 (``DaemonFuture``), the overlapped executor's host-tail worker, watchdog
 deadline threads (``utils/faults.call_with_deadline``), the SIGTERM
 handler, two ``ThreadPoolExecutor`` pools (semantics/features.py,
-ops/dbscan.py) and the lock-guarded obs sinks — and the scene-serving
-daemon (ROADMAP item 1) multiplies thread populations and shared state by
-an order of magnitude. PR 3's registry race and PR 5's
-deadline/abandonment semantics were caught by review; this module makes
-thread safety a machine-checked contract, the way ``mct-check``'s other
-families gate the sync/dtype/donation contracts.
+ops/dbscan.py), the lock-guarded obs sinks — and, since PR 10, the
+mct-serve daemon's acceptor / per-connection handler / device-worker
+threads (``maskclustering_tpu/serve/``, scanned via the package root of
+``SCAN_ROOTS`` and annotated with the ``# mct-thread:`` grammar below).
+PR 3's registry race and PR 5's deadline/abandonment semantics were
+caught by review; this module makes thread safety a machine-checked
+contract, the way ``mct-check``'s other families gate the
+sync/dtype/donation contracts.
 
 **Thread-topology model.** Thread roots are collected tree-wide: targets
 of ``DaemonFuture(fn)`` / ``threading.Thread(target=fn)`` / executor

@@ -213,8 +213,9 @@ def _run_scene_device_impl(tensors: SceneTensors, cfg: PipelineConfig, *,
     faults.inject("device", seq_name)
 
     if k_max is None:
-        max_id = int(np.max(tensors.segmentations)) if np.size(tensors.segmentations) else 0
-        k_max = bucket_k_max(max_id)
+        from maskclustering_tpu.utils.compile_cache import max_seg_id
+
+        k_max = bucket_k_max(max_seg_id(tensors.segmentations))
 
     n_real = tensors.num_points
     with tracer.span("associate", scene=seq_name, k_max=k_max,

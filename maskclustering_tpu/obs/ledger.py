@@ -147,6 +147,28 @@ def run_row(report: Dict, **extra) -> Dict:
     return row
 
 
+def serve_row(verdict: Dict, **extra) -> Dict:
+    """Ledger row from a load_gen serve verdict (scripts/load_gen.py).
+
+    The metric is serve-specific ("serve s/request ..."), so --regress
+    never gates a serve row against a bench/run baseline (or vice versa):
+    ``latest_value_row``'s metric filter plus the tool fence below keep
+    the trajectories separate while sharing one ledger file.
+    """
+    row = {"tool": "serve",
+           "metric": verdict.get("metric", "serve s/request (p50)"),
+           "value": verdict.get("value"),
+           "unit": verdict.get("unit", "s/request")}
+    for k in ("p95_s", "throughput_rps", "requests", "concurrency",
+              "scenes", "buckets", "rejects", "failed", "warmup_s",
+              "count_dtype", "plane_dtype", "retrace_compiles",
+              "retrace_repeats", "retrace_post_freeze", "error"):
+        if verdict.get(k) is not None:
+            row[k] = verdict[k]
+    row.update(extra)
+    return row
+
+
 def read_ledger(path: str, *, stats: Optional[ReadStats] = None) -> List[Dict]:
     """All known-version rows, oldest first; torn/unknown lines are counted
     into ``stats`` and skipped (one shared policy: events.iter_jsonl_rows)."""
@@ -157,15 +179,21 @@ def read_ledger(path: str, *, stats: Optional[ReadStats] = None) -> List[Dict]:
 
 
 def latest_value_row(rows: List[Dict], *,
-                     metric: Optional[str] = None) -> Optional[Dict]:
+                     metric: Optional[str] = None,
+                     exclude_tools: Tuple[str, ...] = ()) -> Optional[Dict]:
     """Newest row with a numeric headline value (null verdicts are history,
     not baselines). ``metric`` restricts the pick to comparable rows — the
     --regress gate must not compare a run-row median against a bench
-    baseline just because it is newer."""
+    baseline just because it is newer. ``exclude_tools`` fences whole
+    trajectories out of the METRIC-LESS fallback pick: a ``serve`` p50
+    (s/request under concurrency) must never gate against a bench
+    baseline (s/scene) just because a load_gen row is the newest."""
     for row in reversed(rows):
         if not isinstance(row.get("value"), (int, float)):
             continue
         if metric is not None and row.get("metric") != metric:
+            continue
+        if metric is None and row.get("tool") in exclude_tools:
             continue
         return row
     return None

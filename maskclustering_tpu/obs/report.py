@@ -165,7 +165,7 @@ class RunData:
             "counters": {k: v for k, v in sorted(self._counters.items())
                          if k.startswith(("run.", "bench.", "compile_cache.",
                                           "pipeline.", "faults.",
-                                          "retrace."))},
+                                          "retrace.", "serve."))},
         }
         ov = self.overlap()
         if ov is not None:
@@ -173,11 +173,18 @@ class RunData:
         return out
 
 
-def _pct(sorted_vals: List[float], q: float) -> float:
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending list — THE one quantile
+    rule every surface shares (stage tables here, the serve worker's
+    latency digest, load_gen's verdict), so p50/p95 cannot silently
+    disagree between the report, the daemon and the ledger row."""
     if not sorted_vals:
         return 0.0
     idx = min(int(q / 100.0 * len(sorted_vals)), len(sorted_vals) - 1)
     return sorted_vals[idx]
+
+
+_pct = percentile  # internal alias (stage tables predate the public name)
 
 
 def _fmt_s(v: Optional[float]) -> str:
@@ -311,6 +318,72 @@ def render_analysis(rows: List[Dict]) -> Optional[str]:
     return "\n".join(out)
 
 
+def render_serving(run: "RunData") -> Optional[str]:
+    """The Serving section: the daemon's admission/latency/warmth digest.
+
+    Rendered only when the events file carries ``serve.*`` metrics (a
+    daemon run with ``--obs_events``); batch run reports are unchanged.
+    Sources: the admission counters (``serve.admission.*``,
+    ``serve.requests*``), the queue/latency gauges the daemon books at
+    shutdown (``emit_serve_counters``), the ``serve.request`` span series
+    (per-request p50/p95 — preferred over the gauges when present), and
+    the retrace sanitizer's post-freeze count as "compiles post-warm-up"
+    (the serve-many contract's headline number: a warm daemon reads 0).
+    """
+    c, g = run._counters, run._gauges
+    if not any(k.startswith("serve.") for k in list(c) + list(g)):
+        return None
+    requests = int(c.get("serve.requests", 0))
+    by_status = {s: int(c.get(f"serve.requests_{s}", 0))
+                 for s in ("ok", "failed", "deadline", "skipped",
+                           "interrupted")}
+    rejects = {k[len("serve.admission.rejects."):]: int(v)
+               for k, v in sorted(c.items())
+               if k.startswith("serve.admission.rejects.")}
+    if c.get("serve.rejects.deadline"):
+        rejects["deadline"] = (rejects.get("deadline", 0)
+                               + int(c["serve.rejects.deadline"]))
+    lines = ["== serving (mct-serve) =="]
+    lines.append(
+        f"requests {requests} | "
+        + " | ".join(f"{s} {n}" for s, n in by_status.items() if n)
+        + (f" | warm-up scenes {int(c['serve.warmup_scenes'])}"
+           if c.get("serve.warmup_scenes") else ""))
+    depth_hw = g.get("serve.queue_depth_high_water")
+    admitted = c.get("serve.admission.admitted")
+    lines.append(
+        f"admission: {int(admitted or 0)} admitted | queue high-water "
+        f"{int(depth_hw or 0)}"
+        + (" | rejects: " + ", ".join(f"{r} x{n}"
+                                      for r, n in rejects.items())
+           if rejects else " | rejects: none"))
+    # per-request latency: the span series is exact; the shutdown gauges
+    # are the fallback when a digest-only file has no spans
+    p50 = p95 = None
+    for r in run.stage_rows():
+        if r["stage"] == "serve.request":
+            p50, p95 = r["p50_s"], r["p95_s"]
+            break
+    if p50 is None:
+        p50, p95 = g.get("serve.request_p50_s"), g.get("serve.request_p95_s")
+    if p50 is not None:
+        lines.append(f"request latency: p50 {_fmt_s(p50)} | p95 {_fmt_s(p95)}")
+    post_warm = c.get("retrace.post_freeze_compiles")
+    cold = int(c.get("serve.buckets_cold", 0))
+    warm_n = g.get("serve.warm_buckets")
+    tail = []
+    if warm_n is not None:
+        tail.append(f"warm buckets {int(warm_n)}")
+    if cold:
+        tail.append(f"cold bucket dispatches {cold}")
+    tail.append(f"compiles post-warm-up: "
+                f"{int(post_warm) if post_warm is not None else 0}"
+                + (" [VIOLATION — the serve-many contract broke]"
+                   if post_warm else ""))
+    lines.append(" | ".join(tail))
+    return "\n".join(lines)
+
+
 def render_retrace(counters: Dict[str, float]) -> Optional[str]:
     """The retrace-sanitizer digest line (armed runs only): compile events
     vs new shape buckets, with violations called out. Lives in the
@@ -368,6 +441,9 @@ def render_report(run: RunData) -> str:
     faults_sec = render_faults(run._counters)
     if faults_sec:
         out.append(faults_sec)
+    serving_sec = render_serving(run)
+    if serving_sec:
+        out.append(serving_sec)
     analysis_sec = render_analysis(run.analysis_rows)
     retrace_line = render_retrace(run._counters)
     if analysis_sec:
@@ -592,10 +668,19 @@ def _regress_eval(ledger_path: str, baseline_path: str,
     # bench baseline just because it is the newest numeric row
     current = None
     base_metric = baseline.get("metric") if baseline else None
+    base_is_serve = (baseline or {}).get("tool") == "serve" or (
+        isinstance(base_metric, str) and base_metric.startswith("serve "))
     if base_metric:
         current = led.latest_value_row(rows, metric=base_metric)
     if current is None:
-        current = led.latest_value_row(rows)
+        # metric-less fallback: serve rows (s/request under concurrency)
+        # are a different experiment from bench/run rows (s/scene) — a
+        # serve baseline only gates serve rows, everything else never
+        # gates a serve row just because load_gen ran last
+        pool = ([r for r in rows if r.get("tool") == "serve"]
+                if base_is_serve else rows)
+        current = led.latest_value_row(
+            pool, exclude_tools=() if base_is_serve else ("serve",))
         if current is not None and base_metric \
                 and current.get("metric") != base_metric:
             lines.append(f"WARNING: no ledger row matches baseline metric "

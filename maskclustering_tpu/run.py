@@ -27,7 +27,7 @@ import logging
 import os
 import time
 import traceback
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -666,11 +666,8 @@ def _dispatch_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
     return statuses
 
 
-def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
-                   workers: int = 1, resume: bool = True,
-                   journal: Optional[faults.RunJournal] = None
-                   ) -> List[SceneStatus]:
-    """Step 2: the fault-supervised scene work queue.
+class SceneSupervisor:
+    """The fault-supervised scene work queue, as a reusable seam.
 
     The scene is the fault boundary (the pipeline is embarrassingly
     scene-parallel): each executor pass captures per-scene failures, and
@@ -694,81 +691,145 @@ def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
       started";
     - stops cleanly at scene boundaries when a SIGTERM requested stop
       (remaining scenes journal as ``interrupted`` and re-run next time).
-    """
-    ladder = faults.DegradationLadder(cfg)
-    policy = faults.RetryPolicy(attempts=cfg.scene_retries + 1,
-                                base_s=cfg.retry_backoff_s,
-                                cap_s=max(cfg.retry_backoff_s * 8.0, 0.0))
-    statuses: Dict[str, SceneStatus] = {}
-    attempts: Dict[str, int] = {}
-    pending = list(seq_names)
-    if journal is not None and resume:
-        done = journal.resume_done()
-        for seq in pending:
-            if seq in done:
-                obs.count("run.journal_skips")
-                st = SceneStatus(seq, "skipped", attempts=0)
-                journal.outcome(seq, "skipped", attempt=0, rung=0)
-                statuses[seq] = st
-        if done:
-            log.info("journal resume: skipping %d already-done scene(s)",
-                     len([s for s in pending if s in done]))
-        pending = [s for s in pending if s not in done]
-    round_no = 1
-    while pending:
-        ctx = _FaultCtx(journal=journal, rung=ladder.rung, attempts=attempts)
-        batch = _dispatch_scenes(ladder.apply(cfg), pending, workers=workers,
-                                 resume=resume, ctx=ctx)
-        retry: List[str] = []
-        saw_device = False
-        for st in batch:
-            statuses[st.seq_name] = st
-            if st.status != "failed":
-                continue
-            saw_device = saw_device or st.error_class == "device"
-            # device-class failures keep retrying while the ladder still
-            # has rungs to drop: a deterministic device fault (e.g. a
-            # post-process capacity overflow) needs to reach the rung that
-            # heals it, and with a small scene_retries the budget would
-            # otherwise exhaust one rung short of host-postprocess. The
-            # extension is bounded by the ladder depth (<= 4 extra rounds)
-            in_budget = round_no <= cfg.scene_retries
-            ladder_can_help = (st.error_class == "device"
-                               and not ladder.exhausted)
-            if (st.error_class != "terminal"
-                    and (in_budget or ladder_can_help)
-                    and not faults.stop_requested()):
-                retry.append(st.seq_name)
-        if not retry:
-            break
-        if saw_device:
-            # the chip, not the scenes, looks sick: drop one rung before
-            # the retry round so the SAME fault class cannot burn the
-            # whole retry budget at full configuration
-            ladder.degrade(reason=f"device-class failure(s) in round {round_no}")
-            from maskclustering_tpu.analysis import retrace_sanitizer
 
-            if retrace_sanitizer.enabled():
-                # tag compile events with the rung: donation-off (and any
-                # future surface-adding rung) legitimately rebuilds its
-                # programs — under a new context those are enumerated
-                # surface (compile_surface_baseline.json "rungs"), not
-                # repeat-compile violations. The switch happens between
-                # executor rounds, when the scene queue is drained
-                retrace_sanitizer.set_context(
-                    "+".join(ladder.applied_names) or "baseline")
-        delay = policy.backoff(round_no)
-        obs.count("run.scene_retries", len(retry))
-        log.warning("retrying %d scene(s) in %.2fs (round %d/%d, rung %d%s)",
-                    len(retry), delay, round_no + 1, cfg.scene_retries + 1,
-                    ladder.rung,
-                    f": {'+'.join(ladder.applied_names)}"
-                    if ladder.applied_names else "")
-        if delay > 0:
-            time.sleep(delay)
-        pending = retry
-        round_no += 1
-    return [statuses[s] for s in seq_names if s in statuses]
+    Two callers share one copy of these semantics: the batch cluster step
+    (``cluster_scenes``, one supervisor per run) and the serving daemon's
+    worker (``serve/worker.py``, one supervisor PER REQUEST so a sick
+    request's ladder drop cannot poison its neighbors).
+
+    ``on_event`` observes supervisor decisions without changing them:
+    ``on_event("retry", scenes=[...], round=n, delay_s=d, rung=r)`` before
+    each retry round and ``on_event("degrade", rung=name, rung_index=i)``
+    on each ladder drop — the daemon streams these to the requesting
+    client as status events. ``should_continue`` is polled alongside
+    ``stop_requested()`` when deciding whether a failed scene may retry;
+    the daemon wires the per-request deadline here so an out-of-budget
+    request answers with its best-so-far failure instead of burning
+    retry rounds past its deadline.
+    """
+
+    def __init__(self, cfg: PipelineConfig, *, workers: int = 1,
+                 resume: bool = True,
+                 journal: Optional[faults.RunJournal] = None,
+                 on_event: Optional[Callable] = None,
+                 should_continue: Optional[Callable[[], bool]] = None):
+        self.cfg = cfg
+        self.workers = workers
+        self.resume = resume
+        self.journal = journal
+        self.on_event = on_event
+        self.should_continue = should_continue
+        self.ladder = faults.DegradationLadder(cfg)
+
+    def _notify(self, kind: str, **info) -> None:
+        if self.on_event is None:
+            return
+        try:
+            self.on_event(kind, **info)
+        except Exception:  # noqa: BLE001 — an observer must not sink the queue
+            log.exception("scene supervisor on_event(%r) observer failed", kind)
+
+    def _may_retry(self) -> bool:
+        if faults.stop_requested():
+            return False
+        if self.should_continue is not None and not self.should_continue():
+            return False
+        return True
+
+    def run(self, seq_names: Sequence[str]) -> List[SceneStatus]:
+        cfg, ladder, journal = self.cfg, self.ladder, self.journal
+        policy = faults.RetryPolicy(attempts=cfg.scene_retries + 1,
+                                    base_s=cfg.retry_backoff_s,
+                                    cap_s=max(cfg.retry_backoff_s * 8.0, 0.0))
+        statuses: Dict[str, SceneStatus] = {}
+        attempts: Dict[str, int] = {}
+        pending = list(seq_names)
+        if journal is not None and self.resume:
+            done = journal.resume_done()
+            for seq in pending:
+                if seq in done:
+                    obs.count("run.journal_skips")
+                    st = SceneStatus(seq, "skipped", attempts=0)
+                    journal.outcome(seq, "skipped", attempt=0, rung=0)
+                    statuses[seq] = st
+            if done:
+                log.info("journal resume: skipping %d already-done scene(s)",
+                         len([s for s in pending if s in done]))
+            pending = [s for s in pending if s not in done]
+        round_no = 1
+        while pending:
+            ctx = _FaultCtx(journal=journal, rung=ladder.rung,
+                            attempts=attempts)
+            batch = _dispatch_scenes(ladder.apply(cfg), pending,
+                                     workers=self.workers,
+                                     resume=self.resume, ctx=ctx)
+            retry: List[str] = []
+            saw_device = False
+            for st in batch:
+                statuses[st.seq_name] = st
+                if st.status != "failed":
+                    continue
+                saw_device = saw_device or st.error_class == "device"
+                # device-class failures keep retrying while the ladder
+                # still has rungs to drop: a deterministic device fault
+                # (e.g. a post-process capacity overflow) needs to reach
+                # the rung that heals it, and with a small scene_retries
+                # the budget would otherwise exhaust one rung short of
+                # host-postprocess. The extension is bounded by the
+                # ladder depth (<= 4 extra rounds)
+                in_budget = round_no <= cfg.scene_retries
+                ladder_can_help = (st.error_class == "device"
+                                   and not ladder.exhausted)
+                if (st.error_class != "terminal"
+                        and (in_budget or ladder_can_help)
+                        and self._may_retry()):
+                    retry.append(st.seq_name)
+            if not retry:
+                break
+            if saw_device:
+                # the chip, not the scenes, looks sick: drop one rung
+                # before the retry round so the SAME fault class cannot
+                # burn the whole retry budget at full configuration
+                rung_name = ladder.degrade(
+                    reason=f"device-class failure(s) in round {round_no}")
+                if rung_name:
+                    self._notify("degrade", rung=rung_name,
+                                 rung_index=ladder.rung)
+                from maskclustering_tpu.analysis import retrace_sanitizer
+
+                if retrace_sanitizer.enabled():
+                    # tag compile events with the rung: donation-off (and
+                    # any future surface-adding rung) legitimately rebuilds
+                    # its programs — under a new context those are
+                    # enumerated surface (compile_surface_baseline.json
+                    # "rungs"), not repeat-compile violations. The switch
+                    # happens between executor rounds, when the scene
+                    # queue is drained
+                    retrace_sanitizer.set_context(
+                        "+".join(ladder.applied_names) or "baseline")
+            delay = policy.backoff(round_no)
+            obs.count("run.scene_retries", len(retry))
+            self._notify("retry", scenes=list(retry), round=round_no + 1,
+                         delay_s=delay, rung=ladder.rung)
+            log.warning("retrying %d scene(s) in %.2fs (round %d/%d, rung %d%s)",
+                        len(retry), delay, round_no + 1, cfg.scene_retries + 1,
+                        ladder.rung,
+                        f": {'+'.join(ladder.applied_names)}"
+                        if ladder.applied_names else "")
+            if delay > 0:
+                time.sleep(delay)
+            pending = retry
+            round_no += 1
+        return [statuses[s] for s in seq_names if s in statuses]
+
+
+def cluster_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
+                   workers: int = 1, resume: bool = True,
+                   journal: Optional[faults.RunJournal] = None
+                   ) -> List[SceneStatus]:
+    """Step 2: one SceneSupervisor pass over the run's scene list."""
+    return SceneSupervisor(cfg, workers=workers, resume=resume,
+                           journal=journal).run(seq_names)
 
 
 _FAULT_COUNTERS = ("run.scene_retries", "run.device_stalls",

@@ -1,0 +1,36 @@
+"""mct-serve: the long-lived scene-serving daemon (L6 serving layer).
+
+The batch orchestrator (``run.py``) walks a scene list and exits,
+throwing away a warm compile cache that costs ~106 s to rebuild
+(BENCH_r03). This package keeps the process — and therefore every jit
+cache and the persistent XLA cache's deserialized executables — alive
+across requests:
+
+- ``protocol``  — line-delimited JSON request/response schema;
+- ``admission`` — bounded queue, typed rejects, per-request deadlines;
+- ``router``    — shape-bucket classification (one vocabulary with
+  ``utils/compile_cache.scene_bucket`` and the retrace census) and
+  serving-vocabulary warm-up from ``compile_surface_baseline.json``;
+- ``worker``    — the single device-owning thread driving
+  ``run.SceneSupervisor`` per request (per-request retry/degradation,
+  journal, obs spans, ``serve.*`` metrics);
+- ``daemon``    — socket front + lifecycle (SIGTERM drains in flight);
+- ``client``    — the one blocking client implementation every caller
+  (load_gen, CI smoke, tests) shares.
+
+Start one with ``python -m maskclustering_tpu.serve --config scannet
+--socket /tmp/mct.sock``; drive it with ``scripts/load_gen.py``.
+"""
+
+from maskclustering_tpu.serve.admission import AdmissionQueue, QueueFullReject
+from maskclustering_tpu.serve.client import ServeClient
+from maskclustering_tpu.serve.daemon import ServeDaemon
+from maskclustering_tpu.serve.protocol import (ProtocolError, SceneRequest,
+                                               parse_line)
+from maskclustering_tpu.serve.router import Router
+from maskclustering_tpu.serve.worker import ServeWorker
+
+__all__ = [
+    "AdmissionQueue", "QueueFullReject", "ServeClient", "ServeDaemon",
+    "ProtocolError", "SceneRequest", "parse_line", "Router", "ServeWorker",
+]

@@ -1,0 +1,194 @@
+"""CLI: ``python -m maskclustering_tpu.serve`` — start the daemon.
+
+Mirrors run.py's operational posture: backend init under a watchdog,
+SIGTERM -> cooperative drain (exit 143), obs events armed when a path is
+given, the retrace sanitizer as the serve-many contract's runtime gate
+(frozen after warm-up), and ONE machine-readable JSON digest line on
+stdout at shutdown — the load generator and the CI smoke gate read that
+line, everything else goes to stderr via logging.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from maskclustering_tpu.utils import faults
+
+log = logging.getLogger("maskclustering_tpu")
+
+
+def _parse_overrides(pairs) -> dict:
+    """``--set key=value`` pairs -> typed config overrides.
+
+    Coercion follows the PipelineConfig field's current type (bools accept
+    1/0/true/false); unknown keys fail loudly, same as load_config.
+    """
+    import dataclasses
+
+    from maskclustering_tpu.config import PipelineConfig
+
+    fields = {f.name: f for f in dataclasses.fields(PipelineConfig)}
+    out = {}
+    for pair in pairs:
+        key, sep, value = pair.partition("=")
+        if not sep or key not in fields:
+            raise SystemExit(f"--set {pair!r}: expected KEY=VALUE with a "
+                             f"PipelineConfig field as KEY")
+        default = getattr(PipelineConfig(), key)
+        if isinstance(default, bool):
+            out[key] = value.strip().lower() in ("1", "true", "on", "yes")
+        elif isinstance(default, int):
+            out[key] = int(value)
+        elif isinstance(default, float):
+            out[key] = float(value)
+        else:
+            out[key] = value
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="maskclustering_tpu.serve",
+        description="long-lived scene-serving daemon (JSONL over a local "
+                    "socket)")
+    parser.add_argument("--config", required=True,
+                        help="config name under configs/")
+    parser.add_argument("--socket", default=None,
+                        help="AF_UNIX socket path to serve on")
+    parser.add_argument("--host", default=None,
+                        help="TCP host to serve on instead of --socket "
+                             "(with --port; loopback serving only — there "
+                             "is no auth layer)")
+    parser.add_argument("--port", type=int, default=0,
+                        help="TCP port for --host (0 = ephemeral, printed "
+                             "on startup)")
+    parser.add_argument("--capacity", type=int, default=8,
+                        help="admission queue bound (typed queue_full "
+                             "reject beyond it)")
+    parser.add_argument("--deadline", type=float, default=0.0,
+                        help="default per-request deadline seconds "
+                             "(0 = none; requests may set their own)")
+    parser.add_argument("--journal-dir", default=None,
+                        help="per-request RunJournal directory "
+                             "(<dir>/<request id>.jsonl; default: "
+                             "<data_root>/serve_journals)")
+    parser.add_argument("--no-journal", action="store_true",
+                        help="disable per-request journals")
+    parser.add_argument("--warm", default=None,
+                        help="+-joined scene names to run end-to-end "
+                             "(exports included) before accepting requests")
+    parser.add_argument("--warm-baseline", default=None, nargs="?",
+                        const="compile_surface_baseline.json",
+                        help="pre-warm the serving vocabulary from this "
+                             "surface baseline's workload (flag alone: "
+                             "compile_surface_baseline.json)")
+    parser.add_argument("--no-freeze", action="store_true",
+                        help="do not freeze the retrace sanitizer after "
+                             "warm-up (armed runs only)")
+    parser.add_argument("--obs_events", default=None,
+                        help="obs span/metrics JSONL path (the Serving "
+                             "report section renders from it)")
+    parser.add_argument("--retrace-sanitizer", action="store_true",
+                        help="arm the compile-event sanitizer (default: "
+                             "$MCT_RETRACE_SANITIZER); the daemon freezes "
+                             "it after warm-up so every post-warm compile "
+                             "is a violation")
+    parser.add_argument("--fault-plan", default=None,
+                        help="deterministic fault injection spec "
+                             "(testing/drill knob — never in production)")
+    parser.add_argument("--set", action="append", default=[],
+                        metavar="KEY=VALUE", dest="overrides",
+                        help="override a config field (repeatable; value "
+                             "coerced to the field's type, e.g. "
+                             "--set step=1 --set mask_pad_multiple=32)")
+    parser.add_argument("--data_root", default=None,
+                        help="override the config's data root")
+    parser.add_argument("--prediction-root", default=None,
+                        help="artifact root (default: <data_root>/prediction)")
+    parser.add_argument("--init_timeout", type=float, default=120.0)
+    parser.add_argument("--debug", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        stream=sys.stderr,  # stdout carries exactly one digest line
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    if args.socket is None and args.host is None:
+        parser.error("need --socket PATH or --host HOST [--port N]")
+
+    from maskclustering_tpu.config import load_config
+
+    overrides = {"data_root": args.data_root} if args.data_root else {}
+    overrides.update(_parse_overrides(args.overrides))
+    cfg = load_config(args.config, **overrides)
+
+    from maskclustering_tpu.analysis import retrace_sanitizer
+
+    if args.retrace_sanitizer:
+        retrace_sanitizer.arm(True)
+    if retrace_sanitizer.enabled():
+        retrace_sanitizer.install()
+    if args.fault_plan:
+        faults.set_plan(faults.FaultPlan.from_spec(args.fault_plan))
+    faults.install_sigterm_handler()
+
+    if args.obs_events:
+        from maskclustering_tpu import obs
+
+        obs.configure(args.obs_events, truncate=True,
+                      meta={"tool": "serve", "config": cfg.config_name})
+
+    from maskclustering_tpu.run import init_backend_or_die
+
+    init_backend_or_die(args.init_timeout,
+                        platform="cpu" if cfg.backend == "cpu" else None)
+
+    journal_dir = None
+    if not args.no_journal:
+        journal_dir = args.journal_dir or os.path.join(cfg.data_root,
+                                                       "serve_journals")
+
+    from maskclustering_tpu.serve.daemon import ServeDaemon
+
+    daemon = ServeDaemon(
+        cfg,
+        socket_path=args.socket,
+        host=args.host, port=args.port,
+        capacity=args.capacity,
+        journal_dir=journal_dir,
+        prediction_root=args.prediction_root,
+        warm_scenes=tuple(s for s in (args.warm or "").split("+") if s),
+        warm_baseline=args.warm_baseline,
+        freeze_after_warm=not args.no_freeze,
+        default_deadline_s=args.deadline,
+    )
+    daemon.start()
+    if args.host is not None:
+        # the ephemeral port is only knowable now; clients parse this line
+        print(json.dumps({"kind": "listening",
+                          "address": list(daemon.address)}), flush=True)
+    try:
+        daemon.serve_forever()
+    finally:
+        daemon.shutdown()
+        from maskclustering_tpu import obs
+
+        if args.obs_events and obs.enabled():
+            daemon.emit_serve_counters()
+            if retrace_sanitizer.enabled():
+                retrace_sanitizer.emit_counters()
+            obs.flush_metrics()
+            obs.disable()
+        # the one stdout line: the daemon's final digest (load_gen / CI
+        # smoke parse it; bench.py keeps the same one-line contract)
+        print(json.dumps({"kind": "digest", **daemon.stats()},
+                         sort_keys=True), flush=True)
+    return 143 if faults.stop_requested() else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,125 @@
+"""mct-serve admission layer: a bounded queue with typed rejects.
+
+Admission is the daemon's backpressure contract: the queue holds at most
+``capacity`` requests, a full queue rejects IMMEDIATELY with a typed
+``queue_full`` (the client retries elsewhere/later instead of silently
+waiting on an unbounded backlog), and every admitted request carries its
+deadline so the worker can refuse to start work that can no longer
+finish in budget (``deadline`` reject at dequeue).
+
+Built on ``queue.Queue`` (internally locked; the handler threads submit,
+the single worker thread consumes) plus one small ``mct_lock``-named lock
+for the depth high-water bookkeeping — the ``serve.queue_depth`` gauge
+and ``serve.admission.*`` counters are the Serving report's source of
+truth.
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import List, Optional
+
+from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.serve.protocol import SceneRequest
+
+
+class QueueFullReject(Exception):
+    """Typed admission reject: the bounded queue is at capacity."""
+
+    def __init__(self, depth: int, capacity: int):
+        self.depth = depth
+        self.capacity = capacity
+        super().__init__(f"admission queue full ({depth}/{capacity})")
+
+
+def _count(name: str, delta: float = 1.0) -> None:
+    from maskclustering_tpu.obs import metrics
+
+    metrics.count(name, delta)
+
+
+def _gauge(name: str, value: float) -> None:
+    from maskclustering_tpu.obs import metrics
+
+    metrics.gauge(name, value)
+
+
+class AdmissionQueue:
+    """Bounded FIFO of admitted ``SceneRequest``s.
+
+    ``submit`` never blocks: a full queue raises ``QueueFullReject`` so
+    the caller (a connection handler thread) answers the client within
+    one lock acquisition. ``next`` is the worker's bounded-wait pop (the
+    timeout doubles as the worker's stop-flag poll interval).
+    """
+
+    def __init__(self, capacity: int = 8):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._q: "queue.Queue[SceneRequest]" = queue.Queue(maxsize=capacity)
+        self._lock = mct_lock("serve.AdmissionQueue._lock")
+        self._high_water = 0
+        self._admitted = 0
+
+    def submit(self, req: SceneRequest) -> int:
+        """Admit one request; returns the post-admission depth."""
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            _count("serve.admission.rejects.queue_full")
+            raise QueueFullReject(self._q.qsize(), self.capacity) from None
+        depth = self._q.qsize()
+        with self._lock:
+            self._admitted += 1
+            if depth > self._high_water:
+                self._high_water = depth
+        _count("serve.admission.admitted")
+        _gauge("serve.queue_depth", float(depth))
+        _gauge("serve.queue_depth_high_water", float(self._high_water))
+        return depth
+
+    def next(self, timeout_s: float = 0.25) -> Optional[SceneRequest]:
+        """The worker's pop: one request, or None after ``timeout_s``."""
+        try:
+            req = self._q.get(timeout=timeout_s)
+        except queue.Empty:
+            return None
+        _gauge("serve.queue_depth", float(self._q.qsize()))
+        return req
+
+    def requeue(self, req: SceneRequest) -> bool:
+        """Hand a popped-but-unserved request back (the worker's stop path:
+        it must not execute work the drain promised a typed reject for).
+        False when a racing submit refilled the slot — the caller then
+        answers the request itself."""
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            return False
+        _gauge("serve.queue_depth", float(self._q.qsize()))
+        return True
+
+    def drain(self) -> List[SceneRequest]:
+        """Everything still queued (shutdown: answer, don't run)."""
+        out: List[SceneRequest] = []
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        _gauge("serve.queue_depth", 0.0)
+        return out
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    @property
+    def high_water(self) -> int:
+        with self._lock:
+            return self._high_water
+
+    @property
+    def admitted(self) -> int:
+        with self._lock:
+            return self._admitted

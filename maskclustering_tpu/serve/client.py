@@ -1,0 +1,116 @@
+"""Minimal mct-serve client: one connection, blocking request/response.
+
+Stdlib-only (socket + json via serve/protocol): load_gen, the CI smoke
+gate and the tests all talk to the daemon through this one client, so the
+wire shapes have exactly one reader implementation. A ``ServeClient`` is
+single-threaded by design — concurrent load uses one client (one
+connection) per in-flight request, which keeps event demultiplexing out
+of the protocol entirely.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Dict, List, Optional, Tuple, Union
+
+from maskclustering_tpu.serve import protocol
+
+
+class ServeClientError(RuntimeError):
+    """The daemon closed the connection or sent something unreadable."""
+
+
+class ServeClient:
+    def __init__(self, address: Union[str, Tuple[str, int]],
+                 timeout_s: float = 120.0):
+        if isinstance(address, str):
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout_s)
+        self._sock.connect(address)
+        self._buf = b""
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- wire ---------------------------------------------------------------
+
+    def send(self, doc: Dict) -> None:
+        self._sock.sendall(protocol.encode(doc))
+
+    def recv_event(self) -> Dict:
+        """One response line (blocking up to the socket timeout)."""
+        while b"\n" not in self._buf:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ServeClientError("daemon closed the connection")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        try:
+            return json.loads(line.decode("utf-8", "replace"))
+        except ValueError as e:
+            raise ServeClientError(f"unreadable response line: {e}") from e
+
+    # -- requests -----------------------------------------------------------
+
+    def request_scene(self, scene: str, *, synthetic: Optional[Dict] = None,
+                      deadline_s: float = 0.0, resume: bool = False,
+                      tag: str = "") -> Dict:
+        """Submit one scene request; returns the ack or reject event."""
+        doc: Dict = {"op": "scene", "scene": scene}
+        if synthetic is not None:
+            doc["synthetic"] = synthetic
+        if deadline_s:
+            doc["deadline_s"] = deadline_s
+        if resume:
+            doc["resume"] = True
+        if tag:
+            doc["tag"] = tag
+        self.send(doc)
+        return self.recv_event()
+
+    def wait_result(self, *, collect: Optional[List[Dict]] = None) -> Dict:
+        """Read events until the terminal one (result or reject).
+
+        ``collect`` (optional) receives every intermediate status event.
+        """
+        while True:
+            ev = self.recv_event()
+            if ev.get("kind") in ("result", "reject"):
+                return ev
+            if collect is not None:
+                collect.append(ev)
+
+    def run_scene(self, scene: str, **kw) -> Tuple[Dict, List[Dict], float]:
+        """request + wait: (terminal event, status events, latency seconds)."""
+        t0 = time.monotonic()
+        first = self.request_scene(scene, **kw)
+        if first.get("kind") == "reject":
+            return first, [], time.monotonic() - t0
+        assert first.get("kind") == "ack", first
+        statuses: List[Dict] = []
+        terminal = self.wait_result(collect=statuses)
+        return terminal, statuses, time.monotonic() - t0
+
+    def stats(self) -> Dict:
+        self.send({"op": "status"})
+        while True:
+            ev = self.recv_event()
+            if ev.get("kind") == "stats":
+                return ev
+
+    def shutdown(self) -> Dict:
+        self.send({"op": "shutdown"})
+        return self.recv_event()

@@ -1,0 +1,405 @@
+"""mct-serve daemon: the long-lived scene-serving process.
+
+Lifecycle of one daemon::
+
+    start()            bind the socket, pre-warm the serving vocabulary
+                       (explicit warm scenes and/or the surface baseline's
+                       workload), optionally freeze the retrace sanitizer
+                       (a warm daemon books ZERO compiles per request),
+                       then start the worker + acceptor threads
+    serve_forever()    poll the stop flags (own + faults.stop_requested(),
+                       which the SIGTERM handler sets) at scene-safe
+                       granularity
+    shutdown()         stop admitting (new lines answer ``draining``),
+                       finish the request in flight, typed-reject the
+                       still-queued ones, join every thread bounded,
+                       close the socket
+
+Thread topology (all spawns bounded-joined at shutdown; the scope-local
+CONC.JOIN check cannot see the cross-method join, hence the abandon
+markers with that exact rationale):
+
+- **acceptor** — ``accept()`` with a poll timeout; spawns one handler per
+  connection;
+- **handler** (per connection) — reads JSONL lines, validates, admits
+  into the bounded queue, answers ``ack``/``reject`` inline; the
+  request's ``send`` stays bound to this connection (one lock per
+  connection serializes event lines);
+- **worker** (``serve/worker.py``) — the single device-owning executor.
+
+The daemon deliberately reuses the one-shot stack end to end — the same
+``setup_compilation_cache``, the same executors, the same artifact
+exports — so a served scene's npz is byte-identical to ``run.py``'s and a
+restarted daemon starts against the same persistent compile cache.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from maskclustering_tpu import obs
+from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.serve.admission import AdmissionQueue, QueueFullReject
+from maskclustering_tpu.serve.router import Router
+from maskclustering_tpu.serve.worker import ServeWorker
+from maskclustering_tpu.utils import faults
+
+log = logging.getLogger("maskclustering_tpu")
+
+DEFAULT_CAPACITY = 8
+
+
+def _make_sender(conn: socket.socket):
+    """A thread-safe one-line-per-event sender bound to one connection.
+
+    ``send.lock``/``send.raw`` exist for the admission handshake: the
+    handler holds the lock across queue-submit + ack (written via
+    ``raw``), so the worker — which can pick the request up the instant
+    it lands in the queue — cannot interleave a ``running`` status (or
+    even the result) BEFORE the ack the protocol promises first.
+    """
+    lock = mct_lock("serve.Connection._send_lock")
+
+    def raw(event: Dict) -> None:
+        conn.sendall(protocol.encode(event))
+
+    def send(event: Dict) -> None:
+        with lock:
+            raw(event)
+
+    send.lock = lock
+    send.raw = raw
+    return send
+
+
+class ServeDaemon:
+    """One serving process: admission + router + worker + socket front."""
+
+    def __init__(self, cfg, *,
+                 socket_path: Optional[str] = None,
+                 host: Optional[str] = None, port: int = 0,
+                 capacity: int = DEFAULT_CAPACITY,
+                 journal_dir: Optional[str] = None,
+                 prediction_root: Optional[str] = None,
+                 warm_scenes: Tuple[str, ...] = (),
+                 warm_baseline: Optional[str] = None,
+                 freeze_after_warm: bool = True,
+                 default_deadline_s: float = 0.0):
+        if socket_path is None and host is None:
+            raise ValueError("need a socket_path (AF_UNIX) or host/port (TCP)")
+        self.cfg = cfg
+        self.socket_path = socket_path
+        self.host = host
+        self.port = port
+        self.default_deadline_s = float(default_deadline_s)
+        self.freeze_after_warm = freeze_after_warm
+        self.warm_scenes = tuple(warm_scenes)
+        self.queue = AdmissionQueue(capacity)
+        self.router = Router(cfg, baseline_path=warm_baseline)
+        self.worker = ServeWorker(cfg, self.queue, self.router,
+                                  journal_dir=journal_dir,
+                                  prediction_root=prediction_root)
+        self._lock = mct_lock("serve.ServeDaemon._lock")
+        self._ids = 0
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        # connections outlive the stop flag: in-flight results and the
+        # queued requests' draining rejects must still reach their
+        # clients, so handler threads only exit once the drain is done
+        self._conns_stop = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self._acceptor: Optional[threading.Thread] = None
+        self._handlers: List[threading.Thread] = []
+        self._started_at = 0.0
+        self._warmup_s = 0.0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def address(self):
+        """The bound address: the socket path, or (host, port) for TCP."""
+        if self.socket_path is not None:
+            return self.socket_path
+        assert self._listener is not None, "start() first"
+        return self._listener.getsockname()
+
+    def start(self) -> None:
+        from maskclustering_tpu.utils.compile_cache import \
+            setup_compilation_cache
+
+        setup_compilation_cache(self.cfg.compilation_cache_dir)
+        self._started_at = time.monotonic()
+        self._bind()
+        self._prewarm()
+        self.worker.start()
+        self._acceptor = threading.Thread(  # mct-thread: abandon(daemon-lifetime thread, bounded-joined in shutdown(); the spawn/join pair spans methods, which the scope-local check cannot see)
+            target=self._accept_loop, daemon=True, name="serve-acceptor")
+        self._acceptor.start()
+        log.info("mct-serve: accepting on %s (capacity %d, %d warm "
+                 "bucket(s), warm-up %.1fs)", self.address,
+                 self.queue.capacity, len(self.router.warm_buckets()),
+                 self._warmup_s)
+
+    def _bind(self) -> None:
+        if self.socket_path is not None:
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+            os.makedirs(os.path.dirname(self.socket_path) or ".",
+                        exist_ok=True)
+            self._listener = socket.socket(socket.AF_UNIX,
+                                           socket.SOCK_STREAM)
+            self._listener.bind(self.socket_path)
+        else:
+            self._listener = socket.socket(socket.AF_INET,
+                                           socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEADDR, 1)
+            self._listener.bind((self.host, self.port))
+        self._listener.listen(16)
+        self._listener.settimeout(0.25)  # the acceptor's stop-poll cadence
+
+    def _prewarm(self) -> None:
+        """Pay the serving vocabulary's compiles before the first request.
+
+        An active FaultPlan (a serving-path drill) is suspended for the
+        duration: warm-up scenes often ARE the drill's target scenes, and
+        a plan consumed during warm-up would leave the serving path —
+        the thing the drill exists to exercise — fault-free.
+        """
+        t0 = time.monotonic()
+        drill = faults.active_plan()
+        faults.set_plan(None)
+        try:
+            for name, tensors in self.router.warmup_workload():
+                self.worker.warm_tensors(name, tensors)
+            if self.warm_scenes:
+                from maskclustering_tpu.run import cluster_scenes
+
+                statuses = cluster_scenes(self.cfg, list(self.warm_scenes),
+                                          resume=False)
+                for st in statuses:
+                    log.info("mct-serve: warm scene %s -> %s", st.seq_name,
+                             st.status)
+        finally:
+            faults.set_plan(drill)
+        self._warmup_s = time.monotonic() - t0
+        from maskclustering_tpu.analysis import retrace_sanitizer
+
+        if self.freeze_after_warm and retrace_sanitizer.enabled():
+            # the serve-many contract's runtime half: from here on, every
+            # compile is a post-warm violation (enumerated ladder-rung
+            # surface excepted) — "compiles post-warm-up" in the Serving
+            # report reads straight off this freeze
+            retrace_sanitizer.freeze()
+            log.info("mct-serve: retrace sanitizer frozen after warm-up")
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def stopping(self) -> bool:
+        return self._stop.is_set() or faults.stop_requested()
+
+    def serve_forever(self, poll_s: float = 0.2) -> None:
+        """Block until a stop is requested (own flag or SIGTERM), then
+        drain and shut down."""
+        while not self.stopping():
+            time.sleep(poll_s)
+        self.shutdown()
+
+    def shutdown(self, timeout_s: float = 60.0) -> None:
+        """SIGTERM-shaped drain: finish the in-flight request, typed-reject
+        the queued ones, join every thread bounded, close the socket."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        self._stop.set()
+        log.info("mct-serve: draining (in-flight request finishes, queued "
+                 "requests get typed rejects)")
+        drained_clean = self.worker.stop(timeout_s=timeout_s)
+        if not drained_clean:
+            log.error("mct-serve: in-flight request outlived the %.0fs "
+                      "drain budget; its journal has the in-flight attempt",
+                      timeout_s)
+        for req in self.queue.drain():
+            obs.count("serve.admission.rejects.draining")
+            try:
+                if req.send is not None:
+                    req.send(protocol.reject(
+                        "draining", req=req,
+                        detail="daemon shutting down before dispatch"))
+            except Exception:  # noqa: BLE001 — client gone mid-shutdown
+                pass
+        self._conns_stop.set()
+        if self._acceptor is not None:
+            self._acceptor.join(5.0)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            finally:
+                self._listener = None
+        if self.socket_path and os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+        with self._lock:
+            handlers = list(self._handlers)
+        for t in handlers:
+            t.join(2.0)
+        log.info("mct-serve: shutdown complete (%s)", self.stats()["counts"])
+
+    # -- socket front -------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        # polls the DAEMON's stop flag, not the process-global SIGTERM
+        # flag: only serve_forever()/shutdown() translate a SIGTERM into
+        # a daemon stop, so an embedding process (tests, a future
+        # multi-daemon host) can field signals without killing acceptors
+        while not self._stop.is_set():
+            listener = self._listener
+            if listener is None:
+                break
+            try:
+                conn, _addr = listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed under us: shutdown in progress
+            t = threading.Thread(  # mct-thread: abandon(per-connection reader, bounded-joined in shutdown(); clients may hold connections open for the daemon's lifetime)
+                target=self._handle_conn, args=(conn,), daemon=True,
+                name="serve-conn")
+            with self._lock:
+                self._handlers = [h for h in self._handlers
+                                  if h.is_alive()] + [t]
+            t.start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        send = _make_sender(conn)
+        buf = b""
+        conn.settimeout(0.5)
+        try:
+            while not self._conns_stop.is_set():
+                try:
+                    chunk = conn.recv(65536)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    try:
+                        self._handle_line(send,
+                                          line.decode("utf-8", "replace"))
+                    except OSError:
+                        # the client hung up before its answer (an aborted
+                        # probe, a dead load-gen thread): admitted work
+                        # still runs and journals; only this connection dies
+                        return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _next_id(self) -> str:
+        with self._lock:
+            self._ids += 1
+            return f"r-{self._ids:06d}"
+
+    def _handle_line(self, send, line: str) -> None:
+        if not line.strip():
+            return
+        tag = ""
+        try:
+            doc = protocol.parse_line(line)
+            tag = str(doc.get("tag", ""))
+            op = doc["op"]
+            if op == "status":
+                send({"v": protocol.PROTOCOL_VERSION, "kind": "stats",
+                      **self.stats()})
+                return
+            if op == "shutdown":
+                send({"v": protocol.PROTOCOL_VERSION, "kind": "ack",
+                      "op": "shutdown"})
+                self.request_stop()
+                return
+            if self._draining.is_set() or self._stop.is_set():
+                obs.count("serve.admission.rejects.draining")
+                send(protocol.reject("draining", tag=tag,
+                                     detail="daemon is shutting down"))
+                return
+            if doc.get("synthetic") is not None \
+                    and self.cfg.dataset != "scannet":
+                raise protocol.ProtocolError(
+                    "inline synthetic scenes need a scannet-layout config "
+                    f"(daemon dataset is {self.cfg.dataset!r})")
+            if doc.get("deadline_s", 0) == 0 and self.default_deadline_s > 0:
+                doc["deadline_s"] = self.default_deadline_s
+            req = protocol.build_request(doc, self._next_id())
+            req.send = send
+            # submit + ack under the connection's send lock: the worker's
+            # first event for this request serializes AFTER the ack
+            with send.lock:
+                depth = self.queue.submit(req)
+                send.raw(protocol.ack(req, queue_depth=depth))
+        except protocol.ProtocolError as e:
+            obs.count("serve.admission.rejects.bad_request")
+            send(protocol.reject("bad_request", detail=str(e), tag=tag))
+            return
+        except QueueFullReject as e:
+            send(protocol.reject(
+                "queue_full", tag=tag,
+                detail=f"{e.depth}/{e.capacity} queued; retry with backoff"))
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> Dict:
+        w = self.worker.stats()
+        from maskclustering_tpu.analysis import retrace_sanitizer
+
+        retrace: Dict = {}
+        if retrace_sanitizer.enabled():
+            d = retrace_sanitizer.digest()
+            retrace = {
+                "compiles": d["compiles"],
+                "post_freeze": sum(1 for v in d["violations"]
+                                   if v["kind"] == "post_freeze"),
+                "repeats": sum(1 for v in d["violations"]
+                               if v["kind"] == "repeat"),
+                "frozen": d["frozen"],
+            }
+        return {
+            "config": self.cfg.config_name,
+            "uptime_s": round(time.monotonic() - self._started_at, 2)
+            if self._started_at else 0.0,
+            "warmup_s": round(self._warmup_s, 2),
+            "queue": {"depth": self.queue.depth(),
+                      "capacity": self.queue.capacity,
+                      "high_water": self.queue.high_water,
+                      "admitted": self.queue.admitted},
+            "counts": w["counts"],
+            "latency": w["latency"],
+            "warm_buckets": [list(b) for b in w["warm_buckets"]],
+            "retrace": retrace,
+            "draining": self._draining.is_set(),
+        }
+
+    def emit_serve_counters(self) -> None:
+        """Book the serving digest on the obs registry (the report's
+        Serving section renders from these; call before flush/shutdown)."""
+        lat = self.worker.latency_quantiles()
+        if lat["p50_s"] is not None:
+            obs.gauge("serve.request_p50_s", lat["p50_s"])
+            obs.gauge("serve.request_p95_s", lat["p95_s"])
+        obs.gauge("serve.queue_depth_high_water",
+                  float(self.queue.high_water))
+        obs.gauge("serve.warm_buckets", float(len(self.router.warm_buckets())))

@@ -1,0 +1,181 @@
+"""mct-serve wire protocol: line-delimited JSON over a local socket.
+
+One request or response per ``\\n``-terminated line; every line is a JSON
+object carrying ``v`` (protocol version). The daemon answers a scene
+request with an immediate ``ack`` (the daemon-assigned request id), then
+streams ``status`` events (queued -> running, retry/degrade decisions)
+and exactly one terminal ``result`` — or a typed ``reject`` instead of
+the ack when admission refuses the work. Stdlib-only: clients need
+nothing from the rest of the tree.
+
+Request ops::
+
+    {"op": "scene", "scene": "scene0001_00",
+     "deadline_s": 30.0,          # optional per-request budget (0 = none)
+     "resume": false,             # optional: artifact/journal resume
+     "tag": "client-key"}         # optional: echoed on every event
+    {"op": "scene", "scene": "synth-a",
+     "synthetic": {"num_boxes": 3, "num_frames": 10,
+                   "image_hw": [60, 80], "spacing": 0.06, "seed": 40}}
+    {"op": "status"}              # daemon stats snapshot
+    {"op": "shutdown"}            # drain in-flight requests, then exit
+
+Responses (all carry ``id`` when bound to a request)::
+
+    {"kind": "ack", "id": "r-000001", "scene": ..., "queue_depth": 2}
+    {"kind": "reject", "reason": "queue_full" | "deadline" |
+                                 "bad_request" | "draining", ...}
+    {"kind": "status", "id": ..., "state": "running" | "retrying" |
+                                           "degraded", ...}
+    {"kind": "result", "id": ..., "status": "ok" | "failed" | "skipped" |
+                                            "deadline", ...}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import time
+from typing import Dict, Optional
+
+PROTOCOL_VERSION = 1
+
+OPS = ("scene", "status", "shutdown")
+REJECT_REASONS = ("queue_full", "deadline", "bad_request", "draining")
+RESULT_STATUSES = ("ok", "failed", "skipped", "deadline", "interrupted")
+
+# make_scene parameters an inline synthetic request may set; anything else
+# is a bad_request (the daemon must not forward arbitrary kwargs into the
+# generator)
+SYNTHETIC_PARAMS = frozenset({
+    "num_boxes", "num_frames", "image_hw", "spacing", "seed", "room_half",
+    "camera_radius", "camera_height", "floor_spacing",
+})
+
+
+class ProtocolError(ValueError):
+    """A request line the daemon cannot admit (reason: bad_request)."""
+
+
+@dataclasses.dataclass
+class SceneRequest:
+    """One admitted unit of work (daemon-internal; not the wire shape)."""
+
+    id: str
+    scene: str
+    synthetic: Optional[Dict] = None
+    deadline_s: float = 0.0
+    resume: bool = False
+    tag: str = ""
+    admitted_at: float = 0.0       # time.monotonic() at admission
+    deadline_at: float = math.inf  # monotonic deadline (inf = none)
+    send = None  # bound by the daemon: callable(event dict) -> None
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.deadline_at
+
+    def remaining_s(self) -> float:
+        return max(self.deadline_at - time.monotonic(), 0.0)
+
+
+def parse_line(line: str) -> Dict:
+    """One wire line -> validated request dict (raises ProtocolError)."""
+    line = line.strip()
+    if not line:
+        raise ProtocolError("empty request line")
+    try:
+        doc = json.loads(line)
+    except ValueError as e:
+        raise ProtocolError(f"not JSON: {e}") from e
+    if not isinstance(doc, dict):
+        raise ProtocolError("request must be a JSON object")
+    op = doc.get("op")
+    if op not in OPS:
+        raise ProtocolError(f"unknown op {op!r} (one of {OPS})")
+    if op == "scene":
+        scene = doc.get("scene")
+        if not isinstance(scene, str) or not scene:
+            raise ProtocolError("scene op needs a non-empty 'scene' name")
+        if os_sep_like(scene):
+            raise ProtocolError(f"scene name {scene!r} must not contain "
+                                "path separators")
+        syn = doc.get("synthetic")
+        if syn is not None:
+            if not isinstance(syn, dict):
+                raise ProtocolError("'synthetic' must be an object of "
+                                    "make_scene params")
+            unknown = set(syn) - SYNTHETIC_PARAMS
+            if unknown:
+                raise ProtocolError(
+                    f"unknown synthetic param(s) {sorted(unknown)} "
+                    f"(allowed: {sorted(SYNTHETIC_PARAMS)})")
+        deadline = doc.get("deadline_s", 0.0)
+        if not isinstance(deadline, (int, float)) or deadline < 0:
+            raise ProtocolError("'deadline_s' must be a number >= 0")
+        if not isinstance(doc.get("resume", False), bool):
+            raise ProtocolError("'resume' must be a boolean")
+    return doc
+
+
+def os_sep_like(name: str) -> bool:
+    return "/" in name or "\\" in name or name in (".", "..")
+
+
+def build_request(doc: Dict, request_id: str) -> SceneRequest:
+    """A validated ``scene`` op -> the daemon's work item."""
+    deadline = float(doc.get("deadline_s", 0.0) or 0.0)
+    now = time.monotonic()
+    return SceneRequest(
+        id=request_id,
+        scene=doc["scene"],
+        synthetic=doc.get("synthetic"),
+        deadline_s=deadline,
+        resume=bool(doc.get("resume", False)),
+        tag=str(doc.get("tag", "")),
+        admitted_at=now,
+        deadline_at=(now + deadline) if deadline > 0 else math.inf,
+    )
+
+
+# ---------------------------------------------------------------------------
+# response builders (the only shapes the daemon ever sends)
+# ---------------------------------------------------------------------------
+
+
+def _event(kind: str, req: Optional[SceneRequest] = None, **fields) -> Dict:
+    ev = {"v": PROTOCOL_VERSION, "kind": kind}
+    if req is not None:
+        ev["id"] = req.id
+        if req.tag:
+            ev["tag"] = req.tag
+    ev.update(fields)
+    return ev
+
+
+def ack(req: SceneRequest, *, queue_depth: int) -> Dict:
+    return _event("ack", req, scene=req.scene, queue_depth=queue_depth)
+
+
+def reject(reason: str, *, req: Optional[SceneRequest] = None,
+           detail: str = "", tag: str = "") -> Dict:
+    assert reason in REJECT_REASONS, reason
+    ev = _event("reject", req, reason=reason)
+    if detail:
+        ev["detail"] = detail
+    if tag and "tag" not in ev:
+        ev["tag"] = tag
+    return ev
+
+
+def status(req: SceneRequest, state: str, **fields) -> Dict:
+    return _event("status", req, state=state, **fields)
+
+
+def result(req: SceneRequest, status_: str, **fields) -> Dict:
+    assert status_ in RESULT_STATUSES, status_
+    return _event("result", req, status=status_, **fields)
+
+
+def encode(event: Dict) -> bytes:
+    return (json.dumps(event, sort_keys=True) + "\n").encode("utf-8")

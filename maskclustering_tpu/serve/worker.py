@@ -1,0 +1,361 @@
+"""mct-serve worker core: one device-owning thread serving the queue.
+
+The device is a single resource, so ONE worker thread drains the
+admission queue and drives the batch pipeline's own execution stack per
+request — ``run.SceneSupervisor`` (retry + degradation ladder, PR 5) over
+the PR-3 executors — with serving-specific wiring around it:
+
+- a **fresh supervisor per request**: the degradation ladder is
+  per-request state, so a sick request degrades ITSELF to the rung that
+  heals it while its neighbors keep the full configuration (and the
+  retrace-sanitizer ladder context is restored to baseline between
+  requests for the same reason);
+- **deadline enforcement**: a request whose deadline expired while queued
+  is answered with a typed ``deadline`` reject before any device work;
+  a live deadline becomes the phase watchdog budget (min'd with the
+  config's own ``watchdog_*_s``), so a stalled device phase raises
+  ``DeviceStallError`` within the remaining budget — the ladder degrades
+  and, while budget remains, the request retries; once the budget is
+  gone ``should_continue`` stops the retry loop and the request answers
+  ``deadline`` with its best-so-far attribution;
+- a **per-request RunJournal** (``journal_dir/<request id>.jsonl``,
+  rows stamped with the request id) so a daemon crash leaves per-request
+  attribution on disk, exactly like a one-shot run's journal;
+- **serve.* metrics + spans**: every request runs under a
+  ``serve.request`` span (the Serving report's p50/p95 source) and books
+  ``serve.requests_*`` counters; scene shape buckets newly compiled by a
+  request are reported on its result (``buckets_new`` — a warm daemon
+  answers 0) and fed to the router's warmth set.
+
+Synthetic requests materialize on disk (ScanNet layout under the
+daemon's data root) on first use and are ordinary disk scenes from then
+on — journals, artifact resume and byte-for-byte parity with one-shot
+``run.py`` all hold by construction.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional
+
+from maskclustering_tpu import obs
+from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.serve.admission import AdmissionQueue
+from maskclustering_tpu.serve.router import Router
+from maskclustering_tpu.utils import faults
+
+log = logging.getLogger("maskclustering_tpu")
+
+
+def _send(req: protocol.SceneRequest, event: Dict) -> None:
+    """Deliver one event to the request's client; never the failure source
+    (a disconnected client must not take the worker down)."""
+    if req.send is None:
+        return
+    try:
+        req.send(event)
+    except Exception:  # noqa: BLE001 — client gone; the journal still has it
+        log.warning("serve: could not deliver %s for request %s "
+                    "(client gone?)", event.get("kind"), req.id)
+
+
+def ensure_synthetic_scene(cfg, name: str, params: Dict) -> None:
+    """Materialize an inline-synthetic scene on disk (idempotent)."""
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    write_scannet_layout)
+
+    processed = os.path.join(cfg.data_root, "scannet", "processed", name)
+    if os.path.isdir(os.path.join(processed, "color")):
+        return
+    kw = dict(params)
+    if "image_hw" in kw:
+        kw["image_hw"] = tuple(kw["image_hw"])
+    with obs.span("serve.materialize", scene=name):
+        write_scannet_layout(make_scene(**kw), cfg.data_root, name)
+
+
+def _scene_buckets() -> set:
+    """The compile-cache's scene-kind shape buckets seen so far."""
+    from maskclustering_tpu.utils.compile_cache import seen_scene_buckets
+
+    return seen_scene_buckets()
+
+
+class ServeWorker:
+    """The daemon's single execution thread (start/stop bounded)."""
+
+    def __init__(self, cfg, queue: AdmissionQueue, router: Router, *,
+                 journal_dir: Optional[str] = None,
+                 prediction_root: Optional[str] = None,
+                 poll_s: float = 0.25):
+        self.cfg = cfg
+        self.queue = queue
+        self.router = router
+        self.journal_dir = journal_dir
+        self.prediction_root = (prediction_root
+                                or os.path.join(cfg.data_root, "prediction"))
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._idle = threading.Event()  # set whenever no request is running
+        self._idle.set()
+        self._lock = mct_lock("serve.ServeWorker._lock")
+        self._thread: Optional[threading.Thread] = None
+        # bounded window (worker-thread appends only): a daemon that
+        # serves for days must not grow per-request state without bound,
+        # and stats() re-sorts the window per call — O(window), not
+        # O(requests ever)
+        self._latencies: Deque[float] = deque(maxlen=4096)
+        self._counts = {"requests": 0, "ok": 0, "failed": 0, "deadline": 0,
+                        "skipped": 0, "interrupted": 0}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(  # mct-thread: abandon(daemon-lifetime thread, bounded-joined in stop(); the spawn/join pair spans methods, which the scope-local check cannot see)
+            target=self._run, daemon=True, name="serve-worker")
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 60.0) -> bool:
+        """Request stop and wait (bounded) for the in-flight request.
+
+        The worker finishes the request it is currently executing — the
+        SIGTERM drain contract — and exits; requests still queued are the
+        daemon's to answer with ``draining`` rejects. Returns False when
+        the in-flight request outlived the timeout (the daemon then exits
+        anyway; the thread is a daemon thread and the per-request journal
+        has the in-flight attempt on disk).
+        """
+        self._stop.set()
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout_s)
+        return not t.is_alive()
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block (bounded) until no request is executing AND the queue is
+        empty — the warm-up/test synchronization point."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.queue.depth() == 0 and self._idle.is_set():
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- the thread main ----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            req = self.queue.next(timeout_s=self.poll_s)
+            if req is None:
+                continue
+            if self._stop.is_set():
+                # stop landed while we were blocked in the pop: this
+                # request was promised a draining reject, not execution —
+                # hand it back for the daemon's drain (or answer the
+                # reject ourselves if a racing submit refilled the slot)
+                if not self.queue.requeue(req):
+                    obs.count("serve.admission.rejects.draining")
+                    _send(req, protocol.reject(
+                        "draining", req=req,
+                        detail="daemon shutting down before dispatch"))
+                break
+            self._idle.clear()
+            try:
+                self._serve_one(req)
+            except Exception:  # noqa: BLE001 — one request, not the daemon
+                log.exception("serve: request %s crashed the worker loop",
+                              req.id)
+                _send(req, protocol.result(req, "failed",
+                                           error="internal worker error",
+                                           error_class="terminal"))
+            finally:
+                self._idle.set()
+
+    # -- per-request execution ---------------------------------------------
+
+    def _deadline_cfg(self, req: protocol.SceneRequest):
+        """The request's config: deadline folded into the phase watchdogs."""
+        if math.isinf(req.deadline_at):
+            return self.cfg
+        remaining = req.remaining_s()
+        overrides = {}
+        for field in ("watchdog_load_s", "watchdog_device_s",
+                      "watchdog_host_s"):
+            cur = getattr(self.cfg, field)
+            overrides[field] = min(cur, remaining) if cur > 0 else remaining
+        return self.cfg.replace(**overrides)
+
+    def _journal(self, req: protocol.SceneRequest):
+        if not self.journal_dir:
+            return None
+        os.makedirs(self.journal_dir, exist_ok=True)
+        path = os.path.join(self.journal_dir, f"{req.id}.jsonl")
+        return faults.RunJournal(path, self.cfg.config_name,
+                                 request_id=req.id)
+
+    def _serve_one(self, req: protocol.SceneRequest) -> None:
+        from maskclustering_tpu.run import SceneSupervisor
+
+        obs.count("serve.requests")
+        with self._lock:
+            self._counts["requests"] += 1
+        if req.expired():
+            # admitted in time, dequeued too late: a typed answer beats
+            # burning device time on a result nobody is waiting for
+            obs.count("serve.rejects.deadline")
+            with self._lock:
+                self._counts["deadline"] += 1
+            _send(req, protocol.reject(
+                "deadline", req=req,
+                detail=f"deadline_s={req.deadline_s:g} expired after "
+                       f"{time.monotonic() - req.admitted_at:.2f}s in queue"))
+            return
+
+        t0 = time.monotonic()
+        bucket = None
+        if req.synthetic is not None:
+            try:
+                ensure_synthetic_scene(self.cfg, req.scene, req.synthetic)
+                bucket = self.router.bucket_for(req.scene)
+                if bucket is None:
+                    # first sight of this scene: generate once to
+                    # classify, then the router remembers — repeats must
+                    # not pay a host-side scene regeneration per request
+                    from maskclustering_tpu.utils.synthetic import (
+                        make_scene, to_scene_tensors)
+
+                    kw = dict(req.synthetic)
+                    if "image_hw" in kw:
+                        kw["image_hw"] = tuple(kw["image_hw"])
+                    bucket = self.router.classify_tensors(
+                        to_scene_tensors(make_scene(**kw)))
+                    self.router.remember(req.scene, bucket)
+            except Exception as e:  # noqa: BLE001 — answer, don't crash
+                log.exception("serve: synthetic materialization failed "
+                              "for %s", req.id)
+                obs.count("serve.requests_failed")
+                with self._lock:
+                    self._counts["failed"] += 1
+                _send(req, protocol.result(
+                    req, "failed", error=f"synthetic materialization: {e}",
+                    error_class=faults.classify_error(e)))
+                return
+        _send(req, protocol.status(
+            req, "running", scene=req.scene,
+            **({"bucket": list(bucket),
+                "warm": self.router.is_warm(bucket)}
+               if bucket is not None else {})))
+
+        def on_event(kind: str, **info) -> None:
+            state = {"retry": "retrying", "degrade": "degraded"}.get(kind)
+            if state:
+                _send(req, protocol.status(req, state, **info))
+
+        journal = self._journal(req)
+        buckets_before = _scene_buckets()
+        try:
+            supervisor = SceneSupervisor(
+                self._deadline_cfg(req), resume=req.resume, journal=journal,
+                on_event=on_event,
+                should_continue=lambda: not req.expired())
+            if journal is not None:
+                journal.begin_run()
+            with obs.span("serve.request", request=req.id, scene=req.scene):
+                statuses = supervisor.run([req.scene])
+        finally:
+            if journal is not None:
+                journal.end_run(interrupted=faults.stop_requested())
+                journal.close()
+            from maskclustering_tpu.analysis import retrace_sanitizer
+
+            if retrace_sanitizer.enabled():
+                # the ladder context is per-request: restore baseline so a
+                # degraded request cannot mislabel its neighbors' compiles
+                retrace_sanitizer.set_context("baseline")
+        new_buckets = _scene_buckets() - buckets_before
+        for b in new_buckets:
+            self.router.note_served(b)
+        if bucket is not None:
+            self.router.note_served(bucket)
+        latency = time.monotonic() - t0
+        self._latencies.append(latency)
+
+        st = statuses[0] if statuses else None
+        if st is None:
+            status_ = "failed"
+            fields: Dict = {"error": "supervisor returned no status",
+                            "error_class": "terminal"}
+        else:
+            status_ = st.status
+            if st.status == "failed" and req.expired():
+                status_ = "deadline"
+            fields = {"scene_seconds": round(st.seconds, 4),
+                      "attempts": st.attempts, "rung": st.degradation_rung,
+                      "num_objects": st.num_objects}
+            if st.error:
+                fields["error"] = str(st.error).strip().splitlines()[-1][:200]
+                fields["error_class"] = st.error_class
+        obs.count(f"serve.requests_{status_}")
+        with self._lock:
+            self._counts[status_] = self._counts.get(status_, 0) + 1
+        if new_buckets:
+            obs.count("serve.buckets_cold", len(new_buckets))
+        _send(req, protocol.result(
+            req, status_, seconds=round(latency, 4),
+            buckets_new=len(new_buckets),
+            **({"bucket": list(bucket)} if bucket is not None else {}),
+            **fields))
+
+    # -- warm-up ------------------------------------------------------------
+
+    def warm_tensors(self, name: str, tensors) -> bool:
+        """Run one warm-up scene through the serving path (no export).
+
+        Best-effort: a failed warm-up logs and returns False — the daemon
+        still serves, it just pays that bucket's compiles on the first
+        real request.
+        """
+        from maskclustering_tpu.models.pipeline import (run_scene_device,
+                                                        run_scene_host)
+
+        bucket = self.router.classify_tensors(tensors)
+        try:
+            with obs.span("serve.warmup", scene=name):
+                handoff = run_scene_device(tensors, self.cfg, seq_name=name)
+                run_scene_host(handoff, self.cfg, export=False)
+        except Exception:  # noqa: BLE001 — warm-up must not kill startup
+            log.exception("serve: warm-up scene %s (bucket %s) failed",
+                          name, bucket)
+            return False
+        self.router.note_served(bucket)
+        obs.count("serve.warmup_scenes")
+        return True
+
+    # -- introspection ------------------------------------------------------
+
+    def latency_quantiles(self) -> Dict[str, Optional[float]]:
+        from maskclustering_tpu.obs.report import percentile
+
+        vals = sorted(self._latencies)
+        if not vals:
+            return {"p50_s": None, "p95_s": None, "count": 0}
+        return {"p50_s": round(percentile(vals, 50), 4),
+                "p95_s": round(percentile(vals, 95), 4),
+                "count": len(vals)}
+
+    def stats(self) -> Dict:
+        with self._lock:
+            counts = dict(self._counts)
+        out = {"counts": counts,
+               "latency": self.latency_quantiles(),
+               "warm_buckets": sorted(self.router.warm_buckets())}
+        return out

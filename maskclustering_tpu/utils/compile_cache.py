@@ -98,6 +98,21 @@ def scene_bucket(cfg, frames: int, points: int, max_id: int) -> Tuple[int, int, 
     return (bucket_k_max(max_id), *scene_pads(cfg, frames, points))
 
 
+def max_seg_id(segmentations) -> int:
+    """Largest mask id in a scene's id-maps (0 for an empty stack) — the
+    third ``scene_bucket`` coordinate, shared by the pipeline's k_max
+    derivation and the serving router's classification."""
+    import numpy as np
+
+    return int(np.max(segmentations)) if np.size(segmentations) else 0
+
+
+def scene_bucket_of(cfg, tensors) -> Tuple[int, int, int]:
+    """``scene_bucket`` read off a SceneTensors (datasets/base.py)."""
+    return scene_bucket(cfg, tensors.num_frames, tensors.num_points,
+                        max_seg_id(tensors.segmentations))
+
+
 def record_shape_bucket(kind: str, *bucket) -> bool:
     """Record a jit shape bucket; returns True (and logs) if new.
 
@@ -126,6 +141,13 @@ def record_shape_bucket(kind: str, *bucket) -> bool:
 
 def seen_shape_buckets() -> Set[Tuple]:
     return set(_SEEN_BUCKETS)
+
+
+def seen_scene_buckets() -> Set[Tuple]:
+    """Just the scene-kind (k_max, f_pad, n_pad) buckets — the serving
+    vocabulary this process has compiled against (serve/worker.py diffs
+    it per request to report cold dispatches)."""
+    return {key[1:] for key in _SEEN_BUCKETS if key[0] == "scene"}
 
 
 def reset_shape_buckets() -> None:

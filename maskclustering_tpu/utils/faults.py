@@ -624,30 +624,41 @@ class RunJournal:
     skipped on resume, an ``attempt`` with no outcome was in flight and
     re-runs, scenes never journaled never started. Rows carry the config
     name — one journal file can serve several configs without cross-talk.
+    ``request_id`` (the serving daemon's per-request attribution) stamps
+    every row when given, so one journal path can carry many requests
+    without clobbering — ``read_journal``/``replay_journal``/``resume_done``
+    filter on it, and a request-free reader still round-trips the rows.
     Writes go through the obs EventSink (thread-safe, flush per line,
     never the failure source).
     """
 
-    def __init__(self, path: str, config_name: str):
+    def __init__(self, path: str, config_name: str,
+                 request_id: Optional[str] = None):
         from maskclustering_tpu.obs.events import EventSink
 
         self.path = path
         self.config_name = config_name
+        self.request_id = request_id
         self._sink = EventSink(path)
 
+    def _stamp(self, payload: Dict) -> Dict:
+        if self.request_id is not None:
+            payload["request"] = self.request_id
+        return payload
+
     def begin_run(self) -> None:
-        self._sink.emit(KIND_RUN, {"event": "begin",
-                                   "config": self.config_name})
+        self._sink.emit(KIND_RUN, self._stamp({"event": "begin",
+                                               "config": self.config_name}))
 
     def end_run(self, *, interrupted: bool = False) -> None:
-        self._sink.emit(KIND_RUN, {"event": "end",
-                                   "config": self.config_name,
-                                   "interrupted": bool(interrupted)})
+        self._sink.emit(KIND_RUN, self._stamp({
+            "event": "end", "config": self.config_name,
+            "interrupted": bool(interrupted)}))
 
     def attempt(self, seq: str, attempt: int, rung: int) -> None:
-        self._sink.emit(KIND_SCENE, {"event": "attempt", "seq": seq,
-                                     "attempt": attempt, "rung": rung,
-                                     "config": self.config_name})
+        self._sink.emit(KIND_SCENE, self._stamp({
+            "event": "attempt", "seq": seq, "attempt": attempt,
+            "rung": rung, "config": self.config_name}))
 
     def outcome(self, seq: str, status: str, *, attempt: int = 0,
                 rung: int = 0, error_class: str = "", error: str = "",
@@ -662,19 +673,21 @@ class RunJournal:
             # final line only ("ExceptionType: message" in a formatted
             # traceback): the journal is attribution, not a stack dump
             payload["error"] = str(error).strip().splitlines()[-1][:200]
-        self._sink.emit(KIND_SCENE, payload)
+        self._sink.emit(KIND_SCENE, self._stamp(payload))
 
     def resume_done(self) -> Set[str]:
-        return resume_done(self.path, config=self.config_name)
+        return resume_done(self.path, config=self.config_name,
+                           request=self.request_id)
 
     def close(self) -> None:
         self._sink.close()
 
 
-def read_journal(path: str, *, config: Optional[str] = None, stats=None
-                 ) -> List[Dict]:
+def read_journal(path: str, *, config: Optional[str] = None,
+                 request: Optional[str] = None, stats=None) -> List[Dict]:
     """All journal rows (oldest first), sharing the events torn-line
-    policy; ``config`` filters to one config's rows."""
+    policy; ``config`` filters to one config's rows, ``request`` to one
+    serving request's (rows without a request stamp only match ``None``)."""
     from maskclustering_tpu.obs.events import SCHEMA_VERSION, iter_jsonl_rows
 
     rows = []
@@ -683,11 +696,14 @@ def read_journal(path: str, *, config: Optional[str] = None, stats=None
             continue
         if config is not None and row.get("config") != config:
             continue
+        if request is not None and row.get("request") != request:
+            continue
         rows.append(row)
     return rows
 
 
-def replay_journal(path: str, *, config: Optional[str] = None, stats=None
+def replay_journal(path: str, *, config: Optional[str] = None,
+                   request: Optional[str] = None, stats=None
                    ) -> Dict[str, Dict]:
     """Final per-scene state from the journal alone.
 
@@ -699,7 +715,8 @@ def replay_journal(path: str, *, config: Optional[str] = None, stats=None
     scene was running when the process died and must re-run.
     """
     out: Dict[str, Dict] = {}
-    for row in read_journal(path, config=config, stats=stats):
+    for row in read_journal(path, config=config, request=request,
+                            stats=stats):
         if row.get("kind") != KIND_SCENE:
             continue
         seq = row.get("seq")
@@ -720,11 +737,13 @@ def replay_journal(path: str, *, config: Optional[str] = None, stats=None
     return out
 
 
-def resume_done(path: str, *, config: Optional[str] = None) -> Set[str]:
+def resume_done(path: str, *, config: Optional[str] = None,
+                request: Optional[str] = None) -> Set[str]:
     """Scenes whose journal says they need no re-run: final status ``ok``
     (exported) or ``skipped`` (a previous resume already vouched). Failed,
     interrupted and in-flight scenes all re-run."""
     if not os.path.exists(path):
         return set()
-    return {seq for seq, st in replay_journal(path, config=config).items()
+    return {seq for seq, st in replay_journal(path, config=config,
+                                              request=request).items()
             if st["status"] in ("ok", "skipped")}

@@ -41,14 +41,24 @@
 #    `python -m maskclustering_tpu.obs.report --regress` (exit 2 on a >15%
 #    regression — override the threshold with MCT_REGRESS_THRESHOLD).
 #
+# 3d. runs the serve daemon smoke (distinct exit code 7): spawns a
+#    retrace-sanitizer-armed mct-serve daemon subprocess, warms two tiny
+#    shape buckets, fires a small mixed-bucket burst through
+#    scripts/load_gen.py, SIGTERMs it, and asserts a clean drain (exit
+#    143, final digest line) with ZERO post-warm compiles — the
+#    compile-once/serve-many contract, end to end (MCT_SERVE_SMOKE=0
+#    skips). FATAL. The full concurrent soak is slow-marked in
+#    tests/test_serve.py.
+#
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
 # Exits non-zero on test failures (1), a fault-matrix failure (3), an
 # mct-check finding or ruff violation (4), a concurrency-family finding
-# (5), a retrace-family finding (6), or a perf regression (2), so it
-# gates correctness, fault tolerance, the invariants, thread safety, the
-# compile surface AND the trajectory.
+# (5), a retrace-family finding (6), a serve-smoke failure (7), or a
+# perf regression (2), so it gates correctness, fault tolerance, the
+# invariants, thread safety, the compile surface, the serving layer AND
+# the trajectory.
 # Every gate still RUNS after a failure, but the exit code is the FIRST
 # failing gate's — triage by exit code points at the right gate.
 set -u -o pipefail
@@ -110,6 +120,22 @@ if [ "${MCT_CHECK:-1}" != "0" ]; then
              "or audit the census diff and regenerate" \
              "compile_surface_baseline.json with --write-surface)" >&2
         fail 6
+    fi
+fi
+
+if [ "${MCT_SERVE_SMOKE:-1}" != "0" ]; then
+    echo "== ci: serve daemon smoke (spawn daemon + load_gen burst, SIGTERM drain, <300s) =="
+    # bounded end-to-end gate on the serving layer: a sanitizer-armed
+    # daemon warms two tiny buckets, serves a mixed-bucket burst through
+    # scripts/load_gen.py, and must drain SIGTERM-clean with ZERO
+    # post-warm compiles (the serve-many contract) — the full soak lives
+    # slow-marked in tests/test_serve.py
+    if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+            python scripts/load_gen.py --smoke --requests 6 \
+            --concurrency 3 --no-ledger; then
+        echo "ci: serve daemon smoke FAILED (daemon wedged, a request" \
+             "failed, or the retrace sanitizer booked post-warm compiles)" >&2
+        fail 7
     fi
 fi
 

@@ -1,0 +1,357 @@
+#!/usr/bin/env python
+"""Load generator for the mct-serve daemon (+ the CI smoke gate).
+
+Drives N concurrent synthetic scene requests — mixed shape buckets by
+default, so the daemon's routing/warmth story is exercised, not just one
+executable — against a running daemon, and prints ONE machine-readable
+JSON verdict line on stdout (human progress goes to stderr):
+
+    {"metric": "serve s/request (p50 of N synthetic requests)",
+     "value": 1.92, "p95_s": 2.4, "throughput_rps": 1.4, "requests": 8,
+     "concurrency": 4, "rejects": {"queue_full": 1}, ...}
+
+and appends a ``serve`` row to the perf ledger (obs/ledger.serve_row;
+``--no-ledger`` to skip) — the serving trajectory next to the bench one,
+fenced by metric/tool so ``--regress`` never cross-gates them.
+
+Modes::
+
+    # against a running daemon (see README "Running the daemon"):
+    python scripts/load_gen.py --socket /tmp/mct.sock --requests 16 \
+        --concurrency 8
+
+    # the CI smoke gate: self-contained — materializes two tiny warm
+    # scenes, spawns a sanitizer-armed daemon subprocess, serves a small
+    # mixed-bucket burst, SIGTERMs it, and asserts clean shutdown + ZERO
+    # post-warm compiles (exit 0 pass / 1 fail):
+    python scripts/load_gen.py --smoke [--fault-plan "flaky:lg-b:1"]
+
+Requests repeat over the bucket scene set with ``resume=false`` so every
+request executes (artifact resume would turn repeats into no-ops and the
+throughput number into fiction).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# the two tiny shape buckets the tier-1 suite keeps warm (test_executor /
+# test_retrace use byte-identical scenes): bucket A and the denser B land
+# on distinct (k_max, f_pad, n_pad) keys under the smoke config below
+BUCKET_SPECS: Tuple[Tuple[str, Dict], ...] = (
+    ("lg-a", {"num_boxes": 3, "num_frames": 10, "image_hw": [60, 80],
+              "spacing": 0.06, "seed": 40}),
+    ("lg-b", {"num_boxes": 4, "num_frames": 10, "image_hw": [60, 80],
+              "spacing": 0.05, "seed": 50}),
+)
+SMOKE_CONFIG_SETS = ("step=1", "distance_threshold=0.05",
+                     "mask_pad_multiple=32", "backend=cpu")
+
+
+def log(msg: str) -> None:
+    print(f"load_gen: {msg}", file=sys.stderr, flush=True)
+
+
+def _address(args) -> object:
+    if args.socket:
+        return args.socket
+    return (args.host, args.port)
+
+
+def run_load(address, *, requests: int, concurrency: int, buckets: int,
+             deadline_s: float, resume: bool) -> Dict:
+    """Fire the burst; returns the aggregate verdict fields."""
+    from maskclustering_tpu.serve.client import ServeClient
+
+    specs = list(BUCKET_SPECS[:max(1, min(buckets, len(BUCKET_SPECS)))])
+    work: "queue.Queue[Tuple[int, str, Dict]]" = queue.Queue()
+    for i in range(requests):
+        name, params = specs[i % len(specs)]
+        work.put((i, name, params))
+    results: List[Dict] = []
+    latencies: List[float] = []
+    rejects: Dict[str, int] = {}
+    lock = threading.Lock()
+
+    def client_loop() -> None:
+        with ServeClient(address, timeout_s=600.0) as client:
+            while True:
+                try:
+                    i, name, params = work.get_nowait()
+                except queue.Empty:
+                    return
+                attempts = 0
+                while True:
+                    terminal, _statuses, latency = client.run_scene(
+                        name, synthetic=params, deadline_s=deadline_s,
+                        resume=resume, tag=f"lg-{i:04d}")
+                    if terminal.get("kind") == "reject" \
+                            and terminal.get("reason") == "queue_full" \
+                            and attempts < 10:
+                        # backpressure is the CONTRACT: count it, back off,
+                        # resubmit — a full queue is not a failed request
+                        attempts += 1
+                        with lock:
+                            rejects["queue_full"] = \
+                                rejects.get("queue_full", 0) + 1
+                        time.sleep(0.2 * attempts)
+                        continue
+                    break
+                with lock:
+                    if terminal.get("kind") == "reject":
+                        rejects[terminal.get("reason", "?")] = \
+                            rejects.get(terminal.get("reason", "?"), 0) + 1
+                    else:
+                        results.append(terminal)
+                        if terminal.get("status") == "ok":
+                            latencies.append(latency)
+
+    t0 = time.monotonic()
+    threads = []
+    for i in range(max(1, concurrency)):
+        t = threading.Thread(target=client_loop, daemon=True,
+                             name=f"load-gen-{i}")
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(900.0)
+    wall = time.monotonic() - t0
+
+    from maskclustering_tpu.obs.report import percentile
+
+    ok = [r for r in results if r.get("status") == "ok"]
+    failed = [r for r in results if r.get("status") not in ("ok", "skipped")]
+    vals = sorted(latencies)
+
+    def pct(q: float) -> Optional[float]:
+        return round(percentile(vals, q), 4) if vals else None
+
+    return {
+        "metric": f"serve s/request (p50 of {requests} synthetic requests)",
+        "value": pct(50),
+        "unit": "s/request",
+        "p95_s": pct(95),
+        "throughput_rps": round(len(ok) / wall, 3) if wall > 0 else None,
+        "wall_s": round(wall, 2),
+        "requests": requests,
+        "concurrency": concurrency,
+        "buckets": len(specs),
+        "ok": len(ok),
+        "failed": len(failed),
+        "rejects": rejects or None,
+        "max_attempts": max((r.get("attempts", 1) for r in results),
+                            default=0),
+        "max_rung": max((r.get("rung", 0) for r in results), default=0),
+    }
+
+
+def append_ledger_row(verdict: Dict, path: Optional[str]) -> None:
+    from maskclustering_tpu.obs import ledger as led
+
+    row = led.serve_row(verdict)
+    led.append_row(path or led.default_ledger_path(), row)
+
+
+# ---------------------------------------------------------------------------
+# the CI smoke gate: daemon subprocess + a bounded mixed-bucket burst
+# ---------------------------------------------------------------------------
+
+
+def _wait_for_socket(path: str, proc: subprocess.Popen,
+                     timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return False
+        if os.path.exists(path):
+            try:
+                from maskclustering_tpu.serve.client import ServeClient
+
+                with ServeClient(path, timeout_s=5.0) as c:
+                    c.stats()
+                return True
+            except OSError:
+                pass
+        time.sleep(0.25)
+    return False
+
+
+def run_smoke(args) -> int:
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    write_scannet_layout)
+
+    tmp = tempfile.mkdtemp(prefix="mct_serve_smoke_")
+    sock = os.path.join(tmp, "mct.sock")
+    events = os.path.join(tmp, "serve_events.jsonl")
+    warm_names = []
+    for name, params in BUCKET_SPECS:
+        kw = dict(params)
+        kw["image_hw"] = tuple(kw["image_hw"])
+        write_scannet_layout(make_scene(**kw), tmp, name)
+        warm_names.append(name)
+    log(f"smoke: materialized warm scenes {warm_names} under {tmp}")
+
+    cmd = [sys.executable, "-m", "maskclustering_tpu.serve",
+           "--config", "scannet", "--socket", sock, "--data_root", tmp,
+           "--capacity", "4", "--retrace-sanitizer",
+           "--obs_events", events, "--warm", "+".join(warm_names),
+           "--journal-dir", os.path.join(tmp, "journals")]
+    for kv in SMOKE_CONFIG_SETS:
+        cmd += ["--set", kv]
+    if args.fault_plan:
+        cmd += ["--fault-plan", args.fault_plan]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    log(f"smoke: starting daemon: {' '.join(cmd)}")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, cwd=REPO_ROOT,
+                            env=env, text=True)
+    try:
+        if not _wait_for_socket(sock, proc, timeout_s=args.smoke_startup_s):
+            log("smoke: FAIL — daemon never became reachable")
+            proc.kill()
+            return 1
+        verdict = run_load(sock, requests=args.requests,
+                           concurrency=args.concurrency, buckets=2,
+                           deadline_s=args.deadline, resume=False)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=90.0)
+    except subprocess.TimeoutExpired:
+        log("smoke: FAIL — daemon did not drain within 90s of SIGTERM")
+        proc.kill()
+        return 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    digest = None
+    for line in (out or "").splitlines():
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if doc.get("kind") == "digest":
+            digest = doc
+    failures = []
+    if proc.returncode != 143:
+        failures.append(f"daemon exit code {proc.returncode} (expected 143 "
+                        f"— SIGTERM-clean drain)")
+    if digest is None:
+        failures.append("daemon printed no final digest line")
+    else:
+        verdict["warmup_s"] = digest.get("warmup_s")
+        retrace = digest.get("retrace") or {}
+        verdict["retrace_compiles"] = retrace.get("compiles")
+        verdict["retrace_repeats"] = retrace.get("repeats")
+        verdict["retrace_post_freeze"] = retrace.get("post_freeze")
+        if retrace.get("post_freeze"):
+            failures.append(f"{retrace['post_freeze']} post-warm compile(s) "
+                            f"— the serve-many contract broke")
+        if retrace.get("repeats"):
+            failures.append(f"{retrace['repeats']} repeat compile(s) — "
+                            f"jit-cache thrash in the daemon")
+        if not retrace.get("frozen"):
+            failures.append("retrace sanitizer never froze after warm-up")
+    if verdict["ok"] != args.requests:
+        failures.append(f"only {verdict['ok']}/{args.requests} requests "
+                        f"answered ok")
+    if args.fault_plan and "flaky" in args.fault_plan \
+            and verdict["max_attempts"] < 2:
+        # the daemon suspends the plan during warm-up precisely so the
+        # drill lands on the SERVING path; a flaky that nobody retried
+        # means it never fired there
+        failures.append("fault plan never exercised a serving-path retry")
+    verdict["smoke"] = True
+    if failures:
+        verdict["error"] = "; ".join(failures)
+    print(json.dumps(verdict, sort_keys=True), flush=True)
+    if not args.no_ledger:
+        append_ledger_row(verdict, args.ledger)
+    if failures:
+        for f in failures:
+            log(f"smoke: FAIL — {f}")
+        return 1
+    log(f"smoke: PASS — {verdict['ok']} requests, p50 "
+        f"{verdict['value']}s, p95 {verdict['p95_s']}s, zero post-warm "
+        f"compiles, SIGTERM-clean drain")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="mct-serve load generator (+ --smoke CI gate)")
+    parser.add_argument("--socket", default=None,
+                        help="daemon AF_UNIX socket path")
+    parser.add_argument("--host", default=None, help="daemon TCP host")
+    parser.add_argument("--port", type=int, default=0, help="daemon TCP port")
+    parser.add_argument("--requests", type=int, default=8,
+                        help="total requests to fire (default 8)")
+    parser.add_argument("--concurrency", type=int, default=4,
+                        help="concurrent client connections (default 4)")
+    parser.add_argument("--buckets", type=int, default=2,
+                        help="how many synthetic shape buckets to mix "
+                             "(1..2, default 2)")
+    parser.add_argument("--deadline", type=float, default=0.0,
+                        help="per-request deadline_s (0 = none)")
+    parser.add_argument("--resume", action="store_true",
+                        help="send resume=true (repeats become artifact "
+                             "skips — throughput numbers then measure "
+                             "admission, not execution)")
+    parser.add_argument("--ledger", default=None,
+                        help="perf ledger path (default: PERF_LEDGER.jsonl "
+                             "/ $MCT_PERF_LEDGER)")
+    parser.add_argument("--no-ledger", action="store_true",
+                        help="do not append a serve ledger row")
+    parser.add_argument("--shutdown", action="store_true",
+                        help="send a shutdown op after the burst")
+    parser.add_argument("--smoke", action="store_true",
+                        help="self-contained CI smoke: spawn a daemon "
+                             "subprocess, assert clean drain + zero "
+                             "post-warm compiles")
+    parser.add_argument("--smoke-startup-s", type=float, default=180.0,
+                        help="smoke: max seconds for daemon warm-up "
+                             "before first request")
+    parser.add_argument("--fault-plan", default=None,
+                        help="smoke only: FaultPlan spec passed to the "
+                             "daemon (e.g. 'flaky:lg-b:1')")
+    args = parser.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke(args)
+    if not args.socket and not args.host:
+        parser.error("need --socket or --host/--port (or --smoke)")
+    verdict = run_load(_address(args), requests=args.requests,
+                       concurrency=args.concurrency, buckets=args.buckets,
+                       deadline_s=args.deadline, resume=args.resume)
+    from maskclustering_tpu.serve.client import ServeClient
+
+    with ServeClient(_address(args), timeout_s=30.0) as client:
+        stats = client.stats()
+        retrace = stats.get("retrace") or {}
+        if retrace:
+            verdict["retrace_compiles"] = retrace.get("compiles")
+            verdict["retrace_repeats"] = retrace.get("repeats")
+            verdict["retrace_post_freeze"] = retrace.get("post_freeze")
+        if args.shutdown:
+            client.shutdown()
+    print(json.dumps(verdict, sort_keys=True), flush=True)
+    if not args.no_ledger:
+        append_ledger_row(verdict, args.ledger)
+    if verdict["failed"] or verdict["ok"] < args.requests:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,619 @@
+"""mct-serve: the long-lived scene-serving daemon (ISSUE-11 acceptance).
+
+Unit tier: protocol validation, bounded-admission typed rejects, router
+classification against the committed surface baseline, per-request
+journal round-trips, serve ledger rows + the --regress fence, and the
+Serving report section.
+
+Integration tier (one module-scoped daemon over the tier-1 suite's two
+warm tiny shape buckets — scene A is byte-identical to test_executor /
+test_retrace's seed-40 scene, so in a full run its programs are
+process-warm): the concurrent mixed-bucket soak with byte-identical
+artifacts vs one-shot run.py and ZERO post-warm compiles under the
+frozen retrace sanitizer, FaultPlan healing without neighbor poisoning,
+admission-edge behavior (queue-full, deadline expiry in queue and
+mid-device-phase), SIGTERM drain with a request in flight, and the
+second-daemon warm start pinned via retrace.* counters. A larger
+load_gen-driven soak is slow-marked; the cross-process warm start lives
+in scripts/ci.sh's serve smoke gate.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu import obs
+from maskclustering_tpu.config import load_config
+from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.serve.admission import AdmissionQueue, QueueFullReject
+from maskclustering_tpu.serve.client import ServeClient
+from maskclustering_tpu.serve.daemon import ServeDaemon
+from maskclustering_tpu.serve.router import Router
+from maskclustering_tpu.utils import faults
+from maskclustering_tpu.utils.synthetic import (make_scene, to_scene_tensors,
+                                                write_scannet_layout)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the two warm tiny buckets (shared shapes: test_executor scene0 == A)
+SPEC_A = {"num_boxes": 3, "num_frames": 10, "image_hw": (60, 80),
+          "spacing": 0.06, "seed": 40}
+SPEC_B = {"num_boxes": 4, "num_frames": 10, "image_hw": (60, 80),
+          "spacing": 0.05, "seed": 50}
+SCENE_A, SCENE_B = "scene0000_00", "scene0001_00"
+
+
+def _cfg(data_root, **kw):
+    base = dict(data_root=data_root, config_name="served", step=1,
+                distance_threshold=0.05, mask_pad_multiple=32)
+    base.update(kw)
+    return load_config("scannet").replace(**base)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.set_plan(None)
+    faults.clear_stop()
+    yield
+    faults.set_plan(None)
+    faults.clear_stop()
+
+
+# ---------------------------------------------------------------------------
+# units: protocol
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_parse_validate_and_build():
+    doc = protocol.parse_line(
+        '{"op": "scene", "scene": "s1", "deadline_s": 2.5, "tag": "t",'
+        ' "synthetic": {"num_boxes": 2, "seed": 3}}')
+    req = protocol.build_request(doc, "r-000007")
+    assert (req.scene, req.tag, req.deadline_s) == ("s1", "t", 2.5)
+    assert req.synthetic == {"num_boxes": 2, "seed": 3}
+    assert not req.expired() and 0 < req.remaining_s() <= 2.5
+    nodl = protocol.build_request(protocol.parse_line(
+        '{"op": "scene", "scene": "s2"}'), "r-000008")
+    assert not nodl.expired() and nodl.remaining_s() > 1e9
+
+    for bad in ('not json', '[]', '{"op": "nope"}',
+                '{"op": "scene"}', '{"op": "scene", "scene": ""}',
+                '{"op": "scene", "scene": "a/b"}',
+                '{"op": "scene", "scene": "a", "deadline_s": -1}',
+                '{"op": "scene", "scene": "a", "synthetic": {"bogus": 1}}',
+                '{"op": "scene", "scene": "a", "resume": "yes"}'):
+        with pytest.raises(protocol.ProtocolError):
+            protocol.parse_line(bad)
+
+    ev = protocol.result(req, "ok", seconds=1.25)
+    assert (ev["kind"], ev["id"], ev["tag"], ev["status"]) == \
+        ("result", "r-000007", "t", "ok")
+    line = protocol.encode(ev)
+    assert line.endswith(b"\n") and json.loads(line) == ev
+    rej = protocol.reject("queue_full", detail="4/4", tag="t2")
+    assert rej["reason"] == "queue_full" and rej["tag"] == "t2"
+
+
+def test_admission_queue_bounded_and_typed():
+    q = AdmissionQueue(2)
+    reqs = [protocol.build_request(
+        protocol.parse_line(json.dumps({"op": "scene", "scene": f"s{i}"})),
+        f"r-{i:06d}") for i in range(3)]
+    assert q.submit(reqs[0]) == 1
+    assert q.submit(reqs[1]) == 2
+    with pytest.raises(QueueFullReject) as ei:
+        q.submit(reqs[2])
+    assert ei.value.capacity == 2
+    assert q.high_water == 2 and q.admitted == 2
+    assert q.next(0.01).id == "r-000000"
+    assert q.submit(reqs[2]) == 2  # capacity freed by the pop
+    assert [r.id for r in q.drain()] == ["r-000001", "r-000002"]
+    assert q.next(0.01) is None
+    with pytest.raises(ValueError):
+        AdmissionQueue(0)
+
+
+def test_router_classifies_via_scene_bucket_and_fits_baseline(tmp_path):
+    from maskclustering_tpu.utils.compile_cache import scene_bucket
+
+    cfg = _cfg(str(tmp_path))
+    baseline = os.path.join(REPO_ROOT, "compile_surface_baseline.json")
+    router = Router(cfg, baseline_path=baseline)
+    assert router.vocabulary, "committed baseline must carry a workload"
+
+    t = to_scene_tensors(make_scene(**SPEC_A))
+    bucket = router.classify_tensors(t)
+    assert bucket == scene_bucket(cfg, t.num_frames, t.num_points,
+                                  int(np.max(t.segmentations)))
+    assert not router.is_warm(bucket)
+    assert router.note_served(bucket) is True
+    assert router.note_served(bucket) is False  # repeat = already warm
+    assert router.is_warm(bucket)
+
+    # baseline-driven warm-up scenes land EXACTLY on the baseline's bucket
+    # coordinates (classification only — execution is the daemon's job)
+    workload = list(router.warmup_workload())
+    expected = {router.classify(e["frames"], e["points"], e["max_id"])
+                for e in router.vocabulary}
+    assert workload, "baseline workload produced no warm-up scenes"
+    got = {router.classify_tensors(t) for _, t in workload}
+    assert got == expected
+    # dedup: the baseline's deliberate A-repeat entry emits once
+    assert len(workload) == len(expected)
+
+
+def test_run_journal_per_request_roundtrip(tmp_path):
+    path = str(tmp_path / "serve_journal.jsonl")
+    for rid, seq, status in (("r-000001", "sceneX", "ok"),
+                             ("r-000002", "sceneX", "failed")):
+        j = faults.RunJournal(path, "served", request_id=rid)
+        j.begin_run()
+        j.attempt(seq, 1, 0)
+        j.outcome(seq, status, attempt=1, rung=0,
+                  error="boom" if status == "failed" else "")
+        j.end_run()
+        j.close()
+    # one shared path, two requests, zero clobbering: per-request replay
+    r1 = faults.replay_journal(path, config="served", request="r-000001")
+    r2 = faults.replay_journal(path, config="served", request="r-000002")
+    assert r1["sceneX"]["status"] == "ok"
+    assert r2["sceneX"]["status"] == "failed"
+    assert faults.resume_done(path, config="served",
+                              request="r-000001") == {"sceneX"}
+    assert faults.resume_done(path, config="served",
+                              request="r-000002") == set()
+    # a request-free read still round-trips (last outcome wins), so the
+    # one-shot replay tooling keeps working on daemon journals
+    merged = faults.replay_journal(path, config="served")
+    assert merged["sceneX"]["status"] == "failed"
+    # and a request-free journal is untouched by the new field
+    solo = str(tmp_path / "solo.jsonl")
+    j = faults.RunJournal(solo, "served")
+    j.outcome("sceneY", "ok", attempt=1)
+    j.close()
+    assert faults.resume_done(solo, config="served") == {"sceneY"}
+    assert "request" not in faults.read_journal(solo)[0]
+
+
+def test_serve_ledger_row_and_regress_fence(tmp_path):
+    from maskclustering_tpu.obs import ledger as led
+    from maskclustering_tpu.obs.report import _regress_eval
+
+    path = str(tmp_path / "ledger.jsonl")
+    bench_metric = "mask-clustering s/scene"
+    led.append_row(path, {"tool": "bench", "metric": bench_metric,
+                          "value": 3.2, "unit": "s/scene"})
+    verdict = {"metric": "serve s/request (p50 of 8 synthetic requests)",
+               "value": 1.5, "p95_s": 2.0, "throughput_rps": 2.5,
+               "requests": 8, "concurrency": 4,
+               "retrace_post_freeze": 0}
+    row = led.serve_row(verdict)
+    assert row["tool"] == "serve" and row["unit"] == "s/request"
+    assert led.append_row(path, row)
+
+    # a bench baseline gates the BENCH row even though the serve row is
+    # newer — no cross-metric misattribution
+    base = str(tmp_path / "base.json")
+    with open(base, "w") as f:
+        json.dump({"metric": bench_metric, "value": 3.0,
+                   "unit": "s/scene"}, f)
+    rc, lines, record = _regress_eval(path, base, 0.15)
+    assert rc == 0 and record["current"]["tool"] == "bench"
+
+    # a metric-less bench-style baseline must STILL not pick the serve row
+    with open(base, "w") as f:
+        json.dump({"value": 3.0}, f)
+    rc, lines, record = _regress_eval(path, base, 0.15)
+    assert record["current"]["tool"] == "bench"
+
+    # a serve baseline gates serve rows (50% regression -> exit 2)
+    led.append_row(path, led.serve_row(dict(verdict, value=2.6)))
+    serve_base = str(tmp_path / "serve_base.jsonl")
+    led.append_row(serve_base, led.serve_row(verdict))
+    rc, lines, record = _regress_eval(path, serve_base, 0.15)
+    assert rc == 2 and record["current"]["tool"] == "serve"
+    assert record["baseline"]["tool"] == "serve"
+
+
+def test_render_serving_section(tmp_path):
+    from maskclustering_tpu.obs.report import RunData, render_report
+
+    events = str(tmp_path / "serve_events.jsonl")
+    obs.configure(events, truncate=True, meta={"tool": "serve"})
+    try:
+        for _ in range(4):
+            obs.count("serve.requests")
+            obs.count("serve.requests_ok")
+            with obs.span("serve.request"):
+                time.sleep(0.002)
+        obs.count("serve.requests")
+        obs.count("serve.requests_failed")
+        obs.count("serve.admission.admitted", 5)
+        obs.count("serve.admission.rejects.queue_full", 2)
+        obs.count("retrace.post_freeze_compiles", 1)
+        obs.gauge("serve.queue_depth_high_water", 3)
+        obs.gauge("serve.warm_buckets", 2)
+        obs.flush_metrics()
+    finally:
+        obs.disable()
+    text = render_report(RunData(events))
+    assert "== serving (mct-serve) ==" in text
+    assert "requests 5" in text and "ok 4" in text and "failed 1" in text
+    assert "queue high-water 3" in text
+    assert "queue_full x2" in text
+    assert "request latency: p50" in text
+    assert "warm buckets 2" in text
+    assert "compiles post-warm-up: 1 [VIOLATION" in text
+    # a serve-free events file renders no Serving section
+    other = str(tmp_path / "plain.jsonl")
+    obs.configure(other, truncate=True)
+    try:
+        obs.count("run.scenes_ok")
+        obs.flush_metrics()
+    finally:
+        obs.disable()
+    assert "== serving (mct-serve) ==" not in render_report(RunData(other))
+
+
+# ---------------------------------------------------------------------------
+# integration: one warm daemon, the soak, the edges, the warm start
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def rs():
+    """Module-armed retrace sanitizer (the daemon freezes it post-warm-up)."""
+    from maskclustering_tpu.analysis import retrace_sanitizer
+
+    retrace_sanitizer.reset()
+    retrace_sanitizer.install()
+    yield retrace_sanitizer
+    retrace_sanitizer.uninstall()
+    retrace_sanitizer.reset()
+
+
+@pytest.fixture(scope="module")
+def serve_env(tmp_path_factory, rs):
+    """Disk scenes + a one-shot reference run + a warm serving daemon.
+
+    The one-shot pass (config "oneshot") is the byte-identity reference;
+    the daemon (config "served") starts with both buckets as warm scenes,
+    after which the sanitizer freezes — from there, every compile is a
+    post-warm violation.
+    """
+    from maskclustering_tpu.run import run_pipeline
+
+    root = str(tmp_path_factory.mktemp("serve_data"))
+    for seq, spec in ((SCENE_A, SPEC_A), (SCENE_B, SPEC_B)):
+        write_scannet_layout(make_scene(**spec), root, seq)
+
+    ref = run_pipeline(_cfg(root, config_name="oneshot"), [SCENE_A, SCENE_B],
+                       steps=("cluster",), resume=False, journal=False,
+                       ledger=False)
+    assert [s.status for s in ref.scenes] == ["ok", "ok"]
+
+    sock = os.path.join(root, "mct.sock")
+    daemon = ServeDaemon(
+        _cfg(root), socket_path=sock, capacity=8,
+        journal_dir=os.path.join(root, "journals"),
+        warm_scenes=(SCENE_A, SCENE_B), freeze_after_warm=True)
+    daemon.start()
+    assert rs.digest()["frozen"], "daemon must freeze the sanitizer post-warm"
+    try:
+        yield {"root": root, "daemon": daemon, "sock": sock, "rs": rs}
+    finally:
+        daemon.request_stop()
+        daemon.shutdown()
+
+
+def _wait(predicate, timeout_s: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError("condition not reached within the poll budget")
+
+
+def _request_thread(sock, scene, spec, out, i, **kw):
+    def run():
+        with ServeClient(sock, timeout_s=300.0) as c:
+            terminal, statuses, latency = c.run_scene(
+                scene, synthetic=dict(spec, image_hw=list(spec["image_hw"])),
+                tag=f"t{i}", **kw)
+            out[i] = (terminal, statuses, latency)
+
+    t = threading.Thread(target=run, daemon=True, name=f"soak-client-{i}")
+    t.start()
+    return t
+
+
+def test_soak_concurrent_mixed_buckets_byte_identical(serve_env):
+    """ISSUE-11 acceptance: >= 8 concurrent mixed-bucket requests all
+    complete, artifacts byte-identical to one-shot run.py, ZERO post-warm
+    compiles under the frozen retrace sanitizer, and an injected FaultPlan
+    fault heals via the supervisor without poisoning neighbor requests."""
+    rs = serve_env["rs"]
+    sock = serve_env["sock"]
+    keys_before = rs.snapshot_keys()
+    viol_before = len(rs.violations())
+
+    # one scripted flaky device fault: the FIRST scene-B request retries
+    # once and heals; every other request must be untouched (rung 0,
+    # attempts 1)
+    faults.set_plan(faults.FaultPlan.from_spec(f"flaky:{SCENE_B}:1"))
+    out = {}
+    threads = []
+    specs = [(SCENE_A, SPEC_A), (SCENE_B, SPEC_B)]
+    try:
+        for i in range(8):
+            scene, spec = specs[i % 2]
+            kw = {"deadline_s": 240.0} if i == 0 else {}
+            threads.append(_request_thread(sock, scene, spec, out, i, **kw))
+        for t in threads:
+            t.join(300.0)
+            assert not t.is_alive(), "a soak client wedged"
+    finally:
+        faults.set_plan(None)
+
+    assert sorted(out) == list(range(8))
+    terminals = {i: out[i][0] for i in out}
+    assert all(tv["kind"] == "result" and tv["status"] == "ok"
+               for tv in terminals.values()), terminals
+    # the flaky fault healed on a retry somewhere in the B lane...
+    assert max(tv["attempts"] for tv in terminals.values()) == 2
+    # ...and poisoned nobody: no request degraded a rung, exactly one
+    # request retried (flaky is retryable-class: no ladder involvement)
+    assert all(tv["rung"] == 0 for tv in terminals.values())
+    assert sum(1 for tv in terminals.values() if tv["attempts"] > 1) == 1
+    # every request ran warm: no scene bucket was new to the process
+    assert all(tv["buckets_new"] == 0 for tv in terminals.values())
+
+    # zero post-warm compiles: the frozen sanitizer saw no new keys and
+    # booked no violations across 8 concurrent mixed-bucket requests
+    assert rs.snapshot_keys() == keys_before
+    assert len(rs.violations()) == viol_before
+
+    # byte-identical artifacts vs the one-shot run.py pass
+    pred = os.path.join(serve_env["root"], "prediction")
+    for seq in (SCENE_A, SCENE_B):
+        a = np.load(os.path.join(pred, "served_class_agnostic", f"{seq}.npz"))
+        b = np.load(os.path.join(pred, "oneshot_class_agnostic", f"{seq}.npz"))
+        assert set(a.files) == set(b.files)
+        for key in a.files:
+            np.testing.assert_array_equal(a[key], b[key])
+
+    # per-request journals replay the per-request outcome
+    stats = serve_env["daemon"].stats()
+    assert stats["counts"]["ok"] >= 8
+    journals = os.listdir(os.path.join(serve_env["root"], "journals"))
+    assert len(journals) >= 8
+    rid = terminals[0]["id"]
+    replay = faults.replay_journal(
+        os.path.join(serve_env["root"], "journals", f"{rid}.jsonl"),
+        request=rid)
+    assert replay[SCENE_A]["status"] == "ok"
+
+
+def test_admission_edges_queue_full_and_queue_deadline(serve_env, tmp_path):
+    """Queue-full typed reject at the wire, and a deadline that expires
+    while queued answering a typed deadline reject at dequeue. The
+    blocking requests use a watchdog-free 2s device-phase stall — they
+    hold the worker and then answer ok, so no retry/degradation noise."""
+    root = serve_env["root"]
+    sock = os.path.join(str(tmp_path), "edge.sock")
+    daemon = ServeDaemon(
+        _cfg(root, config_name="edge"), socket_path=sock, capacity=1,
+        journal_dir=os.path.join(str(tmp_path), "journals"),
+        freeze_after_warm=False)
+    daemon.start()
+    syn = dict(SPEC_A, image_hw=list(SPEC_A["image_hw"]))
+    try:
+        # queue-full: a stalled request holds the worker, capacity-1 queue
+        # holds one more, the third answers a typed queue_full reject.
+        # Sync on the stall entry's consumption: it decrements exactly
+        # when the worker enters r1's device phase (no stale-idle races)
+        plan = faults.FaultPlan.from_spec(
+            "stall:edge-block.device:1", stall_s=2.0)
+        faults.set_plan(plan)
+        out = {}
+        t1 = _request_thread(sock, "edge-block", SPEC_A, out, 1)
+        _wait(lambda: plan.entries[0].remaining == 0)  # r1 mid-device-phase
+        t2 = _request_thread(sock, "edge-q", SPEC_A, out, 2)
+        _wait(lambda: daemon.queue.depth() == 1)  # r2 queued behind r1
+        with ServeClient(sock, timeout_s=30.0) as c3:
+            rej = c3.request_scene("edge-q2", synthetic=syn)
+        assert rej["kind"] == "reject" and rej["reason"] == "queue_full"
+        assert "retry" in rej["detail"]
+        t1.join(60.0)
+        t2.join(60.0)
+        assert out[1][0]["status"] == "ok" and out[1][0]["attempts"] == 1
+        assert out[2][0]["status"] == "ok"
+
+        # deadline expiry IN QUEUE: a 0.5s budget parked behind a 2s
+        # stall answers a typed deadline reject at dequeue — no device
+        # work is burned on a result nobody can use
+        plan2 = faults.FaultPlan.from_spec(
+            "stall:edge-block2.device:1", stall_s=2.0)
+        faults.set_plan(plan2)
+        out2 = {}
+        tb = _request_thread(sock, "edge-block2", SPEC_A, out2, 1)
+        _wait(lambda: plan2.entries[0].remaining == 0)  # mid-device-phase
+        with ServeClient(sock, timeout_s=60.0) as c:
+            terminal, _, _ = c.run_scene("edge-dl", synthetic=syn,
+                                         deadline_s=0.5)
+        assert terminal["kind"] == "reject" and \
+            terminal["reason"] == "deadline", terminal
+        assert "expired" in terminal["detail"]
+        tb.join(60.0)
+        assert out2[1][0]["status"] == "ok"
+    finally:
+        faults.set_plan(None)
+        daemon.request_stop()
+        daemon.shutdown()
+    # the edge daemon's stats carried the accounting
+    assert daemon.stats()["counts"]["ok"] >= 3
+
+
+def test_deadline_mid_device_phase_watchdog_degrade_and_answer(serve_env,
+                                                               tmp_path):
+    """Deadline/watchdog expiry MID-DEVICE-PHASE: a scripted 60s stall
+    trips the config's 8s device watchdog (DeviceStallError in budget),
+    the per-request ladder degrades one rung, and the retried attempt —
+    stall consumed — still answers ok. A second request whose DEADLINE is
+    tighter than the watchdog instead answers a typed ``deadline`` result
+    once its budget is gone (no retry past the deadline).
+
+    The 8s watchdog follows the PR-5 budget note: a warm tiny-bucket
+    device phase is ~1s of CPU dispatch but spikes several-fold on a
+    loaded box (4.2s observed), so only the STALLED attempts may trip it
+    — and the watchdog wait IS this test's wall cost, so it stays as
+    tight as that note allows."""
+    root = serve_env["root"]
+    sock = os.path.join(str(tmp_path), "mid.sock")
+    daemon = ServeDaemon(
+        _cfg(root, config_name="mid", watchdog_device_s=8.0),
+        socket_path=sock, capacity=2,
+        journal_dir=os.path.join(str(tmp_path), "journals"),
+        freeze_after_warm=False)
+    daemon.start()
+    syn = dict(SPEC_A, image_hw=list(SPEC_A["image_hw"]))
+    try:
+        faults.set_plan(faults.FaultPlan.from_spec(
+            "stall:mid-heal.device:1", stall_s=60.0))
+        with ServeClient(sock, timeout_s=120.0) as c:
+            terminal, statuses, _ = c.run_scene("mid-heal", synthetic=syn,
+                                                deadline_s=90.0)
+        assert terminal["status"] == "ok", terminal
+        assert terminal["attempts"] == 2 and terminal["rung"] == 1
+        assert any(s.get("state") == "degraded" for s in statuses)
+        assert any(s.get("state") == "retrying" for s in statuses)
+
+        # deadline tighter than the watchdog: the stall is aborted at the
+        # ~3s remaining budget, the budget is then gone, and the request
+        # answers `deadline` with device-class attribution instead of
+        # burning retries past its deadline
+        faults.set_plan(faults.FaultPlan.from_spec(
+            "stall:mid-dl.device:1", stall_s=60.0))
+        with ServeClient(sock, timeout_s=60.0) as c:
+            terminal, _, _ = c.run_scene("mid-dl", synthetic=syn,
+                                         deadline_s=3.0)
+        assert terminal["kind"] == "result" and \
+            terminal["status"] == "deadline", terminal
+        assert terminal["error_class"] == "device"
+        assert terminal["attempts"] == 1
+    finally:
+        faults.set_plan(None)
+        daemon.request_stop()
+        daemon.shutdown()
+
+
+def test_sigterm_drains_in_flight_and_rejects_queued(serve_env, tmp_path):
+    """SIGTERM with one request mid-device-phase: the in-flight request
+    answers, the queued one gets a typed draining reject, shutdown is
+    clean, and the per-request journal survives."""
+    root = serve_env["root"]
+    sock = os.path.join(str(tmp_path), "drain.sock")
+    jdir = os.path.join(str(tmp_path), "journals")
+    daemon = ServeDaemon(_cfg(root, config_name="drain"), socket_path=sock,
+                         capacity=4, journal_dir=jdir,
+                         freeze_after_warm=False)
+    daemon.start()
+    old_handler = faults.install_sigterm_handler()
+    # a 2s device-phase sleep (no watchdog armed) holds the request in
+    # flight long enough to land the signal mid-phase, deterministically:
+    # the stall entry's consumption marks the phase entry exactly
+    plan = faults.FaultPlan.from_spec("stall:drain-s.device:1", stall_s=2.0)
+    faults.set_plan(plan)
+    out = {}
+    try:
+        t1 = _request_thread(sock, "drain-s", SPEC_A, out, 1)
+        _wait(lambda: plan.entries[0].remaining == 0)  # r1 mid-device-phase
+        t2 = _request_thread(sock, "drain-q", SPEC_A, out, 2)
+        _wait(lambda: daemon.queue.depth() == 1)  # r2 admitted behind r1
+        os.kill(os.getpid(), signal.SIGTERM)  # the real handler, real signal
+        assert faults.stop_requested()
+        daemon.shutdown(timeout_s=120.0)
+        t1.join(60.0)
+        t2.join(60.0)
+        assert out[1][0]["kind"] == "result" and \
+            out[1][0]["status"] == "ok", out[1][0]
+        assert out[2][0]["kind"] == "reject" and \
+            out[2][0]["reason"] == "draining", out[2][0]
+    finally:
+        faults.set_plan(None)
+        signal.signal(signal.SIGTERM, old_handler)
+        faults.clear_stop()
+        daemon.request_stop()
+        daemon.shutdown()
+    # the in-flight request's journal survived the drain
+    rid = out[1][0]["id"]
+    replay = faults.replay_journal(os.path.join(jdir, f"{rid}.jsonl"),
+                                   request=rid)
+    assert replay["drain-s"]["status"] == "ok"
+    # new connections are refused once the socket is gone
+    assert not os.path.exists(sock)
+
+
+def test_second_daemon_warm_start_books_zero_retrace(serve_env, tmp_path):
+    """ISSUE-11 acceptance: a second daemon start on the warm cache
+    reaches first request dispatch without re-tracing the served buckets
+    — pinned via retrace.* state: no new compile keys, no violations.
+    (The cross-process half on a persistent AOT cache is ROADMAP item 3;
+    scripts/ci.sh's serve smoke pins the cross-process drain today.)"""
+    rs = serve_env["rs"]
+    root = serve_env["root"]
+    keys_before = rs.snapshot_keys()
+    viol_before = len(rs.violations())
+
+    sock = os.path.join(str(tmp_path), "warm2.sock")
+    daemon = ServeDaemon(_cfg(root, config_name="warm2"), socket_path=sock,
+                         capacity=2, warm_scenes=(SCENE_A,),
+                         freeze_after_warm=True)
+    daemon.start()  # warm-up runs scene A end to end: zero compiles
+    try:
+        syn_b = dict(SPEC_B, image_hw=list(SPEC_B["image_hw"]))
+        with ServeClient(sock, timeout_s=120.0) as c:
+            terminal, _, _ = c.run_scene(SCENE_B, synthetic=syn_b)
+        assert terminal["status"] == "ok"
+        assert terminal["buckets_new"] == 0
+    finally:
+        daemon.request_stop()
+        daemon.shutdown()
+    assert rs.snapshot_keys() == keys_before
+    assert len(rs.violations()) == viol_before
+    # the serving counters survived into the report plumbing
+    assert daemon.stats()["counts"]["ok"] == 1
+
+
+@pytest.mark.slow
+def test_full_soak_load_gen_throughput(serve_env):
+    """The load_gen-driven soak: 16 requests at concurrency 8 through the
+    REAL client/load-gen code path, sustained throughput with bounded
+    p95 and zero failures."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "load_gen", os.path.join(REPO_ROOT, "scripts", "load_gen.py"))
+    load_gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(load_gen)
+
+    # point load_gen's bucket specs at the fixture's materialized scenes
+    load_gen.BUCKET_SPECS = (
+        (SCENE_A, dict(SPEC_A, image_hw=list(SPEC_A["image_hw"]))),
+        (SCENE_B, dict(SPEC_B, image_hw=list(SPEC_B["image_hw"]))),
+    )
+    verdict = load_gen.run_load(serve_env["sock"], requests=16,
+                                concurrency=8, buckets=2, deadline_s=0.0,
+                                resume=False)
+    assert verdict["ok"] == 16 and verdict["failed"] == 0
+    assert verdict["value"] is not None and verdict["p95_s"] is not None
+    # bounded p95: the burst must pipeline, not serialize-with-overhead —
+    # p95 latency stays under the whole-burst wall
+    assert verdict["p95_s"] < verdict["wall_s"]
+    assert verdict["throughput_rps"] > 0
