@@ -41,6 +41,7 @@ must stay importable without jax; jax is imported inside ``install``).
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import logging
 import os
@@ -52,8 +53,10 @@ ENV_FLAG = "MCT_RETRACE_SANITIZER"
 
 # the jax loggers that carry the jax_log_compiles messages (0.4.x: the
 # "Compiling ..." line is pxla's; the tracing/lowering timing lines are
-# dispatch's — both are intercepted so an armed run stays quiet)
-_JAX_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+# dispatch's; the persistent-cache hit/miss chatter is compiler's — all
+# are intercepted so an armed run stays quiet)
+_JAX_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch",
+                        "jax._src.compiler")
 
 # "Compiling <fn> with global shapes and types [sig]. Argument mapping: ..."
 # <fn> may contain spaces ("<unnamed wrapped function>") and [sig] spans
@@ -65,7 +68,13 @@ _COMPILING_RE = re.compile(
 # jax_log_compiles side-chatter suppressed (not recorded) while armed
 _NOISE_PREFIXES = ("Finished tracing + transforming",
                    "Finished jaxpr to MLIR module conversion",
-                   "Finished XLA compilation")
+                   "Finished XLA compilation",
+                   # persistent-compilation-cache chatter (jax flips these
+                   # to visible levels under jax_log_compiles; the HIT
+                   # signal itself arrives via jax.monitoring)
+                   "Persistent compilation cache hit",
+                   "Persistent compilation cache miss",
+                   "PERSISTENT COMPILATION CACHE MISS")
 
 DEFAULT_CONTEXT = "baseline"
 
@@ -94,6 +103,11 @@ def enabled() -> bool:
 # ---------------------------------------------------------------------------
 
 
+# thread-local restore marker: compiles recorded while an AOT-cache
+# restore window is open are cache restores, not serving compiles
+_RESTORE_TLS = threading.local()
+
+
 class _State:
     """Compile events keyed (fn, signature digest, context) since reset."""
 
@@ -106,13 +120,31 @@ class _State:
         self.frozen = False
         self.buckets_new = 0
         self.backend_compiles = 0
+        self.aot_restores = 0
+        # persistent-compilation-cache correlation: jax logs "Compiling
+        # <fn>" BEFORE the backend compile, then fires the
+        # /jax/compilation_cache/cache_hits monitoring event synchronously
+        # on the same thread when the "compile" was really a cache
+        # deserialize — so the last key recorded per thread is the one a
+        # hit event reclassifies (utils/aot_cache.py is built on this:
+        # cache hits are not compiles)
+        self.cache_hits: Dict[Tuple[str, str, str], int] = {}
+        self.pending = threading.local()
 
     def on_compile(self, fn: str, sig: str) -> None:
+        if getattr(_RESTORE_TLS, "active", False):
+            # an AOT-cache restore compiling its deserialized module: a
+            # warm start, not serving surface — counted separately, never
+            # a key/violation
+            with self.lock:
+                self.aot_restores += 1
+            return
         digest = hashlib.sha1(sig.encode("utf-8", "replace")).hexdigest()[:12]
         with self.lock:
             key = (fn, digest, self.context)
             n = self.keys.get(key, 0) + 1
             self.keys[key] = n
+            self.pending.key = key
             if n == 1:
                 self.first_sig[key] = sig[:200]
             if n > 1:
@@ -126,6 +158,15 @@ class _State:
                 self.violations.append({
                     "kind": "post_freeze", "fn": fn, "sig": digest,
                     "context": self.context})
+
+    def on_cache_event(self, hit: bool) -> None:
+        """One /jax/compilation_cache/cache_{hits,misses} event: resolve
+        this thread's pending key (hit -> reclassified as a cache hit)."""
+        with self.lock:
+            key = getattr(self.pending, "key", None)
+            self.pending.key = None
+            if hit and key is not None:
+                self.cache_hits[key] = self.cache_hits.get(key, 0) + 1
 
 
 def _rung_sanctioned(fn: str, context: str) -> bool:
@@ -193,6 +234,23 @@ def note_bucket(new: bool) -> None:
         _STATE.buckets_new += 1
 
 
+@contextlib.contextmanager
+def restore_window():
+    """Mark this thread's compiles as AOT-cache restores for the duration.
+
+    utils/aot_cache.py opens this around deserialize+compile of a
+    serialized executable: the wrapper's compile event is a warm start
+    being paid from disk, not serving surface — booked on
+    ``aot_restores``, never a key and never a violation.
+    """
+    prev = getattr(_RESTORE_TLS, "active", False)
+    _RESTORE_TLS.active = True
+    try:
+        yield
+    finally:
+        _RESTORE_TLS.active = prev
+
+
 def snapshot_keys() -> Set[Tuple[str, str, str]]:
     """The (fn, sig digest, context) keys observed since the last reset."""
     with _STATE.lock:
@@ -205,13 +263,25 @@ def violations() -> List[Dict]:
 
 
 def digest() -> Dict:
-    """JSON-able digest of everything observed since the last reset."""
+    """JSON-able digest of everything observed since the last reset.
+
+    ``compiles`` counts genuine builds only: compile events the
+    persistent compilation cache served (``cache_hits``) and AOT-cache
+    restores (``aot_restores``) are warm starts paid from disk, not
+    compile surface — a second process against warm caches reads
+    ``compiles: 0``. ``raw_compiles`` keeps the uncorrelated event count.
+    """
     with _STATE.lock:
         by_fn: Dict[str, int] = {}
         for (fn, _, _), n in _STATE.keys.items():
             by_fn[fn] = by_fn.get(fn, 0) + n
+        raw = sum(_STATE.keys.values())
+        hits = sum(_STATE.cache_hits.values())
         return {
-            "compiles": sum(_STATE.keys.values()),
+            "compiles": max(raw - hits, 0),
+            "raw_compiles": raw,
+            "cache_hits": hits,
+            "aot_restores": _STATE.aot_restores,
             "distinct_keys": len(_STATE.keys),
             "by_fn": dict(sorted(by_fn.items())),
             "violations": list(_STATE.violations),
@@ -220,6 +290,22 @@ def digest() -> Dict:
             "context": _STATE.context,
             "frozen": _STATE.frozen,
         }
+
+
+def summary() -> Dict:
+    """The compact serving-digest shape: ONE schema for the daemon's
+    stats/digest line and the isolated worker's ready/bye lines — a field
+    added here shows up identically in both topologies."""
+    d = digest()
+    return {
+        "compiles": d["compiles"],
+        "cache_hits": d["cache_hits"],
+        "aot_restores": d["aot_restores"],
+        "post_freeze": sum(1 for v in d["violations"]
+                           if v["kind"] == "post_freeze"),
+        "repeats": sum(1 for v in d["violations"] if v["kind"] == "repeat"),
+        "frozen": d["frozen"],
+    }
 
 
 def emit_counters() -> None:
@@ -233,6 +319,10 @@ def emit_counters() -> None:
     metrics.count("retrace.compiles", float(d["compiles"]))
     metrics.count("retrace.distinct_programs", float(len(d["by_fn"])))
     metrics.count("retrace.buckets_new", float(d["buckets_new"]))
+    if d["cache_hits"]:
+        metrics.count("retrace.cache_hits", float(d["cache_hits"]))
+    if d["aot_restores"]:
+        metrics.count("retrace.aot_restores", float(d["aot_restores"]))
     repeats = sum(1 for v in d["violations"] if v["kind"] == "repeat")
     frozen = sum(1 for v in d["violations"] if v["kind"] == "post_freeze")
     if repeats:
@@ -280,6 +370,19 @@ def _on_duration_event(event: str, duration: float, **kw) -> None:
             _STATE.backend_compiles += 1
 
 
+def _on_plain_event(event: str, **kw) -> None:
+    """Persistent-compilation-cache correlation: jax fires these
+    synchronously on the compiling thread right after the "Compiling <fn>"
+    log line, so a hit reclassifies exactly that pending key."""
+    del kw
+    if not enabled():
+        return
+    if event == "/jax/compilation_cache/cache_hits":
+        _STATE.on_cache_event(True)
+    elif event == "/jax/compilation_cache/cache_misses":
+        _STATE.on_cache_event(False)
+
+
 def install() -> None:
     """Arm + hook (idempotent): flip ``jax_log_compiles`` on and attach
     the capture filter to the jax compile loggers."""
@@ -298,6 +401,7 @@ def install() -> None:
         try:
             jax.monitoring.register_event_duration_secs_listener(
                 _on_duration_event)
+            jax.monitoring.register_event_listener(_on_plain_event)
             _MONITORING_REGISTERED = True
         except Exception:  # noqa: BLE001 — the log filter alone suffices
             pass

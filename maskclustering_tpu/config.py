@@ -152,6 +152,23 @@ class PipelineConfig:
     watchdog_load_s: float = 0.0
     watchdog_device_s: float = 0.0
     watchdog_host_s: float = 0.0
+    # process-isolated serving worker (serve/supervisor.py): parent-side
+    # liveness budget — a worker subprocess that emits no heartbeat for
+    # this long is declared wedged and SIGKILLed (a GIL-held native hang
+    # defeats every in-process watchdog; only the parent can clear it) —
+    # and how many consecutive crash/wedge respawns the supervisor pays
+    # before declaring the device unserveable and stopping the daemon
+    worker_heartbeat_s: float = 20.0
+    worker_respawns: int = 2
+
+    # --- persistent AOT executable cache (utils/aot_cache.py) ---
+    # "" = off (unless $MCT_AOT_CACHE arms it), "auto" = aot_cache/ next
+    # to the perf ledger, any other value = explicit directory. Armed, the
+    # serving programs' jax.export round-trips persist keyed by the
+    # retrace census coordinates and a version stamp, and warm_start()
+    # restores them at run/daemon/worker start — a respawned process
+    # reaches first dispatch with zero compiles
+    aot_cache_dir: str = ""
 
     # --- paths ---
     data_root: str = "./data"
@@ -201,10 +218,14 @@ class PipelineConfig:
             raise ValueError(
                 f"scene_retries must be >= 0, got {self.scene_retries}")
         for knob in ("retry_backoff_s", "watchdog_load_s",
-                     "watchdog_device_s", "watchdog_host_s"):
+                     "watchdog_device_s", "watchdog_host_s",
+                     "worker_heartbeat_s"):
             if getattr(self, knob) < 0:
                 raise ValueError(
                     f"{knob} must be >= 0, got {getattr(self, knob)}")
+        if self.worker_respawns < 0:
+            raise ValueError(
+                f"worker_respawns must be >= 0, got {self.worker_respawns}")
 
     def replace(self, **kw) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)
@@ -213,6 +234,24 @@ class PipelineConfig:
         d = dataclasses.asdict(self)
         d["mesh_shape"] = list(d["mesh_shape"])
         return json.dumps(d, indent=2)
+
+
+def config_from_json(text: str) -> PipelineConfig:
+    """Inverse of ``PipelineConfig.to_json``.
+
+    The isolated serving worker's config transport: the daemon serializes
+    its EXACT config (every override applied) and the worker subprocess
+    rebuilds it field-for-field — re-deriving from a config name + CLI
+    overrides would silently drift the two processes apart.
+    """
+    raw = json.loads(text)
+    fields = {f.name for f in dataclasses.fields(PipelineConfig)}
+    unknown = set(raw) - fields
+    if unknown:
+        raise ValueError(f"unknown config keys: {sorted(unknown)}")
+    if isinstance(raw.get("mesh_shape"), list):
+        raw["mesh_shape"] = tuple(raw["mesh_shape"])
+    return PipelineConfig(**raw)
 
 
 def load_config(name: str, config_dir: Optional[str] = None, **overrides) -> PipelineConfig:

@@ -130,6 +130,14 @@ def run_row(report: Dict, **extra) -> Dict:
             # retrace.compiles=0, and THAT zero is the baseline row the
             # 0 -> N regression attribution anchors on
             row[dst] = int(counters[src])
+    for src, dst in (("retrace.cache_hits", "retrace_cache_hits"),
+                     ("aot_cache.restored", "aot_restored"),
+                     ("aot_cache.invalidated", "aot_invalidated")):
+        # warm-start attribution (nonzero only — clean rows stay compact):
+        # a fast cold start next to restored/hit counts is the AOT cache's
+        # story, not code drift
+        if counters.get(src):
+            row[dst] = int(counters[src])
     faults = report.get("faults") or {}
     # fault attribution: a degraded/retried run's headline is the fault's
     # story, not code drift — stamp it so --regress can say so (keys only
@@ -162,7 +170,9 @@ def serve_row(verdict: Dict, **extra) -> Dict:
     for k in ("p95_s", "throughput_rps", "requests", "concurrency",
               "scenes", "buckets", "rejects", "failed", "warmup_s",
               "count_dtype", "plane_dtype", "retrace_compiles",
-              "retrace_repeats", "retrace_post_freeze", "error"):
+              "retrace_repeats", "retrace_post_freeze",
+              "retrace_cache_hits", "aot_restored", "worker_crashes",
+              "worker_respawns", "error"):
         if verdict.get(k) is not None:
             row[k] = verdict[k]
     row.update(extra)

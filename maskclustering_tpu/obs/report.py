@@ -165,7 +165,8 @@ class RunData:
             "counters": {k: v for k, v in sorted(self._counters.items())
                          if k.startswith(("run.", "bench.", "compile_cache.",
                                           "pipeline.", "faults.",
-                                          "retrace.", "serve."))},
+                                          "retrace.", "serve.",
+                                          "aot_cache."))},
         }
         ov = self.overlap()
         if ov is not None:
@@ -368,6 +369,30 @@ def render_serving(run: "RunData") -> Optional[str]:
         p50, p95 = g.get("serve.request_p50_s"), g.get("serve.request_p95_s")
     if p50 is not None:
         lines.append(f"request latency: p50 {_fmt_s(p50)} | p95 {_fmt_s(p95)}")
+    # crash containment (serve/supervisor.py): worker subprocess deaths,
+    # respawns and requeues — zero lines on an in-thread (or untroubled)
+    # daemon, loud attribution on a supervised one
+    crashes = int(c.get("serve.worker_crashes", 0))
+    respawns = int(c.get("serve.worker_respawns", 0))
+    requeued = int(c.get("serve.requests_requeued", 0))
+    if crashes or respawns:
+        line = (f"worker crashes {crashes} | respawns {respawns} | "
+                f"requests requeued {requeued}")
+        if c.get("serve.worker_fatal"):
+            line += " | FATAL: respawn budget exhausted"
+        lines.append(line)
+    # persistent AOT cache (utils/aot_cache.py): warm-start economics —
+    # how much of this process's warmth was paid from disk
+    aot = {k: int(c.get(f"aot_cache.{k}", 0))
+           for k in ("restored", "hits", "misses", "stores", "invalidated")}
+    if any(aot.values()):
+        line = (f"aot cache: {aot['restored']} restored | "
+                f"{aot['hits']} hit(s) | {aot['misses']} miss(es) | "
+                f"{aot['stores']} captured")
+        if aot["invalidated"]:
+            line += (f" | {aot['invalidated']} invalidated "
+                     f"[version-stamp mismatch — prune or recapture]")
+        lines.append(line)
     post_warm = c.get("retrace.post_freeze_compiles")
     cold = int(c.get("serve.buckets_cold", 0))
     warm_n = g.get("serve.warm_buckets")
@@ -376,6 +401,8 @@ def render_serving(run: "RunData") -> Optional[str]:
         tail.append(f"warm buckets {int(warm_n)}")
     if cold:
         tail.append(f"cold bucket dispatches {cold}")
+    if c.get("retrace.cache_hits"):
+        tail.append(f"compile-cache hits {int(c['retrace.cache_hits'])}")
     tail.append(f"compiles post-warm-up: "
                 f"{int(post_warm) if post_warm is not None else 0}"
                 + (" [VIOLATION — the serve-many contract broke]"
@@ -396,6 +423,12 @@ def render_retrace(counters: Dict[str, float]) -> Optional[str]:
             f"{int(counters.get('retrace.distinct_programs', 0))} "
             f"program(s) | "
             f"{int(counters.get('retrace.buckets_new', 0))} new bucket(s)")
+    hits = int(counters.get("retrace.cache_hits", 0))
+    restores = int(counters.get("retrace.aot_restores", 0))
+    if hits or restores:
+        # warm-start economics: events the persistent caches served are
+        # not compiles (compiles above already excludes them)
+        line += f" | {hits} cache hit(s), {restores} aot restore(s)"
     repeats = int(counters.get("retrace.repeat_compiles", 0))
     frozen = int(counters.get("retrace.post_freeze_compiles", 0))
     if repeats or frozen:

@@ -222,6 +222,31 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# AOT export seam (the persistent executable cache, utils/aot_cache.py)
+# ---------------------------------------------------------------------------
+
+
+def fused_step_aot_key(mesh, cfg, k_max: int, args):
+    """The fused step's persistent-AOT-cache key (census coordinates).
+
+    One entry per (mesh shape, scene batch bucket, k_max, count_dtype,
+    donation) — the same axes the retrace census's "fused" section pins
+    per mesh. ``args`` supplies the batched arg avals (shapes + dtypes,
+    nothing is read); parallel/batch.py consults/captures through this
+    seam so a respawned process re-dispatches the serialized step instead
+    of re-tracing ~400 frames of scan body.
+    """
+    from maskclustering_tpu.utils import aot_cache
+
+    mesh_desc = (f"{int(mesh.shape['scene'])}x{int(mesh.shape['frame'])}"
+                 if mesh is not None else "none")
+    return aot_cache.key_for(
+        "per_scene", args,
+        statics={"mesh": mesh_desc, "k_max": int(k_max)},
+        count_dtype=str(cfg.count_dtype), donate=bool(cfg.donate_buffers))
+
+
+# ---------------------------------------------------------------------------
 # per-stage AOT hooks (the compile-time cost observatory, obs/cost.py)
 # ---------------------------------------------------------------------------
 

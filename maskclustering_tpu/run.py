@@ -712,7 +712,8 @@ class SceneSupervisor:
                  resume: bool = True,
                  journal: Optional[faults.RunJournal] = None,
                  on_event: Optional[Callable] = None,
-                 should_continue: Optional[Callable[[], bool]] = None):
+                 should_continue: Optional[Callable[[], bool]] = None,
+                 initial_rungs: int = 0):
         self.cfg = cfg
         self.workers = workers
         self.resume = resume
@@ -720,6 +721,14 @@ class SceneSupervisor:
         self.on_event = on_event
         self.should_continue = should_continue
         self.ladder = faults.DegradationLadder(cfg)
+        # crash-class interaction (serve/supervisor.py): a request that
+        # took its device worker down with it re-runs PRE-DEGRADED by the
+        # crash count — the full configuration already proved fatal once,
+        # so the respawned worker's retry starts one rung down instead of
+        # re-buying the same crash at full configuration
+        for _ in range(max(int(initial_rungs), 0)):
+            if self.ladder.degrade(reason="worker crash carry-over") is None:
+                break
 
     def _notify(self, kind: str, **info) -> None:
         if self.on_event is None:
@@ -1116,6 +1125,16 @@ def _run_pipeline_body(
     from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
 
     setup_compilation_cache(cfg.compilation_cache_dir)
+    from maskclustering_tpu.utils import aot_cache
+
+    # persistent AOT executable cache (armed via cfg.aot_cache_dir /
+    # --aot-cache / $MCT_AOT_CACHE): restore every valid serialized
+    # serving executable BEFORE the first scene, so a warm-cached process
+    # reaches first dispatch with zero compiles (version-mismatched
+    # entries are skipped + counted; the run then compiles and re-captures)
+    aot_stats = aot_cache.warm_start(cfg)
+    if any(aot_stats.values()):
+        log.info("aot cache: %s", aot_stats)
     from maskclustering_tpu.semantics.encoder import find_local_clip_checkpoint
 
     report = RunReport(config_name=cfg.config_name,
@@ -1374,6 +1393,14 @@ def main(argv=None) -> int:
                              "'load:scene2, stall:scene4.device, "
                              "flaky:scene5:2'; default: $MCT_FAULT_PLAN). "
                              "Testing/drill knob — never set in production")
+    parser.add_argument("--aot-cache", default=None, nargs="?", const="auto",
+                        metavar="DIR",
+                        help="arm the persistent AOT executable cache "
+                             "(utils/aot_cache.py): restore serialized "
+                             "serving executables at start and capture "
+                             "newly compiled ones. Flag alone: aot_cache/ "
+                             "next to the perf ledger; also armed by "
+                             "$MCT_AOT_CACHE or cfg.aot_cache_dir")
     parser.add_argument("--data_root", default=None,
                         help="override the config's data root")
     parser.add_argument("--init_timeout", type=float, default=120.0,
@@ -1392,6 +1419,8 @@ def main(argv=None) -> int:
         overrides["scene_retries"] = args.scene_retries
     if args.watchdog_device is not None:
         overrides["watchdog_device_s"] = args.watchdog_device
+    if args.aot_cache is not None:
+        overrides["aot_cache_dir"] = args.aot_cache
     cfg = load_config(args.config, **overrides)
     if args.transfer_guard:
         from maskclustering_tpu.analysis import transfer_guard

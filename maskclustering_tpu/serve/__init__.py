@@ -15,6 +15,10 @@ across requests:
   ``run.SceneSupervisor`` per request (per-request retry/degradation,
   journal, obs spans, ``serve.*`` metrics);
 - ``daemon``    — socket front + lifecycle (SIGTERM drains in flight);
+- ``supervisor``/``worker_main`` — the crash-contained topology
+  (``--isolate-worker``): the device owner as a heartbeat-watchdogged
+  SUBPROCESS with SIGKILL-on-wedge, bounded respawn and requeue, made
+  instantly warm by the persistent AOT cache (``utils/aot_cache.py``);
 - ``client``    — the one blocking client implementation every caller
   (load_gen, CI smoke, tests) shares.
 

@@ -99,7 +99,23 @@ def main(argv=None) -> int:
                              "is a violation")
     parser.add_argument("--fault-plan", default=None,
                         help="deterministic fault injection spec "
-                             "(testing/drill knob — never in production)")
+                             "(testing/drill knob — never in production; "
+                             "with --isolate-worker the plan is handed to "
+                             "the FIRST worker subprocess, so crash/wedge "
+                             "drills land on the supervised path)")
+    parser.add_argument("--isolate-worker", action="store_true",
+                        help="run the device-owning worker as a supervised "
+                             "SUBPROCESS (serve/supervisor.py): heartbeat "
+                             "watchdog, SIGKILL-on-wedge, bounded respawn "
+                             "with requeue — a hard XLA/TPU crash costs a "
+                             "respawn, not the daemon")
+    parser.add_argument("--aot-cache", default=None, nargs="?", const="auto",
+                        metavar="DIR",
+                        help="arm the persistent AOT executable cache "
+                             "(utils/aot_cache.py) so a (re)started "
+                             "worker reaches first dispatch with zero "
+                             "compiles (flag alone: aot_cache/ next to "
+                             "the perf ledger; also via $MCT_AOT_CACHE)")
     parser.add_argument("--set", action="append", default=[],
                         metavar="KEY=VALUE", dest="overrides",
                         help="override a config field (repeatable; value "
@@ -124,6 +140,8 @@ def main(argv=None) -> int:
 
     overrides = {"data_root": args.data_root} if args.data_root else {}
     overrides.update(_parse_overrides(args.overrides))
+    if args.aot_cache is not None:
+        overrides["aot_cache_dir"] = args.aot_cache
     cfg = load_config(args.config, **overrides)
 
     from maskclustering_tpu.analysis import retrace_sanitizer
@@ -165,6 +183,8 @@ def main(argv=None) -> int:
         warm_baseline=args.warm_baseline,
         freeze_after_warm=not args.no_freeze,
         default_deadline_s=args.deadline,
+        isolate_worker=args.isolate_worker,
+        fault_plan_spec=args.fault_plan,
     )
     daemon.start()
     if args.host is not None:

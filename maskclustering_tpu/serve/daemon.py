@@ -90,7 +90,9 @@ class ServeDaemon:
                  warm_scenes: Tuple[str, ...] = (),
                  warm_baseline: Optional[str] = None,
                  freeze_after_warm: bool = True,
-                 default_deadline_s: float = 0.0):
+                 default_deadline_s: float = 0.0,
+                 isolate_worker: bool = False,
+                 fault_plan_spec: Optional[str] = None):
         if socket_path is None and host is None:
             raise ValueError("need a socket_path (AF_UNIX) or host/port (TCP)")
         self.cfg = cfg
@@ -100,11 +102,29 @@ class ServeDaemon:
         self.default_deadline_s = float(default_deadline_s)
         self.freeze_after_warm = freeze_after_warm
         self.warm_scenes = tuple(warm_scenes)
+        self.isolate_worker = bool(isolate_worker)
         self.queue = AdmissionQueue(capacity)
         self.router = Router(cfg, baseline_path=warm_baseline)
-        self.worker = ServeWorker(cfg, self.queue, self.router,
-                                  journal_dir=journal_dir,
-                                  prediction_root=prediction_root)
+        if isolate_worker:
+            # crash containment (serve/supervisor.py): the device owner is
+            # a supervised SUBPROCESS — a SIGKILL'd/wedged worker costs a
+            # respawn, not the daemon; warm-up (incl. the AOT-cache warm
+            # start) happens in the child, so the parent stays device-free
+            from maskclustering_tpu.serve.supervisor import WorkerSupervisor
+
+            self.worker = WorkerSupervisor(
+                cfg, self.queue, self.router,
+                journal_dir=journal_dir,
+                prediction_root=prediction_root,
+                warm_scenes=self.warm_scenes,
+                warm_baseline=warm_baseline,
+                freeze_after_warm=freeze_after_warm,
+                fault_plan_spec=fault_plan_spec,
+                on_fatal=self.request_stop)
+        else:
+            self.worker = ServeWorker(cfg, self.queue, self.router,
+                                      journal_dir=journal_dir,
+                                      prediction_root=prediction_root)
         self._lock = mct_lock("serve.ServeDaemon._lock")
         self._ids = 0
         self._stop = threading.Event()
@@ -136,8 +156,20 @@ class ServeDaemon:
         setup_compilation_cache(self.cfg.compilation_cache_dir)
         self._started_at = time.monotonic()
         self._bind()
-        self._prewarm()
-        self.worker.start()
+        if self.isolate_worker:
+            # the child owns warm-up end to end (AOT restore + warm
+            # scenes + sanitizer freeze); start() blocks until its ready
+            # line, so the daemon accepts requests only against a warm
+            # worker — same contract as the in-thread _prewarm
+            t0 = time.monotonic()
+            self.worker.start()
+            self._warmup_s = time.monotonic() - t0
+        else:
+            from maskclustering_tpu.utils import aot_cache
+
+            aot_cache.warm_start(self.cfg)
+            self._prewarm()
+            self.worker.start()
         self._acceptor = threading.Thread(  # mct-thread: abandon(daemon-lifetime thread, bounded-joined in shutdown(); the spawn/join pair spans methods, which the scope-local check cannot see)
             target=self._accept_loop, daemon=True, name="serve-acceptor")
         self._acceptor.start()
@@ -367,16 +399,13 @@ class ServeDaemon:
         from maskclustering_tpu.analysis import retrace_sanitizer
 
         retrace: Dict = {}
-        if retrace_sanitizer.enabled():
-            d = retrace_sanitizer.digest()
-            retrace = {
-                "compiles": d["compiles"],
-                "post_freeze": sum(1 for v in d["violations"]
-                                   if v["kind"] == "post_freeze"),
-                "repeats": sum(1 for v in d["violations"]
-                               if v["kind"] == "repeat"),
-                "frozen": d["frozen"],
-            }
+        if self.isolate_worker:
+            # compiles happen in the worker subprocess: its ready/bye
+            # digest is the serve-many contract's evidence, not the
+            # parent's (empty) sanitizer state
+            retrace = self.worker.child_retrace()
+        elif retrace_sanitizer.enabled():
+            retrace = retrace_sanitizer.summary()
         return {
             "config": self.cfg.config_name,
             "uptime_s": round(time.monotonic() - self._started_at, 2)
@@ -391,6 +420,7 @@ class ServeDaemon:
             "warm_buckets": [list(b) for b in w["warm_buckets"]],
             "retrace": retrace,
             "draining": self._draining.is_set(),
+            **({"worker": w["worker"]} if "worker" in w else {}),
         }
 
     def emit_serve_counters(self) -> None:

@@ -26,9 +26,18 @@ Responses (all carry ``id`` when bound to a request)::
     {"kind": "reject", "reason": "queue_full" | "deadline" |
                                  "bad_request" | "draining", ...}
     {"kind": "status", "id": ..., "state": "running" | "retrying" |
-                                           "degraded", ...}
+                                           "degraded" | "worker_crash", ...}
     {"kind": "result", "id": ..., "status": "ok" | "failed" | "skipped" |
                                             "deadline", ...}
+
+``worker_crash`` (process-isolated serving only, serve/supervisor.py):
+the device-owning worker subprocess died under this request; the request
+was requeued (``requeued: true``) for the respawned worker, or — after
+repeated crashes — the next event is a ``failed`` result with
+``error_class: "device"``. The same shapes ride the supervisor<->worker
+pipe (see ``forward_request``), plus three pipe-only kinds: ``hb``
+(heartbeat), ``ready`` (worker warm, carries the retrace/aot digest) and
+``bye`` (drain complete).
 """
 
 from __future__ import annotations
@@ -70,6 +79,10 @@ class SceneRequest:
     tag: str = ""
     admitted_at: float = 0.0       # time.monotonic() at admission
     deadline_at: float = math.inf  # monotonic deadline (inf = none)
+    # how many device workers this request has crashed (the isolated
+    # worker supervisor stamps it on requeue; the respawned worker's
+    # SceneSupervisor starts that many degradation rungs down)
+    crashes: int = 0
     send = None  # bound by the daemon: callable(event dict) -> None
 
     def expired(self) -> bool:
@@ -115,6 +128,12 @@ def parse_line(line: str) -> Dict:
             raise ProtocolError("'deadline_s' must be a number >= 0")
         if not isinstance(doc.get("resume", False), bool):
             raise ProtocolError("'resume' must be a boolean")
+        if "crashes" in doc:
+            # supervisor-internal (the pipe carries it via forward_request,
+            # which bypasses parse_line): a client must not pre-degrade its
+            # own request's ladder — or crash the handler with a non-int
+            raise ProtocolError("'crashes' is supervisor-internal and not "
+                                "accepted on the client wire")
     return doc
 
 
@@ -135,7 +154,31 @@ def build_request(doc: Dict, request_id: str) -> SceneRequest:
         tag=str(doc.get("tag", "")),
         admitted_at=now,
         deadline_at=(now + deadline) if deadline > 0 else math.inf,
+        crashes=int(doc.get("crashes", 0) or 0),
     )
+
+
+def forward_request(req: SceneRequest) -> Dict:
+    """A ``SceneRequest`` -> the wire doc the supervisor pipes to its
+    worker subprocess (serve/supervisor.py -> serve/worker_main.py).
+
+    Carries the daemon-assigned ``id`` (the child assigns none), the
+    REMAINING deadline budget (monotonic clocks do not cross process
+    boundaries), and the crash count (the child's SceneSupervisor starts
+    pre-degraded by it).
+    """
+    doc: Dict = {"op": "scene", "id": req.id, "scene": req.scene}
+    if req.synthetic is not None:
+        doc["synthetic"] = req.synthetic
+    if not math.isinf(req.deadline_at):
+        doc["deadline_s"] = max(round(req.remaining_s(), 3), 0.001)
+    if req.resume:
+        doc["resume"] = True
+    if req.tag:
+        doc["tag"] = req.tag
+    if req.crashes:
+        doc["crashes"] = req.crashes
+    return doc
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,588 @@
+"""Crash-contained serving: the device worker as a supervised subprocess.
+
+PR 10's daemon owned the device from a worker THREAD: a hard XLA/TPU
+crash (segfault, OOM-kill) took the whole daemon down, and a wedged
+native call — the recurring failure mode that kept BENCH_r04/r05 null —
+leaked the device behind an abandoned ``DeviceStallError`` thread
+forever. This module moves the device owner into a SUBPROCESS
+(serve/worker_main.py) speaking the existing JSONL protocol over stdio
+pipes, supervised from the daemon with the PR-5 watchdog vocabulary:
+
+- **heartbeats** — the child emits ``{"kind": "hb"}`` at a fixed cadence
+  from a dedicated thread; the parent re-arms a ``faults.Heartbeat``
+  (budget ``cfg.worker_heartbeat_s``) on every child line. A GIL-held
+  native hang stops every Python thread in the child, so silence IS the
+  wedge signal — and unlike the in-process watchdog, the parent can
+  actually clear it: **SIGKILL**, not an abandoned thread.
+- **bounded respawn with backoff** — ``cfg.worker_respawns`` consecutive
+  failed spawns (shared ``faults.RetryPolicy`` backoff) before the
+  supervisor declares the device unserveable and asks the daemon to stop;
+  the counter resets every time a child reaches ``ready``.
+- **requeue, neighbors untouched** — the in-flight request gets a typed
+  ``worker_crash`` status event, an ``interrupted`` outcome row in its
+  per-request RunJournal (crash-stamped attribution on disk), and goes
+  back into the admission queue for the respawned worker — pre-degraded
+  by its crash count (SceneSupervisor ``initial_rungs``). A request that
+  crashes ``MAX_REQUEST_CRASHES`` workers answers a typed ``failed``
+  result (``error_class: "device"``) instead of crash-looping the fleet.
+  Queued neighbors never notice: they are the parent's, not the child's.
+- **instant warm respawn** — the child's startup runs the persistent AOT
+  cache's ``warm_start`` plus the ordinary warm-up against the warm
+  compilation cache, then freezes the retrace sanitizer; its ``ready``
+  line carries the digest proving the respawn reached first dispatch
+  with ZERO compiles (the acceptance test pins it).
+
+The supervisor exposes ServeWorker's exact surface (start/stop/
+wait_idle/stats/latency_quantiles) so ``ServeDaemon`` swaps topologies
+with one flag; the admission queue, router, protocol and report wiring
+are unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from maskclustering_tpu import obs
+from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.serve.admission import AdmissionQueue
+from maskclustering_tpu.serve.router import Router
+from maskclustering_tpu.serve.worker import _send
+from maskclustering_tpu.utils import faults
+
+log = logging.getLogger("maskclustering_tpu")
+
+# how many device workers one request may take down before it answers a
+# typed failure instead of burning the whole respawn budget on a
+# poison-pill scene
+MAX_REQUEST_CRASHES = 2
+
+
+def _closed_safe(lines):
+    """Iterate a child's stdout, treating a closed-under-us pipe as EOF
+    (the kill path closes streams while the reader may still drain)."""
+    while True:
+        try:
+            line = next(lines)
+        except StopIteration:
+            return
+        except (OSError, ValueError):
+            return
+        yield line
+
+
+class WorkerSupervisor:
+    """Parent-side supervision of one device-owning worker subprocess."""
+
+    def __init__(self, cfg, queue: AdmissionQueue, router: Router, *,
+                 journal_dir: Optional[str] = None,
+                 prediction_root: Optional[str] = None,
+                 warm_scenes: Tuple[str, ...] = (),
+                 warm_baseline: Optional[str] = None,
+                 freeze_after_warm: bool = True,
+                 fault_plan_spec: Optional[str] = None,
+                 child_argv: Optional[list] = None,
+                 start_timeout_s: float = 600.0,
+                 poll_s: float = 0.25,
+                 on_fatal=None):
+        self.cfg = cfg
+        self.queue = queue
+        self.router = router
+        self.journal_dir = journal_dir
+        self.prediction_root = prediction_root
+        self.warm_scenes = tuple(warm_scenes)
+        self.warm_baseline = warm_baseline
+        self.freeze_after_warm = freeze_after_warm
+        self.fault_plan_spec = fault_plan_spec
+        self.child_argv = child_argv
+        self.start_timeout_s = float(start_timeout_s)
+        self.poll_s = poll_s
+        self.on_fatal = on_fatal
+        self._stop = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._lock = mct_lock("serve.WorkerSupervisor._lock")
+        self._thread: Optional[threading.Thread] = None
+        self._child: Optional[subprocess.Popen] = None
+        self._reader: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        self._heartbeat = faults.Heartbeat(
+            max(getattr(cfg, "worker_heartbeat_s", 0.0), 0.0), seam="worker")
+        # in-flight request state, written by the pump, relayed to by the
+        # reader: {"req": SceneRequest, "terminal": dict|None, "done": Event}
+        self._inflight: Optional[Dict] = None
+        self._latencies: Deque[float] = deque(maxlen=4096)
+        self._counts = {"requests": 0, "ok": 0, "failed": 0, "deadline": 0,
+                        "skipped": 0, "interrupted": 0}
+        self.respawns = 0
+        self.crashes = 0
+        self.spawns = 0
+        self.last_ready: Dict = {}
+        self.last_bye: Dict = {}
+        self._cfg_path = self._write_cfg()
+
+    # -- child plumbing ------------------------------------------------------
+
+    def _write_cfg(self) -> str:
+        d = self.journal_dir or tempfile.gettempdir()
+        os.makedirs(d, exist_ok=True)
+        fd, path = tempfile.mkstemp(prefix="worker_cfg_", suffix=".json",
+                                    dir=d)
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(self.cfg.to_json())
+        return path
+
+    def _child_cmd(self, first_spawn: bool) -> list:
+        if self.child_argv is not None:
+            return list(self.child_argv)
+        from maskclustering_tpu.analysis import retrace_sanitizer
+
+        cmd = [sys.executable, "-m", "maskclustering_tpu.serve.worker_main",
+               "--cfg-json", self._cfg_path]
+        if self.journal_dir:
+            cmd += ["--journal-dir", self.journal_dir]
+        if self.prediction_root:
+            cmd += ["--prediction-root", self.prediction_root]
+        if self.warm_scenes:
+            cmd += ["--warm", "+".join(self.warm_scenes)]
+        if self.warm_baseline:
+            cmd += ["--warm-baseline", self.warm_baseline]
+        if not self.freeze_after_warm:
+            cmd += ["--no-freeze"]
+        if retrace_sanitizer.enabled():
+            cmd += ["--retrace-sanitizer"]
+        if first_spawn and self.fault_plan_spec:
+            # drills target the FIRST worker; a respawn is the recovery
+            # under test — re-arming the plan there would crash-loop it
+            cmd += ["--fault-plan", self.fault_plan_spec]
+        return cmd
+
+    def _spawn(self, first_spawn: bool) -> bool:
+        """One child spawn; blocks (bounded) until its ready line."""
+        self._ready.clear()
+        cmd = self._child_cmd(first_spawn)
+        log.info("worker supervisor: spawning device worker%s",
+                 "" if first_spawn else f" (respawn {self.respawns})")
+        try:
+            child = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                     stdout=subprocess.PIPE, text=True,
+                                     bufsize=1)
+        except OSError:
+            log.exception("worker supervisor: spawn failed")
+            return False
+        self._child = child
+        self.spawns += 1
+        reader = threading.Thread(  # mct-thread: abandon(one reader per child, exits on the child's stdout EOF; the kill/respawn path closes the pipe, which IS the bounded join)
+            target=self._read_child, args=(child,), daemon=True,
+            name="worker-reader")
+        reader.start()
+        self._reader = reader
+        deadline = time.monotonic() + self.start_timeout_s
+        while time.monotonic() < deadline:
+            if self._ready.wait(0.25):
+                self._heartbeat.beat()
+                return True
+            if child.poll() is not None:
+                log.error("worker supervisor: child died during startup "
+                          "(rc %s)", child.returncode)
+                return False
+            if self._stop.is_set():
+                return False
+        log.error("worker supervisor: child never answered ready within "
+                  "%.0fs; killing", self.start_timeout_s)
+        self._kill_child()
+        return False
+
+    def _read_child(self, child: subprocess.Popen) -> None:
+        """Reader: heartbeats re-arm the watchdog, request events relay to
+        the in-flight client, terminal events wake the pump."""
+        stream = child.stdout
+        if stream is None:
+            return
+        try:
+            lines = iter(stream)
+        except (OSError, ValueError):
+            return
+        for line in _closed_safe(lines):
+            if not line.strip():
+                continue
+            self._heartbeat.beat()
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                log.warning("worker supervisor: unreadable child line %r",
+                            line[:200])
+                continue
+            kind = doc.get("kind")
+            if kind == "hb":
+                continue
+            if kind == "ready":
+                with self._lock:
+                    self.last_ready = doc
+                self._ready.set()
+                continue
+            if kind == "bye":
+                with self._lock:
+                    self.last_bye = doc
+                continue
+            rid = doc.get("id")
+            if rid is None:
+                continue
+            with self._lock:
+                entry = self._inflight
+            if entry is None or entry["req"].id != rid \
+                    or entry["done"].is_set():
+                log.warning("worker supervisor: dropping stray child event "
+                            "for %s", rid)
+                continue
+            if kind in ("result", "reject"):
+                entry["terminal"] = doc
+                _send(entry["req"], doc)
+                entry["done"].set()
+            else:
+                _send(entry["req"], doc)
+
+    def _kill_child(self) -> None:
+        child = self._child
+        if child is None:
+            return
+        if child.poll() is None:
+            try:
+                child.kill()
+            except OSError:
+                pass
+        try:
+            child.wait(10.0)
+        except subprocess.TimeoutExpired:
+            pass
+        for stream in (child.stdin, child.stdout):
+            try:
+                if stream:
+                    stream.close()
+            except OSError:
+                pass
+        self._child = None  # the pump's respawn trigger
+
+    # -- lifecycle (ServeWorker surface) ------------------------------------
+
+    def start(self) -> None:
+        """Spawn the first worker (blocking until warm) + the pump thread.
+
+        Raises RuntimeError when the first spawn cannot reach ready within
+        the respawn budget — a daemon that cannot own a device must fail
+        its startup loudly, not accept requests it can never serve.
+        """
+        if self._thread is not None:
+            return
+        if not self._spawn(first_spawn=True) and not self._respawn():
+            raise RuntimeError(
+                "device worker failed to start within the respawn budget; "
+                "see worker stderr above")
+        self._thread = threading.Thread(  # mct-thread: abandon(daemon-lifetime pump, bounded-joined in stop(); the spawn/join pair spans methods, which the scope-local check cannot see)
+            target=self._run, daemon=True, name="serve-supervisor")
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 60.0) -> bool:
+        """Drain: finish the in-flight request, stop the child, join."""
+        self._stop.set()
+        # the SIGTERM drain contract: the request in flight finishes in
+        # the child and answers before the child is asked to exit
+        idle = self._idle.wait(timeout_s)
+        child = self._child
+        drained = True
+        if child is not None and child.poll() is None:
+            try:
+                if child.stdin:
+                    child.stdin.write(json.dumps({"op": "shutdown"}) + "\n")
+                    child.stdin.flush()
+                    child.stdin.close()
+            except OSError:
+                pass
+            try:
+                child.wait(max(timeout_s, 5.0))
+            except subprocess.TimeoutExpired:
+                log.error("worker supervisor: child outlived the drain "
+                          "budget; SIGKILL")
+                drained = False
+        # drain the reader BEFORE closing the pipes — whether the child
+        # exited on request or on its own: the final `bye` digest (the
+        # zero-compile evidence the daemon's digest line and the ci.sh
+        # crash gate read) may still sit buffered in the pipe
+        reader = self._reader
+        if reader is not None:
+            reader.join(5.0)
+        self._kill_child()
+        t = self._thread
+        if t is not None:
+            t.join(10.0)
+        try:
+            os.unlink(self._cfg_path)  # one cfg transport file per daemon
+        except OSError:
+            pass
+        return idle and drained and (t is None or not t.is_alive())
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.queue.depth() == 0 and self._idle.is_set():
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- the pump ------------------------------------------------------------
+
+    def _child_dead(self) -> Optional[str]:
+        """A crash signal, if any: process death or heartbeat silence.
+        (``self._child is None`` means an already-handled crash awaiting
+        respawn — not a NEW crash signal.)"""
+        child = self._child
+        if child is None:
+            return None
+        if child.poll() is not None:
+            return f"worker process died (rc {child.returncode})"
+        if self._heartbeat.expired():
+            return (f"worker heartbeat silent past "
+                    f"{self._heartbeat.budget_s:.3g}s (wedged); SIGKILL")
+        return None
+
+    def _respawn(self) -> bool:
+        """Bounded respawn loop; False = budget exhausted (fatal)."""
+        policy = faults.RetryPolicy(
+            attempts=int(getattr(self.cfg, "worker_respawns", 2)) + 1,
+            base_s=self.cfg.retry_backoff_s,
+            cap_s=max(self.cfg.retry_backoff_s * 8.0, 0.0))
+        for attempt in range(1, policy.attempts + 1):
+            if self._stop.is_set():
+                return False
+            self.respawns += 1
+            obs.count("serve.worker_respawns")
+            if self._spawn(first_spawn=False):
+                return True
+            if attempt < policy.attempts:
+                delay = policy.backoff(attempt)
+                log.warning("worker supervisor: respawn failed; retrying "
+                            "in %.2fs (%d/%d)", delay, attempt + 1,
+                            policy.attempts)
+                time.sleep(delay)
+        return False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            detail = self._child_dead()
+            if detail is not None:
+                # idle crash/wedge (no request harmed): contain first
+                self._on_crash(None, detail)
+            if self._child is None:
+                # a crash was handled (here or under a request): respawn
+                if not self._respawn():
+                    self._fatal()
+                    break
+                continue
+            req = self.queue.next(timeout_s=self.poll_s)
+            if req is None:
+                continue
+            if self._stop.is_set():
+                if not self.queue.requeue(req):
+                    obs.count("serve.admission.rejects.draining")
+                    _send(req, protocol.reject(
+                        "draining", req=req,
+                        detail="daemon shutting down before dispatch"))
+                break
+            self._idle.clear()
+            try:
+                self._serve_one(req)
+            except Exception:  # noqa: BLE001 — one request, not the daemon
+                log.exception("worker supervisor: request %s crashed the "
+                              "pump", req.id)
+                _send(req, protocol.result(req, "failed",
+                                           error="internal supervisor error",
+                                           error_class="terminal"))
+            finally:
+                self._idle.set()
+
+    def _serve_one(self, req: protocol.SceneRequest) -> None:
+        obs.count("serve.requests")
+        with self._lock:
+            self._counts["requests"] += 1
+        if req.expired():
+            obs.count("serve.rejects.deadline")
+            with self._lock:
+                self._counts["deadline"] += 1
+            _send(req, protocol.reject(
+                "deadline", req=req,
+                detail=f"deadline_s={req.deadline_s:g} expired after "
+                       f"{time.monotonic() - req.admitted_at:.2f}s in queue"))
+            return
+        t0 = time.monotonic()
+        entry = {"req": req, "terminal": None, "done": threading.Event()}
+        with self._lock:
+            self._inflight = entry
+        child = self._child
+        try:
+            child.stdin.write(
+                json.dumps(protocol.forward_request(req), sort_keys=True)
+                + "\n")
+            child.stdin.flush()
+        except (OSError, ValueError, AttributeError):
+            self._crash_inflight(req, entry, "pipe to worker broke on "
+                                             "forward")
+            return
+        # wait for the terminal event, watching the child the whole time:
+        # a crash mid-request is the supervised case, not an exception (a
+        # drain keeps waiting here — the in-flight request must answer)
+        while not entry["done"].wait(0.25):
+            detail = self._child_dead()
+            if detail is not None:
+                # the child may have ANSWERED and then died: give the
+                # reader a bounded window to drain the buffered result
+                # before declaring the request crashed — a completed
+                # scene must never be re-executed (or worse, converted
+                # into a typed failure at the crash cap)
+                if entry["done"].wait(2.0):
+                    break  # result landed; the death respawns at loop top
+                if self._crash_inflight(req, entry, detail):
+                    return
+                break  # the reader won the race after all: book normally
+            if req.deadline_s > 0 and time.monotonic() - t0 > \
+                    req.deadline_s + max(self.cfg.watchdog_device_s, 30.0) \
+                    + 5.0:
+                # the child enforces the folded deadline itself; this only
+                # backstops a child that ignores it outright
+                if self._crash_inflight(req, entry,
+                                        "worker ignored the request "
+                                        "deadline"):
+                    return
+                break
+        terminal = entry["terminal"] or {}
+        with self._lock:
+            self._inflight = None
+        self._book_result(req, terminal, t0)
+
+    def _book_result(self, req: protocol.SceneRequest, terminal: Dict,
+                     t0: float) -> None:
+        status = terminal.get("status") or terminal.get("reason") or "failed"
+        key = status if status in self._counts else "failed"
+        if terminal.get("kind") == "reject":
+            key = "deadline" if status == "deadline" else "failed"
+        obs.count(f"serve.requests_{key}")
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._inflight = None
+        self._latencies.append(time.monotonic() - t0)
+        bucket = terminal.get("bucket")
+        if bucket is not None:
+            b = tuple(bucket)
+            self.router.remember(req.scene, b)
+            self.router.note_served(b)
+        if terminal.get("buckets_new"):
+            obs.count("serve.buckets_cold", int(terminal["buckets_new"]))
+
+    def _crash_inflight(self, req: protocol.SceneRequest, entry: Dict,
+                        detail: str) -> bool:
+        """The in-flight request's worker died: typed event + requeue (or
+        typed failure), then the pump's next iteration respawns. False
+        when the reader relayed the terminal event while we decided — the
+        request COMPLETED, so the caller books it normally and only the
+        worker death is contained."""
+        if entry["done"].is_set():
+            self._on_crash(None, detail)
+            return False
+        entry["done"].set()  # the reader must not relay stale events
+        with self._lock:
+            self._inflight = None
+        self._on_crash(req, detail)
+        return True
+
+    def _on_crash(self, req: Optional[protocol.SceneRequest],
+                  detail: str) -> None:
+        self.crashes += 1
+        obs.count("serve.worker_crashes")
+        log.error("worker supervisor: %s", detail)
+        self._kill_child()
+        if req is None:
+            return
+        req.crashes += 1
+        err = faults.WorkerCrashError(req.scene, detail)
+        self._journal_crash(req, err)
+        if req.crashes < MAX_REQUEST_CRASHES \
+                and not self._stop.is_set() and self.queue.requeue(req):
+            obs.count("serve.requests_requeued")
+            _send(req, protocol.status(req, "worker_crash", requeued=True,
+                                       crashes=req.crashes, detail=detail))
+            return
+        obs.count("serve.requests_failed")
+        with self._lock:
+            self._counts["failed"] += 1
+        _send(req, protocol.result(req, "failed", error=str(err),
+                                   error_class="device",
+                                   worker_crashes=req.crashes))
+
+    def _journal_crash(self, req: protocol.SceneRequest,
+                       err: Exception) -> None:
+        """Crash-stamp the request's journal: an ``interrupted`` outcome
+        row next to the child's orphaned attempt row, so replay shows
+        exactly which attempt the worker died under."""
+        if not self.journal_dir:
+            return
+        try:
+            path = os.path.join(self.journal_dir, f"{req.id}.jsonl")
+            j = faults.RunJournal(path, self.cfg.config_name,
+                                  request_id=req.id)
+            j.outcome(req.scene, "interrupted", attempt=req.crashes,
+                      error_class="device", error=str(err))
+            j.close()
+        except Exception:  # noqa: BLE001 — attribution must not sink recovery
+            log.exception("worker supervisor: crash journal row failed")
+
+    def _fatal(self) -> None:
+        log.error("worker supervisor: respawn budget exhausted — the "
+                  "device is unserveable; requesting daemon stop")
+        obs.count("serve.worker_fatal")
+        if self.on_fatal is not None:
+            try:
+                self.on_fatal()
+            except Exception:  # noqa: BLE001
+                log.exception("worker supervisor: on_fatal callback failed")
+
+    # -- introspection (ServeWorker surface) --------------------------------
+
+    def latency_quantiles(self) -> Dict[str, Optional[float]]:
+        from maskclustering_tpu.obs.report import percentile
+
+        vals = sorted(self._latencies)
+        if not vals:
+            return {"p50_s": None, "p95_s": None, "count": 0}
+        return {"p50_s": round(percentile(vals, 50), 4),
+                "p95_s": round(percentile(vals, 95), 4),
+                "count": len(vals)}
+
+    def child_retrace(self) -> Dict:
+        """The worker's retrace digest (ready/bye lines), for the daemon's
+        stats + the Serving report — compiles happen in the CHILD, so the
+        parent's own sanitizer has nothing to say here."""
+        with self._lock:
+            src = self.last_bye or self.last_ready
+        return dict(src.get("retrace") or {})
+
+    def stats(self) -> Dict:
+        with self._lock:
+            counts = dict(self._counts)
+            ready = dict(self.last_ready)
+        return {"counts": counts,
+                "latency": self.latency_quantiles(),
+                "warm_buckets": sorted(self.router.warm_buckets()),
+                "worker": {"isolated": True, "spawns": self.spawns,
+                           "respawns": self.respawns,
+                           "crashes": self.crashes,
+                           "warmup_s": ready.get("warmup_s"),
+                           "aot": ready.get("aot"),
+                           "pid": ready.get("pid")}}

@@ -266,7 +266,11 @@ class ServeWorker:
             supervisor = SceneSupervisor(
                 self._deadline_cfg(req), resume=req.resume, journal=journal,
                 on_event=on_event,
-                should_continue=lambda: not req.expired())
+                should_continue=lambda: not req.expired(),
+                # a request that crashed its previous worker(s) re-runs
+                # pre-degraded: the full configuration already proved
+                # fatal once (serve/supervisor.py stamps req.crashes)
+                initial_rungs=req.crashes)
             if journal is not None:
                 journal.begin_run()
             with obs.span("serve.request", request=req.id, scene=req.scene):

@@ -1,0 +1,214 @@
+"""The process-isolated device worker: one subprocess, one device owner.
+
+``python -m maskclustering_tpu.serve.worker_main --cfg-json PATH`` is the
+child half of the crash-containment story (serve/supervisor.py is the
+parent): the device-owning execution moved out of the daemon's process so
+a hard XLA/TPU crash (segfault, OOM-kill, wedged runtime — the failure
+mode that kept BENCH_r04/r05 null) costs one SIGKILL + respawn instead of
+the whole serving process, its admission queue and every connected client.
+
+Wire contract (line-delimited JSON over the stdio pipes; stderr carries
+logging only):
+
+- stdin  <- ``{"op": "scene", "id": ..., ...}`` (protocol.forward_request
+  shape: remaining deadline, crash count) and ``{"op": "shutdown"}``;
+  EOF == shutdown.
+- stdout -> ``{"kind": "ready", ...}`` once warm (carries the warm-up
+  wall, the AOT-cache restore stats and the retrace digest — the
+  supervisor's proof the respawn reached first dispatch with zero
+  compiles), ``{"kind": "hb"}`` heartbeats at a fixed cadence, the
+  standard per-request ``status``/``result`` events, and
+  ``{"kind": "bye", ...}`` after a drain.
+
+The heartbeat is emitted by a dedicated thread so a busy device phase
+never silences it — only a process-level wedge does (a GIL-held native
+hang stops every Python thread, which is exactly what the parent's
+``faults.Heartbeat`` budget detects; the ``wedge`` fault kind simulates
+it deterministically by silencing the emitter via ``faults.set_wedge_hook``
+before hanging).
+
+Execution semantics are ServeWorker's, verbatim — the same per-request
+SceneSupervisor, deadline folding, per-request journal and bucket
+accounting the in-thread daemon worker runs — fed by a local two-slot
+admission queue this process's stdin reader fills. One copy of the
+serving semantics, two process topologies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+log = logging.getLogger("maskclustering_tpu")
+
+
+def _retrace_digest() -> dict:
+    from maskclustering_tpu.analysis import retrace_sanitizer
+
+    if not retrace_sanitizer.enabled():
+        return {}
+    return retrace_sanitizer.summary()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="maskclustering_tpu.serve.worker_main",
+        description="device-owning serving worker subprocess (JSONL over "
+                    "stdio; spawned by serve/supervisor.py)")
+    parser.add_argument("--cfg-json", required=True,
+                        help="path to the daemon's serialized PipelineConfig "
+                             "(config.to_json) — field-for-field fidelity, "
+                             "no re-derivation drift")
+    parser.add_argument("--journal-dir", default=None)
+    parser.add_argument("--prediction-root", default=None)
+    parser.add_argument("--warm", default=None,
+                        help="+-joined scene names to run end-to-end before "
+                             "answering ready")
+    parser.add_argument("--warm-baseline", default=None,
+                        help="surface-baseline path for vocabulary warm-up")
+    parser.add_argument("--no-freeze", action="store_true",
+                        help="do not freeze the retrace sanitizer post-warm")
+    parser.add_argument("--retrace-sanitizer", action="store_true")
+    parser.add_argument("--fault-plan", default=None,
+                        help="drill spec (the supervisor passes it to the "
+                             "FIRST spawn only — a respawn is the recovery "
+                             "under test, not the drill target)")
+    parser.add_argument("--hb-interval", type=float, default=1.0)
+    parser.add_argument("--init_timeout", type=float, default=120.0)
+    parser.add_argument("--debug", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        stream=sys.stderr,  # stdout is the pipe protocol, exclusively
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s worker[%(process)d] %(levelname)s %(message)s")
+
+    from maskclustering_tpu.config import config_from_json
+
+    with open(args.cfg_json, "r", encoding="utf-8") as f:
+        cfg = config_from_json(f.read())
+
+    from maskclustering_tpu.analysis import retrace_sanitizer
+    from maskclustering_tpu.utils import faults
+
+    if args.retrace_sanitizer:
+        retrace_sanitizer.arm(True)
+    if retrace_sanitizer.enabled():
+        retrace_sanitizer.install()
+    if args.fault_plan:
+        faults.set_plan(faults.FaultPlan.from_spec(args.fault_plan))
+    faults.install_sigterm_handler()
+
+    out_lock = threading.Lock()
+
+    def emit(doc: dict) -> None:
+        with out_lock:
+            sys.stdout.write(json.dumps(doc, sort_keys=True) + "\n")
+            sys.stdout.flush()
+
+    # the heartbeat emitter: alive while the PROCESS is alive (a busy
+    # device phase keeps beating; only a process-wide wedge — or the
+    # wedge drill's hook below — silences it)
+    hb_stop = threading.Event()
+
+    def hb_loop() -> None:
+        while not hb_stop.wait(max(args.hb_interval, 0.05)):
+            emit({"kind": "hb"})
+
+    faults.set_wedge_hook(hb_stop.set)
+
+    from maskclustering_tpu.run import init_backend_or_die
+
+    init_backend_or_die(args.init_timeout,
+                        platform="cpu" if cfg.backend == "cpu" else None)
+
+    from maskclustering_tpu.utils import aot_cache
+    from maskclustering_tpu.utils.compile_cache import setup_compilation_cache
+
+    setup_compilation_cache(cfg.compilation_cache_dir)
+    t0 = time.monotonic()
+    aot_stats = aot_cache.warm_start(cfg)
+
+    from maskclustering_tpu.serve import protocol
+    from maskclustering_tpu.serve.admission import AdmissionQueue
+    from maskclustering_tpu.serve.router import Router
+    from maskclustering_tpu.serve.worker import ServeWorker
+
+    router = Router(cfg, baseline_path=args.warm_baseline)
+    queue = AdmissionQueue(capacity=2)  # the supervisor serializes; 2 = margin
+    worker = ServeWorker(cfg, queue, router,
+                         journal_dir=args.journal_dir,
+                         prediction_root=args.prediction_root)
+
+    # warm-up mirrors the daemon's _prewarm: drills are suspended so they
+    # land on the serving path, then (armed runs) the sanitizer freezes —
+    # every post-warm compile in THIS process is a violation
+    drill = faults.active_plan()
+    faults.set_plan(None)
+    try:
+        for name, tensors in router.warmup_workload():
+            worker.warm_tensors(name, tensors)
+        warm = [s for s in (args.warm or "").split("+") if s]
+        if warm:
+            from maskclustering_tpu.run import cluster_scenes
+
+            for st in cluster_scenes(cfg, warm, resume=False):
+                log.info("worker: warm scene %s -> %s", st.seq_name,
+                         st.status)
+    finally:
+        faults.set_plan(drill)
+    if not args.no_freeze and retrace_sanitizer.enabled():
+        retrace_sanitizer.freeze()
+    warmup_s = time.monotonic() - t0
+
+    worker.start()
+    hb_thread = threading.Thread(target=hb_loop, daemon=True,
+                                 name="worker-hb")  # mct-thread: abandon(bounded-joined at drain below; the spawn/join pair brackets the stdin loop)
+    hb_thread.start()
+    emit({"kind": "ready", "pid": os.getpid(),
+          "warmup_s": round(warmup_s, 2), "aot": aot_stats,
+          "retrace": _retrace_digest()})
+    log.info("worker: ready (warm-up %.1fs, aot %s)", warmup_s, aot_stats)
+
+    # the stdin loop: one request at a time from the supervisor; EOF or a
+    # shutdown op drains (finish in flight, then bye)
+    rc = 0
+    for line in sys.stdin:
+        if not line.strip():
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            log.error("worker: unreadable pipe line %r", line[:200])
+            continue
+        op = doc.get("op")
+        if op == "shutdown":
+            break
+        if op != "scene":
+            continue
+        req = protocol.build_request(doc, str(doc.get("id") or "r-local"))
+        req.send = emit
+        try:
+            queue.submit(req)
+        except Exception as e:  # noqa: BLE001 — answer, never die silently
+            emit(protocol.result(req, "failed",
+                                 error=f"worker admission: {e}",
+                                 error_class="terminal"))
+    drained = worker.stop(timeout_s=max(cfg.watchdog_device_s, 60.0) * 2)
+    hb_stop.set()
+    hb_thread.join(2.0)
+    if not drained:
+        log.error("worker: in-flight request outlived the drain budget")
+        rc = 1
+    emit({"kind": "bye", "retrace": _retrace_digest(),
+          "counts": worker.stats()["counts"]})
+    return 143 if faults.stop_requested() else rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,559 @@
+"""Persistent AOT executable cache: serialized serving programs on disk.
+
+ROADMAP item 3: the cold/warm gap is compile-dominated (106.6 s warm-up vs
+~3 s/scene steady in BENCH_r03), and every daemon restart, crashed-worker
+respawn and scarce chip-recovery window re-bought it. This module makes
+warm a DURABLE property of the deployment instead of a property of one
+process:
+
+- **export blobs** — the serving programs' ``jax.export`` round-trips
+  (StableHLO + calling convention), serialized one file per executable and
+  keyed by the retrace census coordinates ``(fn, shape bucket/avals,
+  count_dtype, donation)`` plus a jax/jaxlib/schema **version stamp**.
+  The cache lives next to PERF_LEDGER (``aot_cache/`` beside the ledger
+  path; ``$MCT_AOT_CACHE`` or ``cfg.aot_cache_dir`` override) with a
+  human-auditable ``index.json``. ``warm_start`` deserializes every entry
+  matching the current stamp + config coordinates and AOT-compiles it
+  from abstract avals (nothing materializes); the dispatch seams
+  (``models/backprojection.associate_scene``, ``parallel/batch``) then
+  run the RESTORED executable — zero Python tracing, zero lowering, and
+  the XLA compile of the restored module is itself served by the
+  persistent compilation cache after the first restore.
+- **backend-compile dedup** — enabling the cache also drops
+  ``jax_persistent_cache_min_compile_time_secs`` to 0 so EVERY serving
+  executable persists in the XLA compilation cache
+  (``utils/compile_cache.setup_compilation_cache``). Programs without an
+  export blob still trace in a fresh process, but their backend compile
+  is a cache deserialize — and the retrace sanitizer correlates those
+  compile-log events with jax's ``/jax/compilation_cache/cache_hits``
+  monitoring events and books them as **cache hits, not compiles**
+  (analysis/retrace_sanitizer.py). A warm second process therefore
+  reaches first dispatch with a ``compiles: 0`` digest.
+
+**Version invalidation**: an entry whose stamp does not match the running
+jax/jaxlib/schema versions is never restored — it is reported (and
+counted on ``aot_cache.invalidated``) and the dispatch falls back to a
+normal compile, which re-captures a fresh entry. ``prune()`` deletes the
+mismatched files.
+
+Thread-safety: the runtime registry is written by ``warm_start`` (process
+start, single-threaded) and read by the dispatch seams (worker + host-tail
+threads); captures can fire from the worker thread. One ``mct_lock``
+guards all module state.
+
+Stdlib-only at module scope (jax imports are deferred): bench.py's
+chip-free supervisor may import config (which transitively reaches
+utils/) without pulling jax pre-watchdog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+
+log = logging.getLogger("maskclustering_tpu")
+
+SCHEMA_VERSION = 1
+INDEX_NAME = "index.json"
+ENV_DIR = "MCT_AOT_CACHE"
+
+
+def _count(name: str, delta: float = 1.0) -> None:
+    try:
+        from maskclustering_tpu.obs import metrics
+
+        metrics.count(name, delta)
+    except Exception:  # noqa: BLE001 — accounting never faults the cache
+        pass
+
+
+# ---------------------------------------------------------------------------
+# key schema
+# ---------------------------------------------------------------------------
+
+
+def version_stamp() -> Dict[str, str]:
+    """The invalidation coordinates: a serialized executable is only valid
+    under the exact jax/jaxlib (serialization + compiler) versions and this
+    module's schema version that produced it."""
+    import jax
+    import jaxlib
+
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__,
+            "schema": str(SCHEMA_VERSION)}
+
+
+@dataclasses.dataclass(frozen=True)
+class AotKey:
+    """One executable's identity — the retrace census coordinates.
+
+    ``avals`` is the tuple of (shape, dtype) pairs of the call arguments
+    (the shape bucket, fully resolved: the same program at two buckets is
+    two entries); ``statics`` carries the compile-stable builder params
+    (k_max, window, thresholds, ...) that select the program variant;
+    ``count_dtype``/``donate`` are the census's extra key axes.
+    """
+
+    fn: str
+    avals: Tuple[Tuple[Tuple[int, ...], str], ...]
+    statics: Tuple[Tuple[str, str], ...]
+    count_dtype: str
+    donate: bool
+
+    def digest(self) -> str:
+        doc = {"fn": self.fn, "avals": [list(a) for a, d in self.avals],
+               "dtypes": [d for _, d in self.avals],
+               "statics": dict(self.statics),
+               "count_dtype": self.count_dtype, "donate": self.donate}
+        return hashlib.sha1(
+            json.dumps(doc, sort_keys=True).encode("utf-8")).hexdigest()[:16]
+
+    def describe(self) -> Dict:
+        return {"fn": self.fn,
+                "avals": [f"{d}{list(s)}" for s, d in self.avals],
+                "statics": dict(self.statics),
+                "count_dtype": self.count_dtype,
+                "donate": self.donate}
+
+
+def key_for(fn: str, args: Sequence, *, statics: Dict, count_dtype: str,
+            donate: bool) -> AotKey:
+    """Build an AotKey from concrete call arguments (shapes + dtypes only
+    are read — works for numpy arrays, jax arrays, and ShapeDtypeStructs)."""
+    import numpy as np
+
+    avals = []
+    for a in args:
+        shape = tuple(int(d) for d in getattr(a, "shape", ()))
+        dtype = str(np.dtype(getattr(a, "dtype", np.float32)))
+        avals.append((shape, dtype))
+    return AotKey(fn=fn, avals=tuple(avals),
+                  statics=tuple(sorted((k, str(v))
+                                       for k, v in statics.items())),
+                  count_dtype=str(count_dtype), donate=bool(donate))
+
+
+# ---------------------------------------------------------------------------
+# the on-disk cache (index + one blob per entry)
+# ---------------------------------------------------------------------------
+
+
+def default_cache_dir() -> str:
+    """``aot_cache/`` next to the perf ledger (one durable artifact home),
+    overridable via $MCT_AOT_CACHE."""
+    env = os.environ.get(ENV_DIR, "").strip()
+    if env:
+        return env
+    from maskclustering_tpu.obs.ledger import default_ledger_path
+
+    return os.path.join(os.path.dirname(default_ledger_path()) or ".",
+                        "aot_cache")
+
+
+def resolve_cache_dir(cfg) -> Optional[str]:
+    """The cache directory for ``cfg`` (None = the cache is disabled).
+
+    ``cfg.aot_cache_dir``: "" disables unless $MCT_AOT_CACHE arms it;
+    "auto" (or the env var alone) uses the default next-to-ledger home; an
+    explicit path wins outright.
+    """
+    explicit = getattr(cfg, "aot_cache_dir", "") or ""
+    if explicit and explicit != "auto":
+        return explicit
+    if explicit == "auto" or os.environ.get(ENV_DIR, "").strip():
+        return default_cache_dir()
+    return None
+
+
+class AotCache:
+    """One cache directory: ``index.json`` + ``<digest>.bin`` blobs."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = mct_lock("aot_cache.AotCache._lock")
+
+    def _index_path(self) -> str:
+        return os.path.join(self.path, INDEX_NAME)
+
+    def _read_index(self) -> Dict[str, Dict]:
+        try:
+            with open(self._index_path(), "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        return doc.get("entries", {}) if isinstance(doc, dict) else {}
+
+    def _write_index(self, entries: Dict[str, Dict]) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        tmp = self._index_path() + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"schema": SCHEMA_VERSION, "entries": entries}, f,
+                      indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, self._index_path())  # atomic: no torn index
+
+    def entries(self) -> Dict[str, Dict]:
+        with self._lock:
+            return self._read_index()
+
+    def store(self, key: AotKey, blob: bytes, *, donate_argnums=()) -> bool:
+        """Persist one serialized executable (atomic tmp+rename); returns
+        False (logged) on any disk error — the cache must never sink the
+        run that tried to warm it."""
+        digest = key.digest()
+        try:
+            os.makedirs(self.path, exist_ok=True)
+            blob_path = os.path.join(self.path, f"{digest}.bin")
+            tmp = blob_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, blob_path)
+            with self._lock:
+                entries = self._read_index()
+                entries[digest] = {
+                    **key.describe(),
+                    "stamp": version_stamp(),
+                    "bytes": len(blob),
+                    "donate_argnums": list(donate_argnums),
+                    "created": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+                }
+                self._write_index(entries)
+        except OSError:
+            log.exception("aot cache: could not store %s", key.fn)
+            return False
+        _count("aot_cache.stores")
+        log.info("aot cache: stored %s (%s, %d bytes)", key.fn, digest,
+                 len(blob))
+        return True
+
+    def lookup(self, key: AotKey) -> Optional[bytes]:
+        """The entry's blob, or None on miss/version-mismatch (mismatches
+        are counted on ``aot_cache.invalidated`` — the caller falls back
+        to a normal compile and re-captures)."""
+        digest = key.digest()
+        with self._lock:
+            meta = self._read_index().get(digest)
+        if meta is None:
+            return None
+        if meta.get("stamp") != version_stamp():
+            _count("aot_cache.invalidated")
+            log.warning("aot cache: %s entry stamped %s does not match the "
+                        "running versions %s; ignoring (prune() deletes it)",
+                        key.fn, meta.get("stamp"), version_stamp())
+            return None
+        try:
+            with open(os.path.join(self.path, f"{digest}.bin"), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def prune(self) -> int:
+        """Delete version-mismatched entries; returns how many."""
+        stamp = version_stamp()
+        removed = 0
+        with self._lock:
+            entries = self._read_index()
+            keep = {}
+            for digest, meta in entries.items():
+                if meta.get("stamp") == stamp:
+                    keep[digest] = meta
+                    continue
+                removed += 1
+                try:
+                    os.unlink(os.path.join(self.path, f"{digest}.bin"))
+                except OSError:
+                    pass
+            if removed:
+                self._write_index(keep)
+        return removed
+
+
+# ---------------------------------------------------------------------------
+# capture + restore (the jax.export round-trip)
+# ---------------------------------------------------------------------------
+
+# runtime registry of restored executables: AotKey digest -> callable.
+# Written by warm_start()/capture (worker thread), read per dispatch
+# (worker + host-tail threads) — all under _STATE_LOCK
+_STATE_LOCK = mct_lock("aot_cache._STATE_LOCK")
+_RESTORED: Dict[str, Callable] = {}
+_CAPTURED: set = set()  # key digests exported this process (avoid repeats)
+_ACTIVE: Optional[AotCache] = None
+
+
+def configure(cfg) -> Optional[AotCache]:
+    """Arm the process-wide cache for ``cfg`` (idempotent; None = disabled).
+
+    Also drops the persistent compilation cache's min-compile-time floor
+    to 0 so every serving executable persists — with the AOT cache on,
+    "everything compiled is durable" is the contract the zero-compile
+    warm start stands on.
+    """
+    global _ACTIVE
+    path = resolve_cache_dir(cfg)
+    if path is None:
+        return None
+    with _STATE_LOCK:
+        if _ACTIVE is None or _ACTIVE.path != path:
+            _ACTIVE = AotCache(path)
+        cache = _ACTIVE
+    try:
+        from maskclustering_tpu.utils.compile_cache import \
+            setup_compilation_cache
+
+        setup_compilation_cache(getattr(cfg, "compilation_cache_dir", None),
+                                min_compile_time_s=0.0)
+    except Exception:  # noqa: BLE001 — the export blobs alone still warm
+        pass
+    return cache
+
+
+def active() -> Optional[AotCache]:
+    with _STATE_LOCK:
+        return _ACTIVE
+
+
+def reset() -> None:
+    """Drop process state (test isolation); the disk cache is untouched."""
+    global _ACTIVE
+    with _STATE_LOCK:
+        _ACTIVE = None
+        _RESTORED.clear()
+        _CAPTURED.clear()
+
+
+def restored(key: AotKey) -> Optional[Callable]:
+    """The restored executable for ``key`` (the dispatch seams' query).
+
+    Counts hits/misses: a hit is a dispatch that paid ZERO tracing and
+    zero compilation; a miss falls back to the normal jit path (and is
+    only counted while a cache is armed — disarmed processes book
+    nothing).
+    """
+    with _STATE_LOCK:
+        if _ACTIVE is None:
+            return None
+        fn = _RESTORED.get(key.digest())
+    if fn is not None:
+        _count("aot_cache.hits")
+    else:
+        _count("aot_cache.misses")
+    return fn
+
+
+_PYTREES_REGISTERED = False
+
+
+def _register_pytrees() -> None:
+    """Register the serving programs' namedtuple result types with
+    jax.export (idempotent; needed on BOTH the capturing and the restoring
+    side — an Exported's pytree structure round-trips by serialized name)."""
+    global _PYTREES_REGISTERED
+    if _PYTREES_REGISTERED:
+        return
+    from jax import export as jax_export
+
+    from maskclustering_tpu.models.backprojection import SceneAssociation
+    from maskclustering_tpu.parallel.sharded import FusedStepResult
+
+    for cls in (SceneAssociation, FusedStepResult):
+        try:
+            jax_export.register_namedtuple_serialization(
+                cls, serialized_name=f"maskclustering_tpu.{cls.__name__}")
+        except ValueError:
+            pass  # already registered (re-import in tests)
+    _PYTREES_REGISTERED = True
+
+
+def _compile_blob(blob: bytes, donate_argnums=()) -> Callable:
+    """Deserialize + AOT-compile one blob into a ready executable.
+
+    The compile happens from abstract avals (nothing materializes) inside
+    the retrace sanitizer's restore window, so the wrapper's own compile
+    event books as a cache restore, not a serving compile. The returned
+    ``Compiled`` is called directly per dispatch — no jit cache involved.
+    """
+    import jax
+    from jax import export as jax_export
+
+    _register_pytrees()
+    exp = jax_export.deserialize(blob)
+    wrapped = jax.jit(exp.call,
+                      donate_argnums=tuple(donate_argnums) or None)
+    avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in exp.in_avals]
+    from maskclustering_tpu.analysis import retrace_sanitizer
+
+    with retrace_sanitizer.restore_window():
+        return wrapped.lower(*avals).compile()
+
+
+# warm_start's restore ceiling: each restore is a deserialize + one
+# backend compile (usually a persistent-cache deserialize itself), so a
+# shared cache dir that accumulated many configs' entries must not turn
+# "instant warm" back into a compile wall. $MCT_AOT_MAX_RESTORES raises
+# it; the skip is LOGGED, never silent — per-config cache dirs
+# (--aot-cache DIR) are the real fix for a polluted shared home.
+DEFAULT_MAX_RESTORES = 64
+
+
+def _cfg_statics(cfg) -> Dict[str, str]:
+    """The config-determined static coordinates (stringified exactly like
+    ``key_for``), used to fence warm_start to entries THIS config can
+    actually dispatch. Keys absent from an entry's statics (or from this
+    map — e.g. ``k_max``, which legitimately varies per shape bucket)
+    never disqualify it."""
+    mesh_desc = ("x".join(str(int(d)) for d in cfg.mesh_shape)
+                 if cfg.mesh_shape else "none")
+    return {
+        "window": str(cfg.association_window),
+        "distance_threshold": str(float(cfg.distance_threshold)),
+        "depth_trunc": str(float(cfg.depth_trunc)),
+        "few_points_threshold": str(cfg.few_points_threshold),
+        "coverage_threshold": str(float(cfg.coverage_threshold)),
+        "frame_batch": str(int(cfg.association_frame_batch)),
+        "mesh": mesh_desc,
+    }
+
+
+def warm_start(cfg) -> Dict[str, int]:
+    """Restore every valid entry for ``cfg``'s coordinates at process start.
+
+    Called by run.py, the serve daemon and the isolated worker before
+    first dispatch. Returns ``{"restored": n, "invalidated": n,
+    "failed": n}``; restored executables are installed in the runtime
+    registry, so the dispatch seams find them without compiling. Entries
+    for OTHER coordinates (a different count_dtype, the donation-off rung)
+    are left on disk untouched — they are some other config's warm start.
+    Restores are capped at ``DEFAULT_MAX_RESTORES`` newest entries
+    (``$MCT_AOT_MAX_RESTORES``), and the cap is announced when it bites.
+    """
+    stats = {"restored": 0, "invalidated": 0, "failed": 0}
+    cache = configure(cfg)
+    if cache is None:
+        return stats
+    try:
+        max_restores = int(os.environ.get("MCT_AOT_MAX_RESTORES",
+                                          DEFAULT_MAX_RESTORES))
+    except ValueError:
+        max_restores = DEFAULT_MAX_RESTORES
+    stamp = version_stamp()
+    donate = bool(cfg.donate_buffers)
+    wanted = _cfg_statics(cfg)
+    entries = sorted(cache.entries().items(),
+                     key=lambda kv: kv[1].get("created", ""), reverse=True)
+    for digest, meta in entries:
+        if meta.get("count_dtype") not in (None, cfg.count_dtype) \
+                or bool(meta.get("donate")) != donate:
+            continue
+        statics = meta.get("statics") or {}
+        if any(statics.get(k) not in (None, v) for k, v in wanted.items()):
+            # another config's coordinates (different thresholds, mesh,
+            # frame batch): restoring it would pay a compile for an
+            # executable this process can never dispatch — and could
+            # starve the restore cap. Shape-bucket axes (k_max, avals)
+            # are deliberately NOT filtered: every bucket of THIS config
+            # is wanted warmth.
+            continue
+        if stats["restored"] >= max_restores:
+            log.warning(
+                "aot cache: restore cap %d reached; remaining entries are "
+                "skipped (raise $MCT_AOT_MAX_RESTORES, prune(), or use a "
+                "per-config --aot-cache dir)", max_restores)
+            break
+        if meta.get("stamp") != stamp:
+            stats["invalidated"] += 1
+            _count("aot_cache.invalidated")
+            continue
+        try:
+            with open(os.path.join(cache.path, f"{digest}.bin"), "rb") as f:
+                blob = f.read()
+            compiled = _compile_blob(blob, meta.get("donate_argnums") or ())
+        except Exception:  # noqa: BLE001 — a bad blob must not sink startup
+            log.exception("aot cache: restore of %s (%s) failed; entry "
+                          "skipped", meta.get("fn"), digest)
+            stats["failed"] += 1
+            continue
+        with _STATE_LOCK:
+            _RESTORED[digest] = compiled
+        stats["restored"] += 1
+        _count("aot_cache.restored")
+    if any(stats.values()):
+        log.info("aot cache warm start (%s): %s", cache.path, stats)
+    return stats
+
+
+def capture(key: AotKey, jitted: Callable, args: Sequence, *,
+            donate_argnums=()) -> bool:
+    """Export + serialize + store ``jitted`` at ``args``' shapes (once per
+    key per process). Costs one re-trace/lower, no compile; failures log
+    and return False — capture is an optimization, never a correctness
+    dependency."""
+    with _STATE_LOCK:
+        cache = _ACTIVE
+        if cache is None or key.digest() in _CAPTURED:
+            return False
+        _CAPTURED.add(key.digest())
+    try:
+        from jax import export as jax_export
+
+        from maskclustering_tpu.analysis import retrace_sanitizer
+
+        _register_pytrees()
+        # the export re-lowers the program, which fires a compile-log
+        # event of its own — cache machinery, not serving surface, so it
+        # runs inside the sanitizer's restore window (otherwise the first
+        # real dispatch right after a capture would book a phantom repeat)
+        with retrace_sanitizer.restore_window():
+            exp = jax_export.export(jitted)(*args)
+        blob = exp.serialize()
+    except Exception:  # noqa: BLE001 — see docstring
+        log.exception("aot cache: export of %s failed; not cached", key.fn)
+        return False
+    ok = cache.store(key, blob, donate_argnums=donate_argnums)
+    if ok:
+        # the capturing process can serve from its own export immediately
+        # (and a restored executable is what a respawn will run, so the
+        # capture run itself pins the restored path's byte-identity)
+        try:
+            compiled = _compile_blob(blob, donate_argnums)
+        except Exception:  # noqa: BLE001 — the jit path still serves
+            log.exception("aot cache: self-restore of %s failed", key.fn)
+            return ok
+        with _STATE_LOCK:
+            _RESTORED[key.digest()] = compiled
+    return ok
+
+
+def serving_callable(key: AotKey, jitted: Callable, args: Sequence, *,
+                     donate_argnums=()) -> Callable:
+    """THE dispatch seam, shared by every serving program's call site
+    (models/backprojection.associate_scene, parallel/batch): the restored
+    executable when the registry has this key, else the jit path — with
+    its export captured (from abstract avals) so the NEXT process starts
+    warm. Callers guard with ``active()`` to keep the disarmed hot path
+    free of key construction."""
+    fn = restored(key)
+    if fn is not None:
+        return fn
+    import jax
+
+    capture(key, jitted,
+            [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args],
+            donate_argnums=donate_argnums)
+    return jitted
+
+
+def stats_snapshot() -> Dict[str, int]:
+    """Process-local registry sizes (the report's cache digest source is
+    the obs counters; this is for CLIs/tests)."""
+    with _STATE_LOCK:
+        return {"restored": len(_RESTORED), "captured": len(_CAPTURED),
+                "active": int(_ACTIVE is not None)}

@@ -24,6 +24,7 @@ from typing import Optional, Set, Tuple
 log = logging.getLogger("maskclustering_tpu")
 
 _CACHE_APPLIED: Optional[str] = None
+_CACHE_MIN_S: Optional[float] = None
 _SEEN_BUCKETS: Set[Tuple] = set()
 
 
@@ -33,28 +34,35 @@ def default_cache_dir() -> str:
         os.path.join(os.path.expanduser("~"), ".cache", "maskclustering_tpu", "xla"))
 
 
-def setup_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+def setup_compilation_cache(cache_dir: Optional[str] = None, *,
+                            min_compile_time_s: Optional[float] = None
+                            ) -> Optional[str]:
     """Enable JAX's persistent compilation cache (idempotent).
 
     cache_dir: explicit directory, None for the default, "" to disable.
-    Returns the directory in effect (or None when disabled).
+    ``min_compile_time_s``: the persistence floor — None keeps the 1 s
+    default (sub-second CPU test compiles cost more to serialize than to
+    redo); the AOT cache (utils/aot_cache.py) lowers it to 0 so EVERY
+    serving executable persists, which is what the zero-compile
+    cross-process warm start stands on. Returns the directory in effect
+    (or None when disabled).
     """
-    global _CACHE_APPLIED
+    global _CACHE_APPLIED, _CACHE_MIN_S
     if cache_dir == "":
         return None
     path = os.path.expanduser(cache_dir or default_cache_dir())
-    if _CACHE_APPLIED == path:
+    min_s = 1.0 if min_compile_time_s is None else float(min_compile_time_s)
+    if _CACHE_APPLIED == path and _CACHE_MIN_S == min_s:
         return path
     os.makedirs(path, exist_ok=True)
 
     import jax
 
     jax.config.update("jax_compilation_cache_dir", path)
-    # cache every compile that takes >= 1 s; sub-second CPU test compiles
-    # stay out of the cache (they cost more to serialize than to redo)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_s)
     _CACHE_APPLIED = path
-    log.info("persistent compilation cache at %s", path)
+    _CACHE_MIN_S = min_s
+    log.info("persistent compilation cache at %s (floor %.3gs)", path, min_s)
     return path
 
 

@@ -110,6 +110,24 @@ class InjectedFault(RuntimeError):
         super().__init__(msg)
 
 
+class WorkerCrashError(RuntimeError):
+    """The device-owning worker subprocess died under a request.
+
+    Raised (synthesized) by the serving worker supervisor
+    (serve/supervisor.py) when a child is SIGKILLed on a missed heartbeat
+    or dies outright (segfault, OOM-kill, a ``crash`` fault drill).
+    Classified ``device`` — the chip/runtime, not the scene, is the story
+    — so the requeue/ladder machinery composes with it like any other
+    device-class failure.
+    """
+
+    def __init__(self, scene: Optional[str], detail: str):
+        self.scene = scene
+        self.detail = detail
+        super().__init__(
+            f"device worker crashed under scene {scene!r}: {detail}")
+
+
 # exception type names that mean "the device/runtime is sick" without
 # importing jaxlib here (the names are stable across jaxlib versions)
 _DEVICE_ERROR_NAMES = frozenset({
@@ -129,7 +147,7 @@ _TERMINAL_TYPES = (ValueError, TypeError, KeyError, IndexError,
 
 def classify_error(exc: BaseException) -> str:
     """Stable error class for retry/degradation decisions (ERROR_CLASSES)."""
-    if isinstance(exc, DeviceStallError):
+    if isinstance(exc, (DeviceStallError, WorkerCrashError)):
         return "device"
     if isinstance(exc, InjectedFault):
         return "retryable" if exc.retryable else "terminal"
@@ -390,6 +408,14 @@ _KIND_DEFAULTS = {
     "stall": ("device", 1),
     "terminal": ("device", None),
     "sigterm": ("load", 1),
+    # crash-containment drills (serve/supervisor.py): "crash" SIGKILLs
+    # the process executing the seam (in the isolated serving worker, a
+    # real hard kill of the device-owning subprocess); "wedge" simulates
+    # the GIL-held native hang no in-process watchdog can clear — it
+    # silences the worker's heartbeat (set_wedge_hook) and blocks the
+    # seam UNBOUNDED, so only the supervisor's SIGKILL ends it
+    "crash": ("device", 1),
+    "wedge": ("device", 1),
 }
 
 
@@ -406,6 +432,8 @@ class FaultPlan:
         fail:scene3.export:1  # one export failure
         terminal:scene6       # a non-retryable failure (classification)
         sigterm:scene1.load   # one real SIGTERM to this process at the seam
+        crash:scene7.device   # one real SIGKILL to the executing process
+        wedge:scene8.device   # heartbeat-silent unbounded hang (SIGKILL cures)
 
     ``stall`` sleeps ``stall_s`` at the seam — under an armed watchdog the
     caller sees ``DeviceStallError`` within its budget; without one the
@@ -474,6 +502,19 @@ class FaultPlan:
                 time.sleep(self.stall_s)
             elif e.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
+            elif e.kind == "crash":
+                # the hard-failure drill: SIGKILL the process executing
+                # this seam (no handler, no cleanup — the observed XLA
+                # segfault/OOM-kill class). Under the isolated serving
+                # worker this kills the SUBPROCESS; the supervisor
+                # respawns and requeues.
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif e.kind == "wedge":
+                hook = wedge_hook()
+                if hook is not None:
+                    hook()  # silence the worker's heartbeat emitter
+                while True:  # unbounded: only an external SIGKILL ends it
+                    time.sleep(60.0)
             elif e.kind == "terminal":
                 raise InjectedFault(
                     f"injected terminal fault at {seam} seam of {scene}",
@@ -498,6 +539,22 @@ class FaultPlan:
 _PLAN: Optional[FaultPlan] = None
 _PLAN_LOADED = False
 _PLAN_LOCK = mct_lock("faults._PLAN_LOCK")
+_WEDGE_HOOK: Optional[Callable] = None
+
+
+def set_wedge_hook(fn: Optional[Callable]) -> None:
+    """Register the action a ``wedge`` fault performs before hanging —
+    the isolated serving worker (serve/worker_main.py) installs its
+    heartbeat-silencer here so a wedge drill looks exactly like the
+    GIL-held native hang it simulates."""
+    global _WEDGE_HOOK
+    with _PLAN_LOCK:
+        _WEDGE_HOOK = fn
+
+
+def wedge_hook() -> Optional[Callable]:
+    with _PLAN_LOCK:
+        return _WEDGE_HOOK
 
 
 def active_plan() -> Optional[FaultPlan]:

@@ -42,23 +42,34 @@
 #    regression — override the threshold with MCT_REGRESS_THRESHOLD).
 #
 # 3d. runs the serve daemon smoke (distinct exit code 7): spawns a
-#    retrace-sanitizer-armed mct-serve daemon subprocess, warms two tiny
-#    shape buckets, fires a small mixed-bucket burst through
-#    scripts/load_gen.py, SIGTERMs it, and asserts a clean drain (exit
-#    143, final digest line) with ZERO post-warm compiles — the
+#    retrace-sanitizer-armed mct-serve daemon subprocess (AOT executable
+#    cache armed — the capture half of the round-trip rides every smoke),
+#    warms two tiny shape buckets, fires a small mixed-bucket burst
+#    through scripts/load_gen.py, SIGTERMs it, and asserts a clean drain
+#    (exit 143, final digest line) with ZERO post-warm compiles — the
 #    compile-once/serve-many contract, end to end (MCT_SERVE_SMOKE=0
 #    skips). FATAL. The full concurrent soak is slow-marked in
 #    tests/test_serve.py.
+#
+# 3e. runs the crash-respawn smoke (distinct exit code 8): the same
+#    daemon with the PROCESS-ISOLATED device worker and a scripted
+#    SIGKILL under a request (crash:lg-b.device:1). Asserts the daemon
+#    survives, the request is requeued with a typed worker_crash status
+#    and answers ok, neighbors are untouched, and the RESPAWNED worker's
+#    digest books zero compiles (persistent AOT cache + compilation-cache
+#    warm start) — the crash-containment contract, end to end
+#    (MCT_SERVE_CRASH_SMOKE=0 skips). FATAL.
 #
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
 # Exits non-zero on test failures (1), a fault-matrix failure (3), an
 # mct-check finding or ruff violation (4), a concurrency-family finding
-# (5), a retrace-family finding (6), a serve-smoke failure (7), or a
-# perf regression (2), so it gates correctness, fault tolerance, the
-# invariants, thread safety, the compile surface, the serving layer AND
-# the trajectory.
+# (5), a retrace-family finding (6), a serve-smoke failure (7), a
+# crash-respawn smoke failure (8), or a perf regression (2), so it gates
+# correctness, fault tolerance, the invariants, thread safety, the
+# compile surface, the serving layer, crash containment AND the
+# trajectory.
 # Every gate still RUNS after a failure, but the exit code is the FIRST
 # failing gate's — triage by exit code points at the right gate.
 set -u -o pipefail
@@ -136,6 +147,22 @@ if [ "${MCT_SERVE_SMOKE:-1}" != "0" ]; then
         echo "ci: serve daemon smoke FAILED (daemon wedged, a request" \
              "failed, or the retrace sanitizer booked post-warm compiles)" >&2
         fail 7
+    fi
+fi
+
+if [ "${MCT_SERVE_CRASH_SMOKE:-1}" != "0" ]; then
+    echo "== ci: crash-respawn smoke (isolated worker, SIGKILL drill + zero-compile respawn, <420s) =="
+    # the crash-containment gate: a real SIGKILL of the device-owning
+    # worker subprocess under a request must cost a respawn + requeue,
+    # not the daemon — and the respawned worker must reach first dispatch
+    # warm off the persistent AOT/compilation caches (zero compiles)
+    if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+            python scripts/load_gen.py --smoke --crash-drill --requests 4 \
+            --concurrency 2 --no-ledger; then
+        echo "ci: crash-respawn smoke FAILED (daemon died with its worker," \
+             "the request was not requeued, or the respawned worker" \
+             "recompiled)" >&2
+        fail 8
     fi
 fi
 

@@ -84,6 +84,7 @@ def run_load(address, *, requests: int, concurrency: int, buckets: int,
     results: List[Dict] = []
     latencies: List[float] = []
     rejects: Dict[str, int] = {}
+    crash_events = [0]  # worker_crash status events seen (crash drills)
     lock = threading.Lock()
 
     def client_loop() -> None:
@@ -98,6 +99,11 @@ def run_load(address, *, requests: int, concurrency: int, buckets: int,
                     terminal, _statuses, latency = client.run_scene(
                         name, synthetic=params, deadline_s=deadline_s,
                         resume=resume, tag=f"lg-{i:04d}")
+                    ncrash = sum(1 for s in _statuses
+                                 if s.get("state") == "worker_crash")
+                    if ncrash:
+                        with lock:
+                            crash_events[0] += ncrash
                     if terminal.get("kind") == "reject" \
                             and terminal.get("reason") == "queue_full" \
                             and attempts < 10:
@@ -155,6 +161,7 @@ def run_load(address, *, requests: int, concurrency: int, buckets: int,
         "max_attempts": max((r.get("attempts", 1) for r in results),
                             default=0),
         "max_rung": max((r.get("rung", 0) for r in results), default=0),
+        "worker_crash_events": crash_events[0],
     }
 
 
@@ -207,12 +214,23 @@ def run_smoke(args) -> int:
     cmd = [sys.executable, "-m", "maskclustering_tpu.serve",
            "--config", "scannet", "--socket", sock, "--data_root", tmp,
            "--capacity", "4", "--retrace-sanitizer",
+           # the AOT executable cache rides every smoke: capture on the
+           # cold path, restore on respawns/restarts (the crash drill
+           # asserts the cross-process half)
+           "--aot-cache", os.path.join(tmp, "aot"),
            "--obs_events", events, "--warm", "+".join(warm_names),
            "--journal-dir", os.path.join(tmp, "journals")]
     for kv in SMOKE_CONFIG_SETS:
         cmd += ["--set", kv]
-    if args.fault_plan:
-        cmd += ["--fault-plan", args.fault_plan]
+    fault_plan = args.fault_plan
+    if args.crash_drill and not fault_plan:
+        # one SIGKILL of the device worker under the first B-bucket
+        # request: the supervisor must respawn, requeue and finish warm
+        fault_plan = "crash:lg-b.device:1"
+    if args.isolate_worker or args.crash_drill:
+        cmd += ["--isolate-worker", "--set", "worker_heartbeat_s=30"]
+    if fault_plan:
+        cmd += ["--fault-plan", fault_plan]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     log(f"smoke: starting daemon: {' '.join(cmd)}")
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, cwd=REPO_ROOT,
@@ -255,6 +273,7 @@ def run_smoke(args) -> int:
         verdict["retrace_compiles"] = retrace.get("compiles")
         verdict["retrace_repeats"] = retrace.get("repeats")
         verdict["retrace_post_freeze"] = retrace.get("post_freeze")
+        verdict["retrace_cache_hits"] = retrace.get("cache_hits")
         if retrace.get("post_freeze"):
             failures.append(f"{retrace['post_freeze']} post-warm compile(s) "
                             f"— the serve-many contract broke")
@@ -263,6 +282,26 @@ def run_smoke(args) -> int:
                             f"jit-cache thrash in the daemon")
         if not retrace.get("frozen"):
             failures.append("retrace sanitizer never froze after warm-up")
+        worker = digest.get("worker") or {}
+        if worker:
+            verdict["worker_crashes"] = worker.get("crashes")
+            verdict["worker_respawns"] = worker.get("respawns")
+        if args.crash_drill:
+            # the crash-containment contract, end to end: a real SIGKILL
+            # under a request, a respawn, a typed status on the wire, and
+            # a respawned worker that reached first dispatch warm
+            if not worker.get("crashes"):
+                failures.append("crash drill: no worker crash was recorded")
+            if not worker.get("respawns"):
+                failures.append("crash drill: worker never respawned")
+            if verdict.get("worker_crash_events", 0) < 1:
+                failures.append("crash drill: no client saw a typed "
+                                "worker_crash status event")
+            if retrace.get("compiles", 0) != 0:
+                failures.append(
+                    f"respawned worker booked {retrace.get('compiles')} "
+                    f"compile(s) — the AOT/persistent-cache warm start "
+                    f"did not deliver a zero-compile respawn")
     if verdict["ok"] != args.requests:
         failures.append(f"only {verdict['ok']}/{args.requests} requests "
                         f"answered ok")
@@ -319,6 +358,15 @@ def main(argv=None) -> int:
                         help="self-contained CI smoke: spawn a daemon "
                              "subprocess, assert clean drain + zero "
                              "post-warm compiles")
+    parser.add_argument("--isolate-worker", action="store_true",
+                        help="smoke: run the daemon with the process-"
+                             "isolated device worker (serve/supervisor.py)")
+    parser.add_argument("--crash-drill", action="store_true",
+                        help="smoke: SIGKILL the isolated worker under a "
+                             "request (crash:lg-b.device:1 unless "
+                             "--fault-plan overrides) and assert respawn, "
+                             "requeue, all-ok, and a ZERO-compile "
+                             "respawned worker (implies --isolate-worker)")
     parser.add_argument("--smoke-startup-s", type=float, default=180.0,
                         help="smoke: max seconds for daemon warm-up "
                              "before first request")

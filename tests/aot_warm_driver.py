@@ -1,0 +1,59 @@
+"""Subprocess driver for the cross-process AOT warm-start unit
+(tests/test_aot_cache.py): run ONE tiny scene's device+host phases with
+the retrace sanitizer + AOT cache armed, print one JSON digest line.
+
+Usage: python tests/aot_warm_driver.py AOT_DIR XLA_DIR DATA_ROOT
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    aot_dir, xla_dir, data_root = sys.argv[1:4]
+    from maskclustering_tpu.analysis import retrace_sanitizer
+
+    retrace_sanitizer.install()
+    from maskclustering_tpu.config import load_config
+    from maskclustering_tpu.models.pipeline import (run_scene_device,
+                                                    run_scene_host)
+    from maskclustering_tpu.utils import aot_cache
+    from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+
+    cfg = load_config("scannet").replace(
+        data_root=data_root, config_name="aotwarm", step=1,
+        distance_threshold=0.05, mask_pad_multiple=32,
+        aot_cache_dir=aot_dir, compilation_cache_dir=xla_dir)
+    warm = aot_cache.warm_start(cfg)
+    t = to_scene_tensors(make_scene(num_boxes=3, num_frames=6,
+                                    image_hw=(48, 64), spacing=0.08,
+                                    seed=11))
+    handoff = run_scene_device(t, cfg, seq_name="aot-probe")
+    result = run_scene_host(handoff, cfg, export=False)
+    d = retrace_sanitizer.digest()
+    print(json.dumps({
+        "warm": warm,
+        "compiles": d["compiles"],
+        "raw_compiles": d["raw_compiles"],
+        "cache_hits": d["cache_hits"],
+        "aot_restores": d["aot_restores"],
+        "violations": len(d["violations"]),
+        "num_objects": len(result.objects.point_ids_list),
+        "assignment_sum": int(result.assignment.sum()),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,241 @@
+"""Persistent AOT executable cache (utils/aot_cache.py; ROADMAP item 3).
+
+Unit tier: key schema stability, index/blob round-trip, version-stamp
+invalidation + prune, capture -> restore byte identity on a tiny program,
+and the association dispatch seam serving the restored executable.
+
+Acceptance tier: the cross-process warm start — a SECOND process against
+the same cache directories reaches first dispatch with a ``compiles: 0``
+retrace digest (every compile-log event either served by the persistent
+compilation cache or replaced outright by a restored export), identical
+results, and a version-stamp mismatch falls back to a clean compile with
+the miss counted.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maskclustering_tpu.config import load_config
+from maskclustering_tpu.utils import aot_cache
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(data_root=str(tmp_path / "data"), config_name="aot",
+                step=1, distance_threshold=0.05, mask_pad_multiple=32,
+                aot_cache_dir=str(tmp_path / "aot"))
+    base.update(kw)
+    return load_config("scannet").replace(**base)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    aot_cache.reset()
+    yield
+    aot_cache.reset()
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+
+def test_key_schema_digest_stability():
+    sds = [jax.ShapeDtypeStruct((4, 8), jnp.float32),
+           jax.ShapeDtypeStruct((8,), jnp.int16)]
+    k1 = aot_cache.key_for("fn", sds, statics={"k_max": 63, "window": 1},
+                           count_dtype="bf16", donate=True)
+    k2 = aot_cache.key_for("fn", sds, statics={"window": 1, "k_max": 63},
+                           count_dtype="bf16", donate=True)
+    assert k1.digest() == k2.digest()  # statics order-insensitive
+    # every census axis changes the key
+    for other in (
+        aot_cache.key_for("fn2", sds, statics={"k_max": 63, "window": 1},
+                          count_dtype="bf16", donate=True),
+        aot_cache.key_for("fn", sds[:1], statics={"k_max": 63, "window": 1},
+                          count_dtype="bf16", donate=True),
+        aot_cache.key_for("fn", sds, statics={"k_max": 127, "window": 1},
+                          count_dtype="bf16", donate=True),
+        aot_cache.key_for("fn", sds, statics={"k_max": 63, "window": 1},
+                          count_dtype="int8", donate=True),
+        aot_cache.key_for("fn", sds, statics={"k_max": 63, "window": 1},
+                          count_dtype="bf16", donate=False),
+    ):
+        assert other.digest() != k1.digest()
+    desc = k1.describe()
+    assert desc["fn"] == "fn" and desc["count_dtype"] == "bf16"
+    assert desc["avals"] == ["float32[4, 8]", "int16[8]"]
+
+
+def test_store_lookup_version_invalidation_and_prune(tmp_path):
+    cache = aot_cache.AotCache(str(tmp_path / "c"))
+    key = aot_cache.key_for(
+        "fn", [jax.ShapeDtypeStruct((2,), jnp.float32)],
+        statics={}, count_dtype="bf16", donate=False)
+    assert cache.lookup(key) is None
+    assert cache.store(key, b"blob-bytes", donate_argnums=(1,))
+    assert cache.lookup(key) == b"blob-bytes"
+    meta = cache.entries()[key.digest()]
+    assert meta["stamp"] == aot_cache.version_stamp()
+    assert meta["donate_argnums"] == [1]
+
+    # a mismatched stamp (a jax upgrade) invalidates cleanly: lookup says
+    # miss, the blob stays until prune() deletes it
+    idx_path = os.path.join(cache.path, aot_cache.INDEX_NAME)
+    with open(idx_path) as f:
+        doc = json.load(f)
+    doc["entries"][key.digest()]["stamp"]["jax"] = "0.0.0-other"
+    with open(idx_path, "w") as f:
+        json.dump(doc, f)
+    assert cache.lookup(key) is None
+    assert os.path.exists(os.path.join(cache.path, f"{key.digest()}.bin"))
+    assert cache.prune() == 1
+    assert cache.entries() == {}
+    assert not os.path.exists(os.path.join(cache.path, f"{key.digest()}.bin"))
+
+
+def test_resolve_cache_dir_policy(tmp_path, monkeypatch):
+    monkeypatch.delenv(aot_cache.ENV_DIR, raising=False)
+    cfg = _cfg(tmp_path, aot_cache_dir="")
+    assert aot_cache.resolve_cache_dir(cfg) is None  # off by default
+    assert aot_cache.warm_start(cfg) == {"restored": 0, "invalidated": 0,
+                                         "failed": 0}
+    explicit = _cfg(tmp_path, aot_cache_dir=str(tmp_path / "x"))
+    assert aot_cache.resolve_cache_dir(explicit) == str(tmp_path / "x")
+    # "auto" and the env var land next to the perf ledger (hermetic via
+    # the conftest MCT_PERF_LEDGER tmp redirect)
+    auto = aot_cache.resolve_cache_dir(_cfg(tmp_path, aot_cache_dir="auto"))
+    assert auto == os.path.join(
+        os.path.dirname(os.environ["MCT_PERF_LEDGER"]), "aot_cache")
+    monkeypatch.setenv(aot_cache.ENV_DIR, str(tmp_path / "envdir"))
+    assert aot_cache.resolve_cache_dir(cfg) == str(tmp_path / "envdir")
+
+
+def test_capture_restore_byte_identity_and_warm_start(tmp_path):
+    cfg = _cfg(tmp_path)
+    assert aot_cache.configure(cfg) is not None
+
+    f = jax.jit(lambda x, y: jnp.sin(x) @ y + 1.0)
+    sds = [jax.ShapeDtypeStruct((16, 16), jnp.float32)] * 2
+    key = aot_cache.key_for("tiny", sds, statics={"k": 1},
+                           count_dtype=cfg.count_dtype,
+                           donate=bool(cfg.donate_buffers))
+    assert aot_cache.restored(key) is None  # cold miss
+    assert aot_cache.capture(key, f, sds)
+    restored = aot_cache.restored(key)  # capture self-restores
+    assert restored is not None
+    x = jnp.ones((16, 16)), jnp.full((16, 16), 2.0, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(restored(*x)),
+                                  np.asarray(f(*x)))
+
+    # a "fresh process" (registry reset): warm_start reinstalls from disk
+    aot_cache.reset()
+    stats = aot_cache.warm_start(cfg)
+    assert stats == {"restored": 1, "invalidated": 0, "failed": 0}
+    again = aot_cache.restored(key)
+    assert again is not None
+    np.testing.assert_array_equal(np.asarray(again(*x)), np.asarray(f(*x)))
+
+    # other-coordinate entries are left alone (a different count_dtype is
+    # some other config's warm start)
+    aot_cache.reset()
+    stats = aot_cache.warm_start(cfg.replace(count_dtype="int8"))
+    assert stats["restored"] == 0
+
+
+@pytest.mark.slow
+def test_association_seam_serves_restored_executable(tmp_path):
+    """The dispatch seam end to end, in process: first call compiles +
+    captures, second call runs the RESTORED executable — byte-identical
+    SceneAssociation, and the aot hit counter books it."""
+    from maskclustering_tpu.models.backprojection import associate_scene_tensors
+    from maskclustering_tpu.utils.synthetic import make_scene, to_scene_tensors
+    from maskclustering_tpu import obs
+
+    cfg = _cfg(tmp_path)
+    aot_cache.configure(cfg)
+
+    def run_once():
+        t = to_scene_tensors(make_scene(num_boxes=3, num_frames=6,
+                                        image_hw=(48, 64), spacing=0.08,
+                                        seed=11))
+        return associate_scene_tensors(t, cfg, k_max=63)
+
+    first = run_once()
+    hits_before = obs.registry().snapshot()["counters"].get(
+        "aot_cache.hits", 0)
+    second = run_once()
+    hits_after = obs.registry().snapshot()["counters"].get(
+        "aot_cache.hits", 0)
+    assert hits_after > hits_before, "second dispatch must hit the cache"
+    for name in ("mask_of_point", "first_id", "last_id", "mask_valid",
+                 "boundary"):
+        np.testing.assert_array_equal(np.asarray(getattr(first, name)),
+                                      np.asarray(getattr(second, name)))
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the cross-process warm start (ROADMAP item 3)
+# ---------------------------------------------------------------------------
+
+
+def _run_driver(tmp_path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tests",
+                                      "aot_warm_driver.py"),
+         str(tmp_path / "aot"), str(tmp_path / "xla"),
+         str(tmp_path / "data")],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_cross_process_warm_start_zero_compiles_and_invalidation(tmp_path):
+    """The item-3 acceptance, one cold subprocess amortized three ways:
+
+    - process 2 against the same cache dirs reaches first dispatch
+      WITHOUT recompiling (digest ``compiles: 0`` — restored export +
+      persistent-compilation-cache hits), identical results;
+    - the cold process captured the association program's export under
+      its census coordinates;
+    - a version-stamp mismatch invalidates cleanly in process 3: the
+      entry is skipped + counted, the run falls back to a compile path
+      (still cache-hit-served, never a crash), results unchanged.
+    """
+    p1 = _run_driver(tmp_path)
+    assert p1["compiles"] > 0 and p1["cache_hits"] == 0  # honest cold start
+    assert p1["violations"] == 0
+    # the cold process captured the association export
+    index = json.load(open(tmp_path / "aot" / aot_cache.INDEX_NAME))
+    fns = {e["fn"] for e in index["entries"].values()}
+    assert "_associate_scene_impl" in fns
+
+    p2 = _run_driver(tmp_path)
+    assert p2["compiles"] == 0, p2
+    assert p2["warm"]["restored"] >= 1
+    assert p2["cache_hits"] > 0
+    assert p2["violations"] == 0
+    # same answer either way (restored executable + cache-served builds)
+    assert p2["num_objects"] == p1["num_objects"]
+    assert p2["assignment_sum"] == p1["assignment_sum"]
+
+    # version-stamp mismatch: invalidated + clean fallback, no crash
+    idx_path = tmp_path / "aot" / aot_cache.INDEX_NAME
+    doc = json.load(open(idx_path))
+    for entry in doc["entries"].values():
+        entry["stamp"]["jax"] = "0.0.0-mismatch"
+    with open(idx_path, "w") as f:
+        json.dump(doc, f)
+    p3 = _run_driver(tmp_path)
+    assert p3["warm"]["restored"] == 0
+    assert p3["warm"]["invalidated"] >= 1
+    assert p3["violations"] == 0
+    assert p3["assignment_sum"] == p1["assignment_sum"]

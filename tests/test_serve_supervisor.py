@@ -1,0 +1,282 @@
+"""Crash-contained serving (serve/supervisor.py + serve/worker_main.py).
+
+Stub tier (tests/worker_stub.py — the pipe protocol in milliseconds, no
+jax): heartbeat-silence SIGKILL, crash -> typed worker_crash status ->
+requeue -> respawned-worker ok, poison-pill bounded failure, idle-crash
+respawn, drain with a request in flight, and the crash/wedge FaultPlan
+grammar + WorkerCrashError classification.
+
+Acceptance tier (one real worker subprocess pair on the tiny 6-frame
+bucket): a scripted ``crash:...device`` SIGKILLs the device-owning child
+under an exporting request; the supervisor respawns, requeues, the
+respawned worker answers ok with artifacts byte-identical to a one-shot
+run, its ready digest books ZERO compiles (AOT + persistent-cache warm
+start), and the per-request journal carries the crash-stamped
+``interrupted`` row next to the final ok.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu.config import load_config
+from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.serve.admission import AdmissionQueue
+from maskclustering_tpu.serve.router import Router
+from maskclustering_tpu.serve.supervisor import (MAX_REQUEST_CRASHES,
+                                                 WorkerSupervisor)
+from maskclustering_tpu.utils import faults
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB = os.path.join(REPO_ROOT, "tests", "worker_stub.py")
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(data_root=str(tmp_path), config_name="sup", step=1,
+                distance_threshold=0.05, mask_pad_multiple=32,
+                worker_heartbeat_s=1.0, retry_backoff_s=0.05)
+    base.update(kw)
+    return load_config("scannet").replace(**base)
+
+
+class _Client:
+    """Collects one request's events; done on the terminal one."""
+
+    def __init__(self):
+        self.events = []
+        self.done = threading.Event()
+
+    def send(self, ev):
+        self.events.append(ev)
+        if ev.get("kind") in ("result", "reject"):
+            self.done.set()
+
+    @property
+    def terminal(self):
+        return self.events[-1] if self.events else None
+
+    def states(self):
+        return [e.get("state") for e in self.events
+                if e.get("kind") == "status"]
+
+
+def _submit(queue, scene, i, **kw):
+    client = _Client()
+    req = protocol.build_request({"op": "scene", "scene": scene, **kw},
+                                 f"r-{i:06d}")
+    req.send = client.send
+    queue.submit(req)
+    return client
+
+
+@pytest.fixture()
+def stub_sup(tmp_path, monkeypatch):
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    cfg = _cfg(tmp_path)
+    queue = AdmissionQueue(8)
+    sup = WorkerSupervisor(cfg, queue, Router(cfg),
+                           journal_dir=str(tmp_path / "journals"),
+                           child_argv=[sys.executable, STUB],
+                           start_timeout_s=15.0, poll_s=0.05)
+    sup.start()
+    yield sup, queue
+    sup.stop(timeout_s=10.0)
+
+
+def test_stub_serves_and_drains_in_flight(stub_sup):
+    sup, queue = stub_sup
+    c = _submit(queue, "stub-ok", 1)
+    assert c.done.wait(10.0) and c.terminal["status"] == "ok"
+    # the pump books counts after the reader answers the client: sync on
+    # idle before reading them
+    assert sup.wait_idle(5.0)
+    assert sup.stats()["counts"]["ok"] == 1
+    assert sup.last_ready.get("kind") == "ready"
+    # drain with a slow request in flight: it still answers
+    slow = _submit(queue, "stub-slow", 2)
+    time.sleep(0.3)
+    assert sup.stop(timeout_s=15.0)
+    assert slow.done.wait(5.0) and slow.terminal["status"] == "ok"
+
+
+def test_stub_crash_respawns_requeues_and_pre_degrades(stub_sup):
+    """A SIGKILL mid-request: typed worker_crash status (requeued), the
+    respawned worker serves it pre-degraded (crashes -> rung), neighbors
+    queued behind are untouched, and the journal carries the crash row."""
+    sup, queue = stub_sup
+    crash = _submit(queue, "stub-crash", 1)
+    neighbor = _submit(queue, "stub-ok", 2)
+    assert crash.done.wait(30.0), "crashed request never answered"
+    assert neighbor.done.wait(30.0), "neighbor never answered"
+    assert "worker_crash" in crash.states()
+    crash_ev = next(e for e in crash.events
+                    if e.get("state") == "worker_crash")
+    assert crash_ev["requeued"] is True and crash_ev["crashes"] == 1
+    assert crash.terminal["status"] == "ok"
+    # the stub echoes the forwarded crash count: the respawned execution
+    # saw crashes=1 (the worker pre-degrades its ladder by exactly that)
+    assert crash.terminal["crashes_seen"] == 1
+    assert neighbor.terminal["status"] == "ok"
+    assert sup.crashes == 1 and sup.respawns == 1
+    # crash-stamped journal attribution: interrupted row for the request
+    replay = faults.replay_journal(
+        os.path.join(sup.journal_dir, "r-000001.jsonl"), request="r-000001")
+    assert replay["stub-crash"]["status"] == "interrupted"
+    assert replay["stub-crash"]["error_class"] == "device"
+
+
+def test_stub_wedge_heartbeat_sigkill_heals(stub_sup):
+    """Heartbeat silence (the GIL-held-hang simulation): the supervisor
+    SIGKILLs within the budget and the request heals on the respawn."""
+    sup, queue = stub_sup
+    t0 = time.monotonic()
+    c = _submit(queue, "stub-wedge", 1)
+    assert c.done.wait(30.0), "wedged request never answered"
+    assert "worker_crash" in c.states()
+    assert c.terminal["status"] == "ok"
+    # detection is the heartbeat budget's business, not a long timeout:
+    # budget 1s + spawn/respawn overhead, well under the 30s wait above
+    assert time.monotonic() - t0 < 20.0
+    assert sup.crashes == 1
+
+
+def test_stub_poison_pill_fails_typed_after_bounded_crashes(stub_sup):
+    sup, queue = stub_sup
+    c = _submit(queue, "stub-crash-always", 1)
+    assert c.done.wait(60.0), "poison pill never answered"
+    assert c.terminal["kind"] == "result"
+    assert c.terminal["status"] == "failed"
+    assert c.terminal["error_class"] == "device"
+    assert c.terminal["worker_crashes"] == MAX_REQUEST_CRASHES
+    assert "worker crashed" in c.terminal["error"]
+    assert sup.crashes == MAX_REQUEST_CRASHES
+    # the daemon survives to serve the next request
+    ok = _submit(queue, "stub-ok", 2)
+    assert ok.done.wait(20.0) and ok.terminal["status"] == "ok"
+
+
+def test_stub_idle_death_respawns(tmp_path, monkeypatch):
+    """A worker that dies while IDLE (right after ready) is respawned
+    without any request being harmed."""
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    monkeypatch.setenv("STUB_START_BEHAVIOR", "dead")
+    cfg = _cfg(tmp_path)
+    queue = AdmissionQueue(4)
+    sup = WorkerSupervisor(cfg, queue, Router(cfg),
+                           child_argv=[sys.executable, STUB],
+                           start_timeout_s=15.0, poll_s=0.05)
+    sup.start()
+    try:
+        c = _submit(queue, "stub-ok", 1)
+        assert c.done.wait(20.0) and c.terminal["status"] == "ok"
+        assert sup.crashes >= 1 and sup.respawns >= 1
+    finally:
+        sup.stop(timeout_s=10.0)
+
+
+def test_crash_wedge_grammar_and_classification():
+    plan = faults.FaultPlan.from_spec("crash:s1.device, wedge:s2.post:1")
+    kinds = {(e.kind, e.seam, e.remaining) for e in plan.entries}
+    assert kinds == {("crash", "device", 1), ("wedge", "post", 1)}
+    with pytest.raises(ValueError):
+        faults.FaultPlan.from_spec("crash:")
+    err = faults.WorkerCrashError("sceneX", "rc -9")
+    assert faults.classify_error(err) == "device"
+    assert "sceneX" in str(err)
+
+
+def test_scene_supervisor_initial_rungs():
+    from maskclustering_tpu.run import SceneSupervisor
+
+    cfg = load_config("scannet").replace(data_root="/tmp", config_name="x")
+    sup = SceneSupervisor(cfg, initial_rungs=1)
+    assert sup.ladder.rung == 1
+    assert sup.ladder.applied_names == ["sequential-executor"]
+    # over-asking clamps at the ladder depth instead of raising
+    deep = SceneSupervisor(cfg, initial_rungs=99)
+    assert deep.ladder.exhausted
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a real SIGKILL'd device worker, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_real_worker_crash_respawn_byte_identical_zero_compiles(tmp_path):
+    """The ISSUE-12 acceptance on a real worker subprocess pair: a
+    scripted SIGKILL under an exporting request -> typed worker_crash +
+    requeue -> the RESPAWNED worker (AOT + persistent-cache warm start,
+    frozen sanitizer) answers ok with zero compiles booked and artifacts
+    byte-identical to a one-shot run."""
+    from maskclustering_tpu.analysis import retrace_sanitizer
+    from maskclustering_tpu.run import run_pipeline
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    write_scannet_layout)
+
+    scene = "scene0000_00"
+    spec = dict(num_boxes=3, num_frames=6, image_hw=(48, 64), spacing=0.08,
+                seed=11)
+    root = str(tmp_path / "data")
+    write_scannet_layout(make_scene(**spec), root, scene)
+
+    # byte-identity reference: the one-shot pipeline in this process
+    ref_cfg = _cfg(root, config_name="isoref")
+    ref = run_pipeline(ref_cfg, [scene], steps=("cluster",), resume=False,
+                       journal=False, ledger=False)
+    assert [s.status for s in ref.scenes] == ["ok"]
+
+    cfg = _cfg(root, config_name="iso",
+               aot_cache_dir=str(tmp_path / "aot"),
+               worker_heartbeat_s=30.0, retry_backoff_s=0.1)
+    queue = AdmissionQueue(4)
+    prev_armed = retrace_sanitizer.enabled()
+    retrace_sanitizer.arm(True)  # the child inherits --retrace-sanitizer
+    sup = WorkerSupervisor(
+        cfg, queue, Router(cfg),
+        journal_dir=str(tmp_path / "journals"),
+        warm_scenes=(scene,), freeze_after_warm=True,
+        fault_plan_spec=f"crash:{scene}.device:1",
+        start_timeout_s=300.0, poll_s=0.1)
+    try:
+        sup.start()
+        c = _submit(queue, scene, 1)
+        assert c.done.wait(300.0), "request never answered"
+        assert "worker_crash" in c.states(), c.events
+        assert c.terminal["status"] == "ok", c.terminal
+        # the respawned worker served it pre-degraded by the crash
+        assert c.terminal["rung"] >= 1
+        assert sup.crashes == 1 and sup.respawns == 1
+        # zero compiles on the respawned worker: its ready digest (AOT
+        # restore + compilation-cache hits paid the warmth from disk)
+        retrace = sup.last_ready.get("retrace") or {}
+        assert retrace.get("frozen") is True
+        assert retrace.get("compiles") == 0, retrace
+        # crash-stamped journal: the interrupted row then the final ok
+        replay = faults.replay_journal(
+            os.path.join(sup.journal_dir, "r-000001.jsonl"),
+            request="r-000001")
+        assert replay[scene]["status"] == "ok"
+        rows = faults.read_journal(
+            os.path.join(sup.journal_dir, "r-000001.jsonl"),
+            request="r-000001")
+        assert any(r.get("status") == "interrupted" for r in rows)
+    finally:
+        retrace_sanitizer.arm(True if prev_armed else None)
+        sup.stop(timeout_s=60.0)
+
+    # artifacts byte-identical to the one-shot reference
+    pred = os.path.join(root, "prediction")
+    a = np.load(os.path.join(pred, "iso_class_agnostic", f"{scene}.npz"))
+    b = np.load(os.path.join(pred, "isoref_class_agnostic", f"{scene}.npz"))
+    assert set(a.files) == set(b.files)
+    for key in a.files:
+        np.testing.assert_array_equal(a[key], b[key])
+    # the supervisor's verdict fields the Serving report renders
+    w = sup.stats()["worker"]
+    assert w["isolated"] and w["crashes"] == 1 and w["respawns"] == 1
+    assert json.dumps(w)  # JSON-able for the daemon digest line

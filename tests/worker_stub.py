@@ -1,0 +1,85 @@
+"""A jax-free stand-in for serve/worker_main.py (supervisor unit tests).
+
+Speaks the exact stdio pipe protocol (ready / hb / status / result / bye)
+in milliseconds, so the supervisor's heartbeat, SIGKILL-on-wedge, respawn,
+requeue and drain logic are all testable without paying two jax startups.
+Scene names script behaviors; "once-only" behaviors leave a marker file in
+$STUB_DIR so the RESPAWNED stub serves the same scene cleanly:
+
+    stub-ok     answer ok after 50 ms
+    stub-crash  SIGKILL this process mid-request (once; then ok)
+    stub-wedge  silence heartbeats and hang (once; then ok)
+    stub-dead   SIGKILL while idle, right after ready (once)
+    stub-slow   answer ok after ~1.5 s (drain-with-in-flight cases)
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+STUB_DIR = os.environ.get("STUB_DIR", "/tmp")
+
+
+def emit(doc):
+    sys.stdout.write(json.dumps(doc) + "\n")
+    sys.stdout.flush()
+
+
+def once(name) -> bool:
+    """True the FIRST time this behavior fires across stub generations."""
+    marker = os.path.join(STUB_DIR, f"stub_{name}.fired")
+    if os.path.exists(marker):
+        return False
+    with open(marker, "w"):
+        pass
+    return True
+
+
+def main():
+    hb_stop = threading.Event()
+
+    def hb():
+        while not hb_stop.wait(0.05):
+            emit({"kind": "hb"})
+
+    threading.Thread(target=hb, daemon=True).start()
+    emit({"kind": "ready", "pid": os.getpid(), "warmup_s": 0.0,
+          "aot": {"restored": 0}, "retrace": {"compiles": 0, "frozen": True}})
+    if once("spawncount"):
+        pass  # first generation marker (tests read the .fired files)
+    with open(os.path.join(STUB_DIR, f"stub_gen_{os.getpid()}.pid"), "w"):
+        pass
+    if "dead" in os.environ.get("STUB_START_BEHAVIOR", "") and once("dead"):
+        os.kill(os.getpid(), signal.SIGKILL)
+    for line in sys.stdin:
+        if not line.strip():
+            continue
+        doc = json.loads(line)
+        if doc.get("op") == "shutdown":
+            break
+        if doc.get("op") != "scene":
+            continue
+        rid, scene = doc["id"], doc["scene"]
+        emit({"kind": "status", "id": rid, "state": "running",
+              "scene": scene})
+        if scene == "stub-crash" and once("crash"):
+            os.kill(os.getpid(), signal.SIGKILL)
+        if scene == "stub-crash-always":  # the poison pill: every worker dies
+            os.kill(os.getpid(), signal.SIGKILL)
+        if scene == "stub-wedge" and once("wedge"):
+            hb_stop.set()
+            while True:
+                time.sleep(60)
+        time.sleep(1.5 if scene == "stub-slow" else 0.05)
+        emit({"kind": "result", "id": rid, "status": "ok", "seconds": 0.05,
+              "attempts": 1, "rung": doc.get("crashes", 0),
+              "buckets_new": 0, "crashes_seen": doc.get("crashes", 0)})
+    emit({"kind": "bye", "retrace": {"compiles": 0}})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
