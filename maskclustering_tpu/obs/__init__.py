@@ -38,7 +38,8 @@ from maskclustering_tpu.obs.tracer import NULL_TRACER, NullTracer, Span, Tracer
 from maskclustering_tpu.obs.xprof import XprofArm
 
 __all__ = [
-    "configure", "disable", "enabled", "events_path", "get_tracer",
+    "configure", "configure_sink", "disable", "enabled", "events_path",
+    "emit_event", "get_tracer",
     "scene_tracer", "span", "record_span", "traced", "flush_metrics",
     "count", "count_transfer", "gauge", "gauge_max", "observe", "registry",
     "sample_hbm", "read_events", "EventSink", "Tracer", "NullTracer",
@@ -103,6 +104,35 @@ def configure(path: str, *, fence: bool = True, annotations: bool = False,
     _active = Tracer(_sink, fence=fence, annotations=annotations,
                      sample_memory=sample_memory, xprof=arm)
     return _active
+
+
+def configure_sink(sink, *, fence: bool = False, annotations: bool = False,
+                   sample_memory: bool = False) -> Tracer:
+    """Arm tracing against an arbitrary sink object (anything with the
+    ``EventSink`` emit/close surface).
+
+    The telemetry relay's entry point (obs/telemetry.RelaySink): the
+    worker subprocess needs its spans CAPTURED but has no events file —
+    they ship up the supervisor pipe instead. Defaults are the zero-cost
+    posture (no fencing, no memory sampling): the relay must not add
+    device syncs the in-process topology would not pay.
+    """
+    global _active, _sink
+    disable()
+    _sink = sink
+    _active = Tracer(sink, fence=fence, annotations=annotations,
+                     sample_memory=sample_memory)
+    return _active
+
+
+def emit_event(kind: str, payload: dict) -> None:
+    """Append one typed event line to the armed sink (no-op when off).
+
+    The telemetry ticker's window rows ride this — any subsystem with its
+    own event kind can append without holding a tracer.
+    """
+    if _sink is not None:
+        _sink.emit(kind, payload)
 
 
 def disable() -> None:

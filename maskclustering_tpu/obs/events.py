@@ -40,6 +40,7 @@ KIND_SPAN = "span"
 KIND_METRICS = "metrics"
 KIND_COST = "cost"  # compile-time cost observatory rows (obs/cost.py)
 KIND_ANALYSIS = "analysis"  # mct-check findings/summary (analysis/__main__.py)
+KIND_TELEMETRY = "telemetry"  # windowed serving snapshots (obs/telemetry.py)
 
 
 class ReadStats:

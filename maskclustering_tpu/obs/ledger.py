@@ -37,6 +37,11 @@ log = logging.getLogger("maskclustering_tpu")
 LEDGER_SCHEMA_VERSION = 1
 DEFAULT_REGRESS_THRESHOLD = 0.15  # >15% p50 slowdown fails --regress
 
+# trajectories measuring a different experiment than bench/run s/scene:
+# --regress only compares them against their own kind (obs/report.py's
+# gate fences them out of the metric-less fallback pick BOTH ways)
+FENCED_TOOLS = ("serve", "tier1")
+
 
 def default_ledger_path() -> str:
     """``PERF_LEDGER.jsonl`` in the cwd; overridable via MCT_PERF_LEDGER
@@ -172,9 +177,28 @@ def serve_row(verdict: Dict, **extra) -> Dict:
               "count_dtype", "plane_dtype", "retrace_compiles",
               "retrace_repeats", "retrace_post_freeze",
               "retrace_cache_hits", "aot_restored", "worker_crashes",
-              "worker_respawns", "error"):
+              "worker_respawns", "telemetry_windows", "window_p95",
+              "error"):
         if verdict.get(k) is not None:
             row[k] = verdict[k]
+    row.update(extra)
+    return row
+
+
+def tier1_row(wall_s: float, passed: int, **extra) -> Dict:
+    """Ledger row for one tier-1 suite run (scripts/ci.sh appends it).
+
+    Tracks the 870 s budget trajectory with the same --regress machinery
+    as perf: the metric is tier1-specific ("tier1 ..."), so the tool fence
+    (FENCED_TOOLS) keeps it out of bench/run gating, and a tier1 baseline
+    gates only tier1 rows. ``passed`` rides along so a wall drop that
+    coincides with a pass-count drop reads as a trim, not a speedup.
+    """
+    row = {"tool": "tier1",
+           "metric": "tier1 wall s (not-slow suite)",
+           "value": round(float(wall_s), 1),
+           "unit": "s",
+           "passed": int(passed)}
     row.update(extra)
     return row
 

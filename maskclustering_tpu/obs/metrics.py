@@ -19,7 +19,16 @@ Design constraints, in order:
 2. **flat names** — ``h2d.bytes.feed`` not nested objects, so a snapshot
    is one JSON-able dict and a diff is set arithmetic.
 3. **bounded memory** — histograms keep a capped reservoir (deterministic
-   stride-decimation, not random sampling: reproducible percentiles).
+   stride-decimation, not random sampling: reproducible percentiles), and
+   ``snapshot()`` carries them as bounded summaries (count/total/p50/p95/
+   max) next to the counters and gauges — the report digest and run
+   digests consume all three sections, not just the scalars.
+
+Cross-process relay helpers (``snapshot_delta``/``merge_snapshot_delta``):
+the serving worker subprocess ships counter increments + changed gauges
+over its supervisor pipe and the parent folds them under the same flat
+names (obs/telemetry.py) — flat names are what make that fold one
+``count()`` per key.
 """
 
 from __future__ import annotations
@@ -69,12 +78,18 @@ class Histogram:
         return vals[idx]
 
     def summary(self) -> Dict:
+        # one sort serves all three order statistics; the quantile rule is
+        # THE shared nearest-rank helper (obs.report.percentile) so these
+        # summaries cannot silently disagree with any other surface
+        from maskclustering_tpu.obs.report import percentile
+
+        vals = sorted(self.values)
         return {
             "count": self.count,
             "total": self.total,
-            "p50": self.percentile(50),
-            "p95": self.percentile(95),
-            "max": max(self.values) if self.values else None,
+            "p50": percentile(vals, 50) if vals else None,
+            "p95": percentile(vals, 95) if vals else None,
+            "max": vals[-1] if vals else None,
         }
 
 
@@ -114,20 +129,72 @@ class Registry:
     def histogram(self, name: str) -> Optional[Histogram]:
         return self._hists.get(name)
 
-    def snapshot(self) -> Dict:
-        """One JSON-able dict of everything; cheap enough to flush per scene."""
+    def snapshot(self, *, include_histograms: bool = True) -> Dict:
+        """One JSON-able dict of everything; cheap enough to flush per scene.
+
+        ``include_histograms=False`` skips the per-histogram summaries —
+        each one sorts its (up to 4096-sample) reservoir under the
+        registry lock, which the telemetry hot paths (relay deltas,
+        window rolls, status polls) neither ship nor need.
+        """
         with self._lock:  # a concurrent insert would break dict iteration
-            return {
+            out = {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
-                "histograms": {k: h.summary() for k, h in self._hists.items()},
             }
+            if include_histograms:
+                out["histograms"] = {k: h.summary()
+                                     for k, h in self._hists.items()}
+            return out
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+
+
+def snapshot_delta(prev: Dict, cur: Dict) -> Dict:
+    """Counter/gauge delta between two ``Registry.snapshot()`` dicts.
+
+    The telemetry relay's wire shape (obs/telemetry.py): counters ship as
+    INCREMENTS (cur - prev, changed keys only — a fold is one ``count()``
+    per key, idempotent against re-ordering of other keys), gauges ship as
+    their current values (changed keys only — gauges are last-value
+    semantics, so a fold is one ``gauge()``). Histograms do NOT ride the
+    delta: the relay ships the completed spans themselves and the receiver
+    replays them, so the merged histograms hold real samples instead of
+    unmergable percentile summaries.
+    """
+    prev_c = prev.get("counters") or {}
+    cur_c = cur.get("counters") or {}
+    counters = {}
+    for k, v in cur_c.items():
+        d = v - prev_c.get(k, 0.0)
+        if d:
+            counters[k] = d
+    prev_g = prev.get("gauges") or {}
+    gauges = {k: v for k, v in (cur.get("gauges") or {}).items()
+              if prev_g.get(k) != v}
+    return {"counters": counters, "gauges": gauges}
+
+
+def merge_snapshot_delta(delta: Dict, reg: Optional["Registry"] = None) -> None:
+    """Fold one ``snapshot_delta`` payload into a registry (the relay's
+    receiving half): counter increments via ``count``, gauges via ``gauge``
+    — except ``*high_water*`` names, which keep max-ever semantics so a
+    late-arriving stale relay line cannot LOWER a high-water mark."""
+    reg = reg or _REGISTRY
+    for k, v in (delta.get("counters") or {}).items():
+        if isinstance(v, (int, float)):
+            reg.count(str(k), float(v))
+    for k, v in (delta.get("gauges") or {}).items():
+        if not isinstance(v, (int, float)):
+            continue
+        if "high_water" in str(k):
+            reg.gauge_max(str(k), float(v))
+        else:
+            reg.gauge(str(k), float(v))
 
 
 _REGISTRY = Registry()

@@ -27,7 +27,8 @@ from typing import Dict, List, Optional
 
 from maskclustering_tpu.obs.events import (KIND_ANALYSIS, KIND_COST,
                                            KIND_METRICS, KIND_SPAN,
-                                           ReadStats, read_events)
+                                           KIND_TELEMETRY, ReadStats,
+                                           read_events)
 
 log = logging.getLogger("maskclustering_tpu")
 
@@ -51,6 +52,7 @@ class RunData:
         self.order: List[str] = []
         self.cost_rows: List[Dict] = []  # cost-observatory events, in order
         self.analysis_rows: List[Dict] = []  # mct-check findings/summaries
+        self.telemetry_rows: List[Dict] = []  # windowed serving snapshots
         self.hbm_high_water: Optional[float] = None
         self.read_stats = ReadStats()  # torn/unknown lines: counted, warned
         metrics_by_pid: Dict = {}  # counters are monotonic PER PROCESS:
@@ -78,6 +80,8 @@ class RunData:
                 self.cost_rows.append(ev)
             elif kind == KIND_ANALYSIS:
                 self.analysis_rows.append(ev)
+            elif kind == KIND_TELEMETRY:
+                self.telemetry_rows.append(ev)
             elif kind == KIND_METRICS:
                 metrics_by_pid[ev.get("pid")] = ev.get("metrics") or {}
         if self.read_stats.skipped:
@@ -85,6 +89,7 @@ class RunData:
                         self.read_stats.describe(), path)
         counters: Dict[str, float] = {}
         gauges: Dict[str, float] = {}
+        hists: Dict[str, Dict] = {}
         for m in metrics_by_pid.values():
             for k, v in (m.get("counters") or {}).items():
                 counters[k] = counters.get(k, 0.0) + v
@@ -93,12 +98,34 @@ class RunData:
                 # gauges this subsystem emits (all are "largest seen" style)
                 if k not in gauges or v > gauges[k]:
                     gauges[k] = v
+            for k, h in (m.get("histograms") or {}).items():
+                # bounded summaries only (count/total/p50/p95/max): counts
+                # and totals sum exactly across processes; percentiles
+                # cannot merge, so the largest-count process's stand for
+                # the merged view (one process dominates in practice)
+                if not isinstance(h, dict):
+                    continue
+                cur = hists.get(k)
+                if cur is None:
+                    hists[k] = dict(h)
+                    continue
+                bigger = h if (h.get("count") or 0) > (cur.get("count") or 0) \
+                    else cur
+                merged = dict(bigger)
+                merged["count"] = (cur.get("count") or 0) + (h.get("count") or 0)
+                merged["total"] = (cur.get("total") or 0.0) \
+                    + (h.get("total") or 0.0)
+                maxes = [x.get("max") for x in (cur, h)
+                         if isinstance(x.get("max"), (int, float))]
+                merged["max"] = max(maxes) if maxes else bigger.get("max")
+                hists[k] = merged
         hw = gauges.get("hbm.high_water_bytes")
         if hw is not None and (self.hbm_high_water is None
                                or hw > self.hbm_high_water):
             self.hbm_high_water = float(hw)
         self._counters = counters
         self._gauges = gauges
+        self._histograms = hists
 
     def stage_rows(self) -> List[Dict]:
         """One aggregate row per span name, in first-appearance order."""
@@ -166,7 +193,16 @@ class RunData:
                          if k.startswith(("run.", "bench.", "compile_cache.",
                                           "pipeline.", "faults.",
                                           "retrace.", "serve.",
-                                          "aot_cache."))},
+                                          "aot_cache.", "worker."))},
+            # the registry's bounded histogram summaries (metrics.py
+            # snapshot contract): span.* series are already covered by the
+            # stage table above, so only the non-span histograms (queue
+            # waits, future explicit observe() series) ride the digest
+            "histograms": {
+                k: {f: (round(x, 6) if isinstance(x, float) else x)
+                    for f, x in v.items()}
+                for k, v in sorted(self._histograms.items())
+                if not k.startswith("span.")},
         }
         ov = self.overlap()
         if ov is not None:
@@ -408,7 +444,39 @@ def render_serving(run: "RunData") -> Optional[str]:
                 + (" [VIOLATION — the serve-many contract broke]"
                    if post_warm else ""))
     lines.append(" | ".join(tail))
+    tele = render_telemetry_windows(run.telemetry_rows)
+    if tele:
+        lines.append(tele)
     return "\n".join(lines)
+
+
+def render_telemetry_windows(rows: List[Dict]) -> Optional[str]:
+    """One-line digest of the windowed telemetry ring (obs/telemetry.py
+    rows the daemon's ticker appended): window count, request volume,
+    peak queue depth across windows, and the busiest window's worst
+    per-bucket p95 — the live-view numbers, durable on disk."""
+    if not rows:
+        return None
+    requests = sum(int(r.get("requests", 0) or 0) for r in rows)
+    peak_depth = max((int(r.get("queue_depth", 0) or 0) for r in rows),
+                     default=0)
+    crashes = sum(int(r.get("crashes", 0) or 0) for r in rows)
+    post_warm = sum(int(r.get("post_warm_compiles", 0) or 0) for r in rows)
+    p95 = None
+    for r in rows:
+        for h in (r.get("latency") or {}).values():
+            v = (h or {}).get("p95_s")
+            if v is not None and (p95 is None or v > p95):
+                p95 = v
+    line = (f"telemetry: {len(rows)} window(s) | {requests} request(s) | "
+            f"peak queue depth {peak_depth}")
+    if p95 is not None:
+        line += f" | worst window p95 {_fmt_s(p95)}"
+    if crashes:
+        line += f" | crashes {crashes}"
+    if post_warm:
+        line += f" | post-warm compiles {post_warm} [VIOLATION]"
+    return line
 
 
 def render_retrace(counters: Dict[str, float]) -> Optional[str]:
@@ -701,19 +769,23 @@ def _regress_eval(ledger_path: str, baseline_path: str,
     # bench baseline just because it is the newest numeric row
     current = None
     base_metric = baseline.get("metric") if baseline else None
-    base_is_serve = (baseline or {}).get("tool") == "serve" or (
-        isinstance(base_metric, str) and base_metric.startswith("serve "))
+    # fenced trajectories measure different experiments (serve: s/request
+    # under concurrency; tier1: suite wall seconds) — a baseline from one
+    # of them only gates its own rows, and a bench/run baseline never
+    # gates them just because their row is the newest
+    base_fence = None
+    for tool in led.FENCED_TOOLS:
+        if (baseline or {}).get("tool") == tool or (
+                isinstance(base_metric, str)
+                and base_metric.startswith(tool + " ")):
+            base_fence = tool
     if base_metric:
         current = led.latest_value_row(rows, metric=base_metric)
     if current is None:
-        # metric-less fallback: serve rows (s/request under concurrency)
-        # are a different experiment from bench/run rows (s/scene) — a
-        # serve baseline only gates serve rows, everything else never
-        # gates a serve row just because load_gen ran last
-        pool = ([r for r in rows if r.get("tool") == "serve"]
-                if base_is_serve else rows)
+        pool = ([r for r in rows if r.get("tool") == base_fence]
+                if base_fence else rows)
         current = led.latest_value_row(
-            pool, exclude_tools=() if base_is_serve else ("serve",))
+            pool, exclude_tools=() if base_fence else led.FENCED_TOOLS)
         if current is not None and base_metric \
                 and current.get("metric") != base_metric:
             lines.append(f"WARNING: no ledger row matches baseline metric "

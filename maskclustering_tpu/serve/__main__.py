@@ -91,7 +91,12 @@ def main(argv=None) -> int:
                              "warm-up (armed runs only)")
     parser.add_argument("--obs_events", default=None,
                         help="obs span/metrics JSONL path (the Serving "
-                             "report section renders from it)")
+                             "report section renders from it; telemetry "
+                             "window rows append here too)")
+    parser.add_argument("--telemetry-window", type=float, default=5.0,
+                        help="telemetry aggregation window seconds "
+                             "(obs/telemetry.py ring; the status op's "
+                             "detail=telemetry and obs.top read it)")
     parser.add_argument("--retrace-sanitizer", action="store_true",
                         help="arm the compile-event sanitizer (default: "
                              "$MCT_RETRACE_SANITIZER); the daemon freezes "
@@ -185,6 +190,7 @@ def main(argv=None) -> int:
         default_deadline_s=args.deadline,
         isolate_worker=args.isolate_worker,
         fault_plan_spec=args.fault_plan,
+        telemetry_window_s=args.telemetry_window,
     )
     daemon.start()
     if args.host is not None:

@@ -53,10 +53,15 @@ class AdmissionQueue:
     timeout doubles as the worker's stop-flag poll interval).
     """
 
-    def __init__(self, capacity: int = 8):
+    def __init__(self, capacity: int = 8, *, metered: bool = True):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
+        # metered=False: no serve.admission.*/queue-depth bookings — for
+        # INTERNAL queues (the isolated worker's two-slot stdin buffer)
+        # whose plumbing must not relay up as admission accounting and
+        # break topology invariance (the parent's queue is THE admission)
+        self.metered = metered
         self._q: "queue.Queue[SceneRequest]" = queue.Queue(maxsize=capacity)
         self._lock = mct_lock("serve.AdmissionQueue._lock")
         self._high_water = 0
@@ -67,16 +72,18 @@ class AdmissionQueue:
         try:
             self._q.put_nowait(req)
         except queue.Full:
-            _count("serve.admission.rejects.queue_full")
+            if self.metered:
+                _count("serve.admission.rejects.queue_full")
             raise QueueFullReject(self._q.qsize(), self.capacity) from None
         depth = self._q.qsize()
         with self._lock:
             self._admitted += 1
             if depth > self._high_water:
                 self._high_water = depth
-        _count("serve.admission.admitted")
-        _gauge("serve.queue_depth", float(depth))
-        _gauge("serve.queue_depth_high_water", float(self._high_water))
+        if self.metered:
+            _count("serve.admission.admitted")
+            _gauge("serve.queue_depth", float(depth))
+            _gauge("serve.queue_depth_high_water", float(self._high_water))
         return depth
 
     def next(self, timeout_s: float = 0.25) -> Optional[SceneRequest]:
@@ -85,7 +92,8 @@ class AdmissionQueue:
             req = self._q.get(timeout=timeout_s)
         except queue.Empty:
             return None
-        _gauge("serve.queue_depth", float(self._q.qsize()))
+        if self.metered:
+            _gauge("serve.queue_depth", float(self._q.qsize()))
         return req
 
     def requeue(self, req: SceneRequest) -> bool:
@@ -97,7 +105,8 @@ class AdmissionQueue:
             self._q.put_nowait(req)
         except queue.Full:
             return False
-        _gauge("serve.queue_depth", float(self._q.qsize()))
+        if self.metered:
+            _gauge("serve.queue_depth", float(self._q.qsize()))
         return True
 
     def drain(self) -> List[SceneRequest]:
@@ -108,7 +117,8 @@ class AdmissionQueue:
                 out.append(self._q.get_nowait())
             except queue.Empty:
                 break
-        _gauge("serve.queue_depth", 0.0)
+        if self.metered:
+            _gauge("serve.queue_depth", 0.0)
         return out
 
     def depth(self) -> int:

@@ -104,12 +104,20 @@ class ServeClient:
         terminal = self.wait_result(collect=statuses)
         return terminal, statuses, time.monotonic() - t0
 
-    def stats(self) -> Dict:
-        self.send({"op": "status"})
+    def stats(self, detail: str = "") -> Dict:
+        doc: Dict = {"op": "status"}
+        if detail:
+            doc["detail"] = detail
+        self.send(doc)
         while True:
             ev = self.recv_event()
             if ev.get("kind") == "stats":
                 return ev
+
+    def telemetry(self) -> Dict:
+        """The stats snapshot plus the windowed telemetry ring (the
+        ``obs.top`` dashboard's poll)."""
+        return self.stats(detail="telemetry")
 
     def shutdown(self) -> Dict:
         self.send({"op": "shutdown"})

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Tuple
 
 from maskclustering_tpu import obs
 from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.obs import telemetry
 from maskclustering_tpu.serve import protocol
 from maskclustering_tpu.serve.admission import AdmissionQueue, QueueFullReject
 from maskclustering_tpu.serve.router import Router
@@ -92,7 +93,8 @@ class ServeDaemon:
                  freeze_after_warm: bool = True,
                  default_deadline_s: float = 0.0,
                  isolate_worker: bool = False,
-                 fault_plan_spec: Optional[str] = None):
+                 fault_plan_spec: Optional[str] = None,
+                 telemetry_window_s: float = 5.0):
         if socket_path is None and host is None:
             raise ValueError("need a socket_path (AF_UNIX) or host/port (TCP)")
         self.cfg = cfg
@@ -138,6 +140,12 @@ class ServeDaemon:
         self._handlers: List[threading.Thread] = []
         self._started_at = 0.0
         self._warmup_s = 0.0
+        # the live telemetry plane (obs/telemetry.py): windowed rolling
+        # aggregation over the parent registry — which, under
+        # --isolate-worker, the supervisor keeps fed via the telem relay
+        self.aggregator = telemetry.WindowAggregator(
+            window_s=telemetry_window_s)
+        self._ticker = telemetry.TelemetryTicker(self.aggregator)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -170,6 +178,13 @@ class ServeDaemon:
             aot_cache.warm_start(self.cfg)
             self._prewarm()
             self.worker.start()
+        # install + tick AFTER warm-up, with the delta baseline re-anchored
+        # to NOW: windows meter serving, and without the rebase window 0
+        # would charge the whole warm-up wall + its counter deltas (AOT
+        # restores, prewarm dispatches) to itself
+        self.aggregator.rebase()
+        telemetry.install(self.aggregator)
+        self._ticker.start()
         self._acceptor = threading.Thread(  # mct-thread: abandon(daemon-lifetime thread, bounded-joined in shutdown(); the spawn/join pair spans methods, which the scope-local check cannot see)
             target=self._accept_loop, daemon=True, name="serve-acceptor")
         self._acceptor.start()
@@ -267,6 +282,11 @@ class ServeDaemon:
                         detail="daemon shutting down before dispatch"))
             except Exception:  # noqa: BLE001 — client gone mid-shutdown
                 pass
+        # stop sampling AFTER the drain: its final roll puts the drain's
+        # rejects on disk as the last telemetry window
+        self._ticker.stop()
+        if telemetry.installed() is self.aggregator:
+            telemetry.install(None)
         self._conns_stop.set()
         if self._acceptor is not None:
             self._acceptor.join(5.0)
@@ -356,8 +376,11 @@ class ServeDaemon:
             tag = str(doc.get("tag", ""))
             op = doc["op"]
             if op == "status":
+                doc_stats = self.stats()
+                if doc.get("detail") == "telemetry":
+                    doc_stats["telemetry"] = self.aggregator.snapshot()
                 send({"v": protocol.PROTOCOL_VERSION, "kind": "stats",
-                      **self.stats()})
+                      **doc_stats})
                 return
             if op == "shutdown":
                 send({"v": protocol.PROTOCOL_VERSION, "kind": "ack",

@@ -18,6 +18,7 @@ Request ops::
      "synthetic": {"num_boxes": 3, "num_frames": 10,
                    "image_hw": [60, 80], "spacing": 0.06, "seed": 40}}
     {"op": "status"}              # daemon stats snapshot
+    {"op": "status", "detail": "telemetry"}  # + windowed telemetry ring
     {"op": "shutdown"}            # drain in-flight requests, then exit
 
 Responses (all carry ``id`` when bound to a request)::
@@ -51,6 +52,9 @@ from typing import Dict, Optional
 PROTOCOL_VERSION = 1
 
 OPS = ("scene", "status", "shutdown")
+# status op detail levels: "" (the classic point-in-time snapshot) or
+# "telemetry" (adds the windowed aggregator's ring + cumulative digest)
+STATUS_DETAILS = ("", "telemetry")
 REJECT_REASONS = ("queue_full", "deadline", "bad_request", "draining")
 RESULT_STATUSES = ("ok", "failed", "skipped", "deadline", "interrupted")
 
@@ -106,6 +110,11 @@ def parse_line(line: str) -> Dict:
     op = doc.get("op")
     if op not in OPS:
         raise ProtocolError(f"unknown op {op!r} (one of {OPS})")
+    if op == "status":
+        detail = doc.get("detail", "")
+        if detail not in STATUS_DETAILS:
+            raise ProtocolError(f"unknown status detail {detail!r} "
+                                f"(one of {STATUS_DETAILS})")
     if op == "scene":
         scene = doc.get("scene")
         if not isinstance(scene, str) or not scene:

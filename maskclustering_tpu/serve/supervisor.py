@@ -53,6 +53,7 @@ from typing import Deque, Dict, Optional, Tuple
 
 from maskclustering_tpu import obs
 from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.obs import telemetry
 from maskclustering_tpu.serve import protocol
 from maskclustering_tpu.serve.admission import AdmissionQueue
 from maskclustering_tpu.serve.router import Router
@@ -124,6 +125,10 @@ class WorkerSupervisor:
         self._counts = {"requests": 0, "ok": 0, "failed": 0, "deadline": 0,
                         "skipped": 0, "interrupted": 0}
         self.respawns = 0
+        # respawns since the last child reached ready — the pre-wedge
+        # visibility counter (resets on every healthy ready, so a climbing
+        # value in `status` means the respawn budget is being eaten NOW)
+        self.consecutive_respawns = 0
         self.crashes = 0
         self.spawns = 0
         self.last_ready: Dict = {}
@@ -190,6 +195,7 @@ class WorkerSupervisor:
         while time.monotonic() < deadline:
             if self._ready.wait(0.25):
                 self._heartbeat.beat()
+                self.consecutive_respawns = 0
                 return True
             if child.poll() is not None:
                 log.error("worker supervisor: child died during startup "
@@ -224,6 +230,16 @@ class WorkerSupervisor:
                 continue
             kind = doc.get("kind")
             if kind == "hb":
+                continue
+            if kind == telemetry.KIND_TELEM:
+                # the cross-process relay: the child's counter deltas fold
+                # into THIS registry under their own names and its spans
+                # replay here — the Serving report and the telemetry
+                # windows read topology-invariant (obs/telemetry.py)
+                try:
+                    telemetry.fold_telem(doc, child_pid=child.pid)
+                except Exception:  # noqa: BLE001 — telemetry never faults
+                    log.exception("worker supervisor: telem fold failed")
                 continue
             if kind == "ready":
                 with self._lock:
@@ -364,6 +380,7 @@ class WorkerSupervisor:
             if self._stop.is_set():
                 return False
             self.respawns += 1
+            self.consecutive_respawns += 1
             obs.count("serve.worker_respawns")
             if self._spawn(first_spawn=False):
                 return True
@@ -410,10 +427,17 @@ class WorkerSupervisor:
                 self._idle.set()
 
     def _serve_one(self, req: protocol.SceneRequest) -> None:
-        obs.count("serve.requests")
+        # NB: serve.requests / serve.requests_<status> obs counters for
+        # forwarded requests are booked by the CHILD and arrive via the
+        # telem relay — booking them here too would double-count the fold.
+        # Only the paths the child never sees (expired-at-dequeue, the
+        # crash cap in _on_crash) book parent-side.
         with self._lock:
             self._counts["requests"] += 1
+        telemetry.record_queue_wait(
+            req, max(time.monotonic() - req.admitted_at, 0.0))
         if req.expired():
+            obs.count("serve.requests")
             obs.count("serve.rejects.deadline")
             with self._lock:
                 self._counts["deadline"] += 1
@@ -473,18 +497,28 @@ class WorkerSupervisor:
         key = status if status in self._counts else "failed"
         if terminal.get("kind") == "reject":
             key = "deadline" if status == "deadline" else "failed"
-        obs.count(f"serve.requests_{key}")
+        # per-status obs counters arrive via the relay (the child booked
+        # them); only the internal stats digest and the telemetry window's
+        # latency-by-bucket are parent-side bookings here
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + 1
             self._inflight = None
-        self._latencies.append(time.monotonic() - t0)
+        latency = time.monotonic() - t0
+        self._latencies.append(latency)
         bucket = terminal.get("bucket")
         if bucket is not None:
             b = tuple(bucket)
             self.router.remember(req.scene, b)
             self.router.note_served(b)
-        if terminal.get("buckets_new"):
-            obs.count("serve.buckets_cold", int(terminal["buckets_new"]))
+        # window-latency parity with the in-process worker: it records
+        # only requests that reached the end of execution (its results
+        # carry `seconds`) — not rejects, not early-exit materialization
+        # failures — and bucket-less terminals (disk scenes) fall back to
+        # the router's memory for the same per-bucket keys
+        if terminal.get("kind") == "result" and "seconds" in terminal:
+            telemetry.record_request(
+                tuple(bucket) if bucket is not None
+                else self.router.bucket_for(req.scene), latency)
 
     def _crash_inflight(self, req: protocol.SceneRequest, entry: Dict,
                         detail: str) -> bool:
@@ -510,9 +544,18 @@ class WorkerSupervisor:
         self._kill_child()
         if req is None:
             return
+        # zero-width trace marker: obs.trace renders the crash between the
+        # dead attempt and the requeue's second queue-wait segment
+        obs.record_span("serve.worker_crash", 0.0, request=req.id,
+                        scene=req.scene, detail=detail, end_ts=time.time())
         req.crashes += 1
         err = faults.WorkerCrashError(req.scene, detail)
         self._journal_crash(req, err)
+        # re-admission stamp: the SECOND queue-wait segment measures from
+        # the requeue, not the original ack (the first attempt's wall is
+        # its own trace segment, not queue time); deadline_at is absolute
+        # and unaffected
+        req.admitted_at = time.monotonic()
         if req.crashes < MAX_REQUEST_CRASHES \
                 and not self._stop.is_set() and self.queue.requeue(req):
             obs.count("serve.requests_requeued")
@@ -577,12 +620,26 @@ class WorkerSupervisor:
         with self._lock:
             counts = dict(self._counts)
             ready = dict(self.last_ready)
+            inflight = self._inflight
+            inflight_id = inflight["req"].id if inflight else None
+            inflight_crashes = inflight["req"].crashes if inflight else 0
+        child = self._child
+        alive = child is not None and child.poll() is None
         return {"counts": counts,
                 "latency": self.latency_quantiles(),
                 "warm_buckets": sorted(self.router.warm_buckets()),
-                "worker": {"isolated": True, "spawns": self.spawns,
+                # the pre-wedge liveness panel: heartbeat age (vs budget),
+                # consecutive respawns and the in-flight crash count make
+                # a wedging worker visible in `status` BEFORE the SIGKILL
+                "worker": {"isolated": True, "alive": alive,
+                           "spawns": self.spawns,
                            "respawns": self.respawns,
+                           "consecutive_respawns": self.consecutive_respawns,
                            "crashes": self.crashes,
+                           "hb_age_s": round(self._heartbeat.age_s(), 3),
+                           "hb_budget_s": self._heartbeat.budget_s,
+                           "inflight": inflight_id,
+                           "inflight_crashes": inflight_crashes,
                            "warmup_s": ready.get("warmup_s"),
                            "aot": ready.get("aot"),
                            "pid": ready.get("pid")}}

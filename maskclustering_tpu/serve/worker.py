@@ -45,6 +45,7 @@ from typing import Deque, Dict, Optional
 
 from maskclustering_tpu import obs
 from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.obs import telemetry
 from maskclustering_tpu.serve import protocol
 from maskclustering_tpu.serve.admission import AdmissionQueue
 from maskclustering_tpu.serve.router import Router
@@ -208,6 +209,12 @@ class ServeWorker:
         obs.count("serve.requests")
         with self._lock:
             self._counts["requests"] += 1
+        # ack->dequeue wait: the telemetry window's queue_wait histogram
+        # and the trace CLI's queue-wait segment (no-op without a daemon
+        # aggregator — e.g. inside the isolated worker subprocess, where
+        # the PARENT supervisor measured the real wait already)
+        telemetry.record_queue_wait(
+            req, max(time.monotonic() - req.admitted_at, 0.0))
         if req.expired():
             # admitted in time, dequeued too late: a typed answer beats
             # burning device time on a result nobody is waiting for
@@ -311,6 +318,9 @@ class ServeWorker:
         obs.count(f"serve.requests_{status_}")
         with self._lock:
             self._counts[status_] = self._counts.get(status_, 0) + 1
+        telemetry.record_request(
+            bucket if bucket is not None else self.router.bucket_for(req.scene),
+            latency)
         if new_buckets:
             obs.count("serve.buckets_cold", len(new_buckets))
         _send(req, protocol.result(

@@ -79,6 +79,10 @@ def main(argv=None) -> int:
                              "FIRST spawn only — a respawn is the recovery "
                              "under test, not the drill target)")
     parser.add_argument("--hb-interval", type=float, default=1.0)
+    parser.add_argument("--telem-interval", type=float, default=2.0,
+                        help="seconds between periodic telemetry relay "
+                             "flushes (request boundaries flush too; "
+                             "0 disables the relay)")
     parser.add_argument("--init_timeout", type=float, default=120.0)
     parser.add_argument("--debug", action="store_true")
     args = parser.parse_args(argv)
@@ -106,19 +110,64 @@ def main(argv=None) -> int:
 
     out_lock = threading.Lock()
 
-    def emit(doc: dict) -> None:
+    def emit_raw(doc: dict) -> None:
         with out_lock:
             sys.stdout.write(json.dumps(doc, sort_keys=True) + "\n")
             sys.stdout.flush()
 
+    # the telemetry relay (obs/telemetry.py): spans + registry deltas ship
+    # up the pipe so the parent's Serving report / windows / status op are
+    # topology-invariant — nothing stays stranded in this process
+    from maskclustering_tpu import obs
+    from maskclustering_tpu.obs import telemetry
+
+    relay = telemetry.ChildRelay() if args.telem_interval > 0 else None
+    if relay is not None:
+        obs.configure_sink(relay.sink)
+    # one lock across collect+write: the hb thread and the device thread
+    # both flush, and a collect drained by one thread must hit the pipe
+    # before the other thread's (later) result line — otherwise a telem
+    # line can land AFTER the result it accounts for and the parent's
+    # fold-before-result ordering contract breaks
+    telem_lock = threading.Lock()
+
+    def flush_telem() -> None:
+        if relay is None:
+            return
+        with telem_lock:
+            try:
+                doc = relay.collect()
+            except Exception:  # noqa: BLE001 — telemetry never faults serving
+                log.exception("worker: telemetry collect failed")
+                return
+            if doc is not None:
+                emit_raw(doc)
+
+    def emit(doc: dict) -> None:
+        if doc.get("kind") in ("result", "reject"):
+            # request boundary: ship this request's counters/spans BEFORE
+            # its terminal line — the parent reader folds in pipe order,
+            # so by the time any client sees the result, the parent's
+            # registry/windows already account for it (no stale-status
+            # race for a telemetry poll fired on the result)
+            flush_telem()
+        emit_raw(doc)
+
     # the heartbeat emitter: alive while the PROCESS is alive (a busy
     # device phase keeps beating; only a process-wide wedge — or the
-    # wedge drill's hook below — silences it)
+    # wedge drill's hook below — silences it). The telemetry relay rides
+    # the same thread at its own (coarser) cadence — a wedge silences
+    # both, which is exactly the signal the parent watches for.
     hb_stop = threading.Event()
 
     def hb_loop() -> None:
+        last_telem = time.monotonic()
         while not hb_stop.wait(max(args.hb_interval, 0.05)):
-            emit({"kind": "hb"})
+            emit_raw({"kind": "hb"})
+            if relay is not None and \
+                    time.monotonic() - last_telem >= args.telem_interval:
+                last_telem = time.monotonic()
+                flush_telem()
 
     faults.set_wedge_hook(hb_stop.set)
 
@@ -140,7 +189,10 @@ def main(argv=None) -> int:
     from maskclustering_tpu.serve.worker import ServeWorker
 
     router = Router(cfg, baseline_path=args.warm_baseline)
-    queue = AdmissionQueue(capacity=2)  # the supervisor serializes; 2 = margin
+    # the supervisor serializes; 2 = margin. metered=False: this queue is
+    # pipe plumbing — the PARENT's queue is the admission layer, and this
+    # one's counters must not relay up as doubled admission accounting
+    queue = AdmissionQueue(capacity=2, metered=False)
     worker = ServeWorker(cfg, queue, router,
                          journal_dir=args.journal_dir,
                          prediction_root=args.prediction_root)
@@ -170,9 +222,10 @@ def main(argv=None) -> int:
     hb_thread = threading.Thread(target=hb_loop, daemon=True,
                                  name="worker-hb")  # mct-thread: abandon(bounded-joined at drain below; the spawn/join pair brackets the stdin loop)
     hb_thread.start()
-    emit({"kind": "ready", "pid": os.getpid(),
-          "warmup_s": round(warmup_s, 2), "aot": aot_stats,
-          "retrace": _retrace_digest()})
+    emit_raw({"kind": "ready", "pid": os.getpid(),
+              "warmup_s": round(warmup_s, 2), "aot": aot_stats,
+              "retrace": _retrace_digest()})
+    flush_telem()  # warm-up's counters (aot_cache.*, d2h.*) relay at once
     log.info("worker: ready (warm-up %.1fs, aot %s)", warmup_s, aot_stats)
 
     # the stdin loop: one request at a time from the supervisor; EOF or a
@@ -205,8 +258,14 @@ def main(argv=None) -> int:
     if not drained:
         log.error("worker: in-flight request outlived the drain budget")
         rc = 1
-    emit({"kind": "bye", "retrace": _retrace_digest(),
-          "counts": worker.stats()["counts"]})
+    if retrace_sanitizer.enabled():
+        # book the sanitizer digest as retrace.* counters so the FINAL
+        # telem flush relays them — the parent's Serving report reads
+        # "compiles post-warm-up" off the same counters in both topologies
+        retrace_sanitizer.emit_counters()
+    flush_telem()
+    emit_raw({"kind": "bye", "retrace": _retrace_digest(),
+              "counts": worker.stats()["counts"]})
     return 143 if faults.stop_requested() else rc
 
 

@@ -247,6 +247,13 @@ class Heartbeat:
         with self._lock:
             return self.budget_s - (time.monotonic() - self._last)
 
+    def age_s(self) -> float:
+        """Seconds since the last beat — the liveness number a status
+        snapshot shows BEFORE the budget expires (a climbing age is the
+        wedge-is-coming signal; ``expired`` is the wedge-already-here one)."""
+        with self._lock:
+            return time.monotonic() - self._last
+
     def expired(self) -> bool:
         return self.budget_s > 0 and self.remaining() <= 0
 

@@ -10,7 +10,10 @@
 #    override) — new tests must reuse the small shared synthetic fixtures,
 #    not fresh full-depth scenes, and this is the tripwire that says so
 #    before the hard timeout does (the fault-tolerance tests are counted
-#    by the same --durations table);
+#    by the same --durations table). Appends one `tier1` row (suite wall
+#    + pass count) to the perf ledger so the 870 s budget trajectory is
+#    machine-checkable via the same --regress machinery (fenced: a tier1
+#    baseline gates tier1 rows only, never the bench/run headline);
 # 2. runs the fault-matrix smoke (scripts/fault_smoke.py): three canned
 #    FaultPlans — flaky-then-ok, device stall + degradation ladder,
 #    persistent load failure + journal replay — through a 2-scene
@@ -45,8 +48,10 @@
 #    retrace-sanitizer-armed mct-serve daemon subprocess (AOT executable
 #    cache armed — the capture half of the round-trip rides every smoke),
 #    warms two tiny shape buckets, fires a small mixed-bucket burst
-#    through scripts/load_gen.py, SIGTERMs it, and asserts a clean drain
-#    (exit 143, final digest line) with ZERO post-warm compiles — the
+#    through scripts/load_gen.py while POLLING the telemetry op mid-burst
+#    (an empty/torn snapshot fails the gate; the verdict stamps the
+#    window p95), SIGTERMs it, and asserts a clean drain (exit 143,
+#    final digest line) with ZERO post-warm compiles — the
 #    compile-once/serve-many contract, end to end (MCT_SERVE_SMOKE=0
 #    skips). FATAL. The full concurrent soak is slow-marked in
 #    tests/test_serve.py.
@@ -58,7 +63,11 @@
 #    and answers ok, neighbors are untouched, and the RESPAWNED worker's
 #    digest books zero compiles (persistent AOT cache + compilation-cache
 #    warm start) — the crash-containment contract, end to end
-#    (MCT_SERVE_CRASH_SMOKE=0 skips). FATAL.
+#    (MCT_SERVE_CRASH_SMOKE=0 skips). FATAL. The mid-burst telemetry poll
+#    additionally asserts the cross-process relay delivered the child's
+#    counters (worker.telem_messages / serve.requests_ok /
+#    pipeline.host_sync present in the parent's cumulative snapshot) —
+#    an isolated worker with a dark relay fails here.
 #
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
@@ -82,22 +91,37 @@ rc=0
 fail() { [ "$rc" -eq 0 ] && rc=$1 || true; }  # first failure wins the exit code
 
 WALL_WARN="${MCT_TIER1_WALL_WARN:-800}"
+T1LOG=$(mktemp /tmp/mct_tier1_XXXX.log)
 echo "== ci: tier-1 tests =="
 t0=$(date +%s)
 if ! timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'not slow' --continue-on-collection-errors --durations=10 \
-        -p no:cacheprovider -p no:xdist -p no:randomly; then
+        -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$T1LOG"; then
     echo "ci: tier-1 tests FAILED" >&2
     fail 1
 fi
 wall=$(( $(date +%s) - t0 ))
-echo "== ci: tier-1 wall ${wall}s (budget: warn >${WALL_WARN}s of the 870s timeout) =="
+# pytest's summary line ("N passed ... in Ns") -> the pass count
+t1_passed=$(grep -aoE '[0-9]+ passed' "$T1LOG" | tail -1 | grep -oE '[0-9]+' || echo 0)
+rm -f "$T1LOG"
+echo "== ci: tier-1 wall ${wall}s, ${t1_passed} passed (budget: warn >${WALL_WARN}s of the 870s timeout) =="
 if [ "$wall" -gt "$WALL_WARN" ]; then
     # non-fatal: the suite still passed, but the headroom is gone — trim
     # the slowest tests (see the --durations table above) onto the shared
     # small fixtures before the 870 s hard timeout starts eating the run
     echo "ci: WARNING tier-1 wall ${wall}s exceeds the ${WALL_WARN}s soft budget" >&2
 fi
+# durable trajectory: one tier1 ledger row per CI run, fenced from the
+# bench/run --regress pick (obs/ledger.FENCED_TOOLS) so the 870s budget is
+# tracked by the same machinery as perf (gate it with a tier1 baseline:
+# python -m maskclustering_tpu.obs.report --regress <tier1 row/ledger>)
+env JAX_PLATFORMS=cpu python - "$LEDGER" "$wall" "$t1_passed" <<'EOF' || \
+    echo "ci: WARNING tier1 ledger row append failed (non-fatal)" >&2
+import sys
+from maskclustering_tpu.obs import ledger as led
+path, wall, passed = sys.argv[1], float(sys.argv[2]), int(sys.argv[3])
+led.append_row(path, led.tier1_row(wall, passed))
+EOF
 
 if [ "${MCT_FAULT_SMOKE:-1}" != "0" ]; then
     echo "== ci: fault-matrix smoke (3 canned FaultPlans, 2-scene CPU run, <60s) =="

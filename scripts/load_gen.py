@@ -172,6 +172,75 @@ def append_ledger_row(verdict: Dict, path: Optional[str]) -> None:
     led.append_row(path or led.default_ledger_path(), row)
 
 
+def worst_window_p95(windows) -> Optional[float]:
+    """Max per-bucket p95 across telemetry window rows (None when none)."""
+    p95s = [h.get("p95_s")
+            for w in windows or ()
+            for h in (w.get("latency") or {}).values()
+            if (h or {}).get("p95_s") is not None]
+    return max(p95s) if p95s else None
+
+
+class TelemetryPoller:
+    """Polls the daemon's telemetry op WHILE the burst runs.
+
+    Watching a daemon under load is the telemetry plane's whole point, so
+    the smoke exercises it mid-burst, not post-hoc: every poll must answer
+    a well-formed snapshot (windows ring + cumulative digest) — an
+    unreachable op or a torn document is a gate failure. Tracks the worst
+    per-bucket window p95 seen, which the verdict stamps.
+    """
+
+    def __init__(self, address, interval_s: float = 0.5):
+        self.address = address
+        self.interval_s = interval_s
+        self.polls = 0
+        self.torn = 0
+        self.errors = 0
+        self.window_p95: Optional[float] = None
+        self.last: Optional[Dict] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _ingest(self, stats: Dict) -> None:
+        tel = stats.get("telemetry")
+        if not isinstance(tel, dict) or "windows" not in tel \
+                or "cumulative" not in tel:
+            self.torn += 1
+            return
+        self.last = stats
+        p95 = worst_window_p95(tel["windows"])
+        if p95 is not None and (self.window_p95 is None
+                                or p95 > self.window_p95):
+            self.window_p95 = p95
+
+    def poll_once(self) -> None:
+        from maskclustering_tpu.serve.client import ServeClient
+
+        self.polls += 1
+        try:
+            with ServeClient(self.address, timeout_s=30.0) as client:
+                self._ingest(client.telemetry())
+        except Exception:  # noqa: BLE001 — counted; the gate decides
+            self.errors += 1
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.poll_once()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,  # mct-thread: abandon(bounded-joined in stop(); the spawn/join pair spans methods, which the scope-local check cannot see)
+                                        name="telemetry-poller")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(5.0)
+        self.poll_once()  # one final full snapshot after the burst
+
+
 # ---------------------------------------------------------------------------
 # the CI smoke gate: daemon subprocess + a bounded mixed-bucket burst
 # ---------------------------------------------------------------------------
@@ -219,6 +288,7 @@ def run_smoke(args) -> int:
            # asserts the cross-process half)
            "--aot-cache", os.path.join(tmp, "aot"),
            "--obs_events", events, "--warm", "+".join(warm_names),
+           "--telemetry-window", "1.0",
            "--journal-dir", os.path.join(tmp, "journals")]
     for kv in SMOKE_CONFIG_SETS:
         cmd += ["--set", kv]
@@ -240,9 +310,16 @@ def run_smoke(args) -> int:
             log("smoke: FAIL — daemon never became reachable")
             proc.kill()
             return 1
-        verdict = run_load(sock, requests=args.requests,
-                           concurrency=args.concurrency, buckets=2,
-                           deadline_s=args.deadline, resume=False)
+        # the telemetry op is polled WHILE the burst runs: an empty or
+        # torn snapshot mid-load is a gate failure (obs/telemetry.py)
+        poller = TelemetryPoller(sock)
+        poller.start()
+        try:
+            verdict = run_load(sock, requests=args.requests,
+                               concurrency=args.concurrency, buckets=2,
+                               deadline_s=args.deadline, resume=False)
+        finally:
+            poller.stop()
         proc.send_signal(signal.SIGTERM)
         out, _ = proc.communicate(timeout=90.0)
     except subprocess.TimeoutExpired:
@@ -302,6 +379,44 @@ def run_smoke(args) -> int:
                     f"respawned worker booked {retrace.get('compiles')} "
                     f"compile(s) — the AOT/persistent-cache warm start "
                     f"did not deliver a zero-compile respawn")
+    # live telemetry plane checks (the mid-burst poller): the op must have
+    # answered well-formed snapshots under load, windows must have closed,
+    # and — under the isolated worker — the relay must have delivered the
+    # child's counters to the parent (the topology-invariance contract)
+    isolated = bool(args.isolate_worker or args.crash_drill)
+    tel = ((poller.last or {}).get("telemetry") or {})
+    windows = tel.get("windows") or []
+    tel_counters = (tel.get("cumulative") or {}).get("counters") or {}
+    verdict["telemetry_polls"] = poller.polls
+    verdict["telemetry_windows"] = len(windows)
+    verdict["window_p95"] = poller.window_p95
+    if poller.last is None:
+        failures.append("telemetry op never answered a well-formed "
+                        "snapshot mid-burst")
+    if poller.torn:
+        failures.append(f"{poller.torn} torn/empty telemetry snapshot(s) "
+                        f"mid-burst")
+    if poller.errors:
+        failures.append(f"{poller.errors} telemetry poll(s) could not "
+                        f"reach the daemon mid-burst")
+    if poller.last is not None:
+        if not windows:
+            failures.append("no telemetry window ever closed during the "
+                            "burst")
+        if tel_counters.get("serve.requests", 0) < args.requests:
+            failures.append(
+                f"telemetry cumulative counters saw "
+                f"{tel_counters.get('serve.requests', 0)} request(s) of "
+                f"{args.requests} — the snapshot is stale or torn")
+        if isolated:
+            missing = [k for k in ("worker.telem_messages",
+                                   "serve.requests_ok",
+                                   "pipeline.host_sync")
+                       if not tel_counters.get(k)]
+            if missing:
+                failures.append(
+                    f"isolated worker relayed no {missing} counter(s) — "
+                    f"the cross-process telemetry relay is dark")
     if verdict["ok"] != args.requests:
         failures.append(f"only {verdict['ok']}/{args.requests} requests "
                         f"answered ok")
@@ -385,7 +500,11 @@ def main(argv=None) -> int:
     from maskclustering_tpu.serve.client import ServeClient
 
     with ServeClient(_address(args), timeout_s=30.0) as client:
-        stats = client.stats()
+        stats = client.telemetry()
+        tel = stats.get("telemetry") or {}
+        if tel:
+            verdict["telemetry_windows"] = len(tel.get("windows") or [])
+            verdict["window_p95"] = worst_window_p95(tel.get("windows"))
         retrace = stats.get("retrace") or {}
         if retrace:
             verdict["retrace_compiles"] = retrace.get("compiles")

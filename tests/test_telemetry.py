@@ -1,0 +1,682 @@
+"""mct-telemetry: the live serving telemetry plane (ISSUE-13 acceptance).
+
+Unit tier: metrics snapshot-delta/merge helpers, relay sink bounds,
+telem folding (counters + replayed spans + the ``worker.`` process tag),
+window aggregation (ring bounds, reject/crash deltas, sample caps),
+ticker rows on the events file, histogram summaries riding run digests,
+the tier1 ledger row + --regress fence, the status-op detail validation,
+the obs.top renderer, and obs.trace assembly over a synthetic timeline.
+
+Stub tier (tests/worker_stub.py): the supervisor folds relayed telem
+lines; a SIGKILL mid-window loses at most the unshipped delta — the
+parent registry keeps the crash counters and every folded line (relay
+loss != registry tear); obs.trace reconstructs the crash -> requeue ->
+respawn request end-to-end with queue-wait segments.
+
+Acceptance tier (one real worker subprocess): the same 4-request
+mixed-bucket soak in-process and under --isolate-worker must render the
+SAME Serving report section and book the SAME serve./d2h./h2d./pipeline.
+counter names and values (modulo the worker.* relay tag) — the topology-
+invariance contract. Scene shapes reuse the tier-1 suite's existing warm
+buckets (test_serve's seed-40 scene + the supervisor acceptance's 6-frame
+bucket), so jit and persistent caches hit across files.
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+import pytest
+
+from maskclustering_tpu import obs
+from maskclustering_tpu.config import load_config
+from maskclustering_tpu.obs import telemetry
+from maskclustering_tpu.obs import metrics as obs_metrics
+from maskclustering_tpu.obs.events import KIND_TELEMETRY
+from maskclustering_tpu.obs.report import (RunData, render_report,
+                                           render_serving,
+                                           render_telemetry_windows)
+from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.utils import faults
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB = os.path.join(REPO_ROOT, "tests", "worker_stub.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_state():
+    telemetry.install(None)
+    yield
+    telemetry.install(None)
+    faults.set_plan(None)
+    faults.clear_stop()
+
+
+# ---------------------------------------------------------------------------
+# units: metrics snapshot-delta / merge helpers
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_delta_and_merge_roundtrip():
+    prev = {"counters": {"a": 2.0, "b": 5.0}, "gauges": {"g": 1.0}}
+    cur = {"counters": {"a": 3.5, "b": 5.0, "c": 1.0},
+           "gauges": {"g": 2.0, "serve.queue_depth_high_water": 7.0}}
+    delta = obs_metrics.snapshot_delta(prev, cur)
+    assert delta["counters"] == {"a": 1.5, "c": 1.0}  # unchanged b dropped
+    assert delta["gauges"] == {"g": 2.0,
+                               "serve.queue_depth_high_water": 7.0}
+
+    reg = obs_metrics.Registry()
+    reg.count("a", 10.0)
+    reg.gauge("serve.queue_depth_high_water", 9.0)
+    obs_metrics.merge_snapshot_delta(delta, reg)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"a": 11.5, "c": 1.0}
+    assert snap["gauges"]["g"] == 2.0
+    # high-water gauges keep max-ever semantics across the fold
+    assert snap["gauges"]["serve.queue_depth_high_water"] == 9.0
+    # an empty delta folds to a no-op
+    obs_metrics.merge_snapshot_delta({}, reg)
+    assert reg.snapshot()["counters"] == {"a": 11.5, "c": 1.0}
+
+
+def test_relay_sink_bounds_and_child_relay_sequences():
+    sink = telemetry.RelaySink(cap=4)
+    for i in range(6):
+        sink.emit("span", {"name": f"s{i}", "dur_s": 0.1})
+    sink.emit("metrics", {"metrics": {}})  # non-span kinds are ignored
+    spans, dropped = sink.drain()
+    assert [s["name"] for s in spans] == ["s2", "s3", "s4", "s5"]
+    assert dropped == 2
+    assert sink.drain() == ([], 0)  # drained clean
+
+    relay = telemetry.ChildRelay(telemetry.RelaySink())
+    obs.count("telem.unit.counter", 3)
+    doc = relay.collect()
+    assert doc["kind"] == "telem" and doc["seq"] == 1
+    assert doc["metrics"]["counters"]["telem.unit.counter"] >= 3
+    # nothing changed since: the idle flush costs zero pipe traffic
+    assert relay.collect() is None
+    obs.count("telem.unit.counter")
+    doc2 = relay.collect()
+    assert doc2["seq"] == 2
+    assert doc2["metrics"]["counters"] == {"telem.unit.counter": 1.0}
+
+
+def test_fold_telem_counters_spans_and_process_tag(tmp_path):
+    events = str(tmp_path / "fold_events.jsonl")
+    obs.configure(events, truncate=True, meta={"tool": "serve"})
+    try:
+        ts = time.time()
+        telemetry.fold_telem(
+            {"kind": "telem", "v": 1, "seq": 1,
+             "metrics": {"counters": {"d2h.bytes.post.drain": 512.0,
+                                      "serve.requests_ok": 2.0},
+                         "gauges": {"retrace.live.post_freeze": 0.0}},
+             "spans": [{"name": "serve.request", "dur_s": 0.25,
+                        "sync_s": 0.01, "ts": ts,
+                        "attrs": {"request": "r-000042", "scene": "x"}}],
+             "spans_dropped": 3},
+            child_pid=4242)
+        # an unknown schema version folds nothing but counts itself
+        telemetry.fold_telem({"kind": "telem", "v": 99, "seq": 2,
+                              "metrics": {"counters": {"d2h.bytes": 1e9}}})
+        obs.flush_metrics()
+    finally:
+        obs.disable()
+    run = RunData(events)
+    c = run._counters
+    # counters landed under their own flat names (topology invariance)...
+    assert c["d2h.bytes.post.drain"] == 512.0
+    assert c["serve.requests_ok"] == 2.0
+    assert "d2h.bytes" not in c  # the unknown-version line folded nothing
+    # ...with the relay's own bookkeeping as the worker. process tag
+    assert c["worker.telem_messages"] == 1.0
+    assert c["worker.telem_spans"] == 1.0
+    assert c["worker.telem_spans_dropped"] == 3.0
+    assert c["worker.telem_unknown_version"] == 1.0
+    # the span replayed into the events file, tagged and time-anchored
+    row = run.spans["serve.request"][0]
+    assert row["dur_s"] == 0.25
+    assert row["attrs"]["request"] == "r-000042"
+    assert row["attrs"]["worker_pid"] == 4242
+    assert abs(row["attrs"]["end_ts"] - ts) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# units: windowed aggregation + the ticker
+# ---------------------------------------------------------------------------
+
+
+def test_window_aggregator_rolls_deltas_and_ring_bounds():
+    agg = telemetry.WindowAggregator(window_s=0.05, ring=3)
+    base = obs.registry().snapshot()["counters"]
+    agg.roll()  # prime the delta baseline against the shared registry
+    obs.count("serve.requests", 2)
+    obs.count("serve.requests_ok", 2)
+    obs.count("serve.admission.rejects.queue_full")
+    obs.count("serve.rejects.deadline")
+    obs.count("serve.worker_crashes")
+    obs.gauge("serve.queue_depth", 3)
+    agg.record_request((16, 16, 8192), 0.5)
+    agg.record_request((16, 16, 8192), 1.5)
+    agg.record_request(None, 0.2)
+    agg.record_queue_wait(0.1)
+    row = agg.roll()
+    assert row["requests"] == 2 and row["by_status"] == {"ok": 2}
+    assert row["rejects"] == {"queue_full": 1, "deadline": 1}
+    assert row["crashes"] == 1 and row["queue_depth"] == 3
+    lat = row["latency"]["16x16x8192"]
+    assert lat["count"] == 2 and lat["max_s"] == 1.5
+    assert row["latency"]["all"]["count"] == 1
+    assert row["queue_wait"]["count"] == 1
+
+    # deltas reset per window; the ring stays bounded
+    for _ in range(5):
+        assert agg.roll()["requests"] == 0
+    snap = agg.snapshot()
+    assert len(snap["windows"]) == 3  # ring=3
+    assert snap["window_s"] == 0.05
+    # cumulative latency histograms survive the window resets
+    assert snap["cumulative"]["latency"]["16x16x8192"]["count"] == 2
+    assert "current" in snap and "t0" in snap["current"]
+    # the whole snapshot is wire-safe
+    json.dumps(snap)
+    del base
+
+
+def test_window_aggregator_sample_cap_counts_drops():
+    agg = telemetry.WindowAggregator(window_s=1.0)
+    for _ in range(telemetry._SAMPLE_CAP + 10):
+        agg.record_request(None, 0.1)
+    # queue waits cap independently — a wait burst must not starve the
+    # latency view (and vice versa)
+    for _ in range(telemetry._SAMPLE_CAP + 5):
+        agg.record_queue_wait(0.01)
+    row = agg.roll()
+    assert row["latency"]["all"]["count"] == telemetry._SAMPLE_CAP
+    assert row["queue_wait"]["count"] == telemetry._SAMPLE_CAP
+    assert row["samples_dropped"] == 15
+    # the cumulative histogram observed EVERY sample, capped list or not
+    cum = agg.snapshot()["cumulative"]["latency"]["all"]
+    assert cum["count"] == telemetry._SAMPLE_CAP + 10
+
+
+def test_ticker_appends_schema_versioned_rows(tmp_path):
+    events = str(tmp_path / "tick_events.jsonl")
+    obs.configure(events, truncate=True, meta={"tool": "serve"})
+    try:
+        agg = telemetry.WindowAggregator(window_s=0.05)
+        ticker = telemetry.TelemetryTicker(agg)
+        ticker.start()
+        agg.record_request((8, 16, 1024), 0.3)
+        time.sleep(0.2)
+        ticker.stop()
+        obs.count("serve.requests")  # a Serving section trigger
+        obs.flush_metrics()
+    finally:
+        obs.disable()
+    run = RunData(events)
+    assert run.telemetry_rows, "ticker appended no telemetry rows"
+    assert all(r["kind"] == KIND_TELEMETRY for r in run.telemetry_rows)
+    line = render_telemetry_windows(run.telemetry_rows)
+    assert line.startswith("telemetry:") and "window(s)" in line
+    # the Serving section carries the windows digest
+    serving = render_serving(run)
+    assert "telemetry:" in serving
+    # and the rows are crash-safe JSONL like everything else in the file
+    assert render_report(run)
+
+
+def test_record_helpers_route_to_installed_aggregator(tmp_path):
+    events = str(tmp_path / "helper_events.jsonl")
+    req = protocol.build_request({"op": "scene", "scene": "s1"}, "r-000009")
+    telemetry.record_request((1, 2, 3), 0.5)  # no-op uninstalled
+    telemetry.record_queue_wait(req, 0.25)  # no-op uninstalled
+    agg = telemetry.WindowAggregator(window_s=5.0)
+    telemetry.install(agg)
+    obs.configure(events, truncate=True)
+    try:
+        telemetry.record_request((1, 2, 3), 0.5)
+        telemetry.record_queue_wait(req, 0.25)
+        obs.flush_metrics()
+    finally:
+        obs.disable()
+        telemetry.install(None)
+    row = agg.roll()
+    assert row["latency"]["1x2x3"]["count"] == 1
+    assert row["queue_wait"]["count"] == 1
+    run = RunData(events)
+    # the queue wait books a trace-able span + an explicit histogram
+    wait_span = run.spans["serve.queue_wait"][0]
+    assert wait_span["attrs"]["request"] == "r-000009"
+    assert run._histograms["serve.queue_wait_s"]["count"] >= 1
+
+
+def test_run_digest_carries_histogram_summaries(tmp_path):
+    """Satellite: the registry's bounded histogram summaries ride the
+    report digest (and hence run digests), not just counters/gauges."""
+    events = str(tmp_path / "hist_events.jsonl")
+    obs.configure(events, truncate=True)
+    try:
+        for v in (0.1, 0.2, 0.3, 0.4):
+            obs.observe("queue.wait_s", v)
+        with obs.span("histspan"):
+            pass
+        obs.flush_metrics()
+    finally:
+        obs.disable()
+    digest = RunData(events).summary()
+    h = digest["histograms"]["queue.wait_s"]
+    assert h["count"] == 4 and abs(h["total"] - 1.0) < 1e-6
+    assert h["p50"] is not None and h["max"] == 0.4
+    # span.* series stay with the stage table, not duplicated here
+    assert not any(k.startswith("span.") for k in digest["histograms"])
+    assert "histspan" in digest["stages"]
+
+
+# ---------------------------------------------------------------------------
+# units: protocol detail, client accessor shape, ledger fence, top, trace
+# ---------------------------------------------------------------------------
+
+
+def test_status_detail_validation():
+    assert protocol.parse_line('{"op": "status"}')["op"] == "status"
+    doc = protocol.parse_line('{"op": "status", "detail": "telemetry"}')
+    assert doc["detail"] == "telemetry"
+    with pytest.raises(protocol.ProtocolError):
+        protocol.parse_line('{"op": "status", "detail": "bogus"}')
+
+
+def test_tier1_ledger_row_and_regress_fence(tmp_path):
+    from maskclustering_tpu.obs import ledger as led
+    from maskclustering_tpu.obs.report import _regress_eval
+
+    path = str(tmp_path / "ledger.jsonl")
+    led.append_row(path, {"tool": "bench", "metric": "mask-clustering "
+                          "s/scene", "value": 3.2, "unit": "s/scene"})
+    row = led.tier1_row(712.4, 430)
+    assert row["tool"] == "tier1" and row["passed"] == 430
+    assert led.append_row(path, row)
+
+    # a bench-style baseline gates the BENCH row even though the tier1
+    # row is newer (the tool fence keeps the trajectories apart)
+    base = str(tmp_path / "base.json")
+    with open(base, "w") as f:
+        json.dump({"value": 3.0}, f)
+    rc, _lines, record = _regress_eval(path, base, 0.15)
+    assert record["current"]["tool"] == "bench"
+
+    # a tier1 baseline gates tier1 rows (and a 20% wall growth fails)
+    tier1_base = str(tmp_path / "tier1_base.json")
+    with open(tier1_base, "w") as f:
+        json.dump(led.tier1_row(600.0, 430), f)
+    rc, _lines, record = _regress_eval(path, tier1_base, 0.15)
+    assert rc == 2 and record["current"]["tool"] == "tier1"
+
+    led.append_row(path, led.tier1_row(610.0, 431))
+    rc, _lines, record = _regress_eval(path, tier1_base, 0.15)
+    assert rc == 0 and record["current"]["value"] == 610.0
+
+
+def test_top_sparkline_and_render():
+    from maskclustering_tpu.obs.top import render_top, sparkline
+
+    assert sparkline([]) == ""
+    assert sparkline([0, 0]) == "▁▁"
+    line = sparkline([0, 1, 2, 4], width=4)
+    assert len(line) == 4 and line[-1] == "█"
+
+    stats = {
+        "config": "served", "uptime_s": 12.5, "draining": False,
+        "counts": {"requests": 5, "ok": 4, "failed": 1},
+        "queue": {"depth": 1, "capacity": 8, "high_water": 3,
+                  "admitted": 5},
+        "warm_buckets": [[16, 16, 8192]],
+        "worker": {"pid": 777, "hb_age_s": 0.4, "spawns": 2,
+                   "consecutive_respawns": 1, "inflight_crashes": 1},
+        "telemetry": {
+            "window_s": 5.0,
+            "windows": [
+                {"dur_s": 5.0, "requests": 2, "queue_depth": 2,
+                 "rejects": {"queue_full": 1}, "crashes": 1,
+                 "respawns": 1, "requeued": 1, "post_warm_compiles": 1,
+                 "latency": {"16x16x8192": {"count": 2, "p50_s": 1.0,
+                                            "p95_s": 2.0, "max_s": 2.0}},
+                 "queue_wait": {"count": 2, "p50_s": 0.1, "p95_s": 0.2,
+                                "max_s": 0.2}},
+                {"dur_s": 5.0, "requests": 3, "queue_depth": 0,
+                 "rejects": {}, "crashes": 0, "respawns": 0,
+                 "latency": {}},
+            ],
+            "cumulative": {
+                "counters": {"aot_cache.hits": 4,
+                             "worker.telem_messages": 9,
+                             "worker.telem_spans": 30},
+                "gauges": {"retrace.live.post_freeze": 1},
+                "latency": {"16x16x8192": {"count": 5, "p50": 1.1,
+                                           "p95": 2.2, "max": 2.2,
+                                           "total": 6.0}}}},
+    }
+    text = render_top(stats, now=1000.0)
+    assert "mct-serve top" in text and "config served" in text
+    assert "depth 1/8" in text and "▁" in text  # sparkline rendered
+    assert "bucket 16x16x8192" in text
+    assert "window p50 1.000s" in text and "cum p50 1.100s" in text
+    assert "queue wait: p50 0.100s" in text
+    assert "queue_full x1" in text and "crashes 1" in text
+    assert "consecutive respawns 1" in text and "in-flight crashes 1" in text
+    assert "post-warm 1 [VIOLATION]" in text
+    assert "aot-cache hits 4" in text
+    assert "relay: 9 telem line(s)" in text
+    # an empty daemon renders without crashing
+    assert "requests: none yet" in render_top({"counts": {}})
+
+
+def _span_line(name, end_ts, dur, **attrs):
+    return {"v": 1, "kind": "span", "ts": end_ts, "pid": 1, "name": name,
+            "dur_s": dur, "sync_s": 0.0, "depth": 0,
+            "attrs": dict(attrs, end_ts=end_ts)}
+
+
+def test_trace_assembly_orders_segments_and_nests_stages(tmp_path):
+    from maskclustering_tpu.obs.trace import assemble_trace, render_trace
+
+    events = str(tmp_path / "trace_events.jsonl")
+    t = 1000.0
+    rows = [
+        _span_line("serve.queue_wait", t + 1.0, 1.0, request="r-000001",
+                   scene="s"),
+        _span_line("serve.request", t + 4.0, 3.0, request="r-000001",
+                   scene="s"),
+        # stage spans inside the execution window nest under it
+        _span_line("associate", t + 2.0, 0.8),
+        _span_line("graph", t + 3.0, 0.5),
+        # an unrelated span outside the window stays out
+        _span_line("associate", t + 20.0, 0.5),
+        # another request's skeleton stays out entirely
+        _span_line("serve.request", t + 9.0, 1.0, request="r-000002",
+                   scene="z"),
+    ]
+    with open(events, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+        f.write('{"torn')  # the reader's torn-line policy applies here too
+    trace = assemble_trace("r-000001", events)
+    kinds = [s["kind"] for s in trace["segments"]]
+    assert kinds == ["queue_wait", "attempt"]
+    execution = trace["segments"][1]
+    assert [c["label"] for c in execution["children"]] == ["associate",
+                                                           "graph"]
+    assert execution["dur_s"] == 3.0
+    assert any("torn" in w for w in trace["warnings"])
+    text = render_trace(trace)
+    assert "queue wait" in text and "execution" in text
+    assert "· associate" in text
+    # an unknown request id answers loudly, not emptily
+    missing = assemble_trace("r-999999", events)
+    assert not missing["segments"] and missing["warnings"]
+
+
+def test_trace_cli_json_and_exit_codes(tmp_path):
+    from maskclustering_tpu.obs import trace as trace_mod
+
+    events = str(tmp_path / "cli_events.jsonl")
+    with open(events, "w") as f:
+        f.write(json.dumps(_span_line("serve.request", 1000.0, 1.0,
+                                      request="r-000001")) + "\n")
+    assert trace_mod.main(["r-000001", "--events", events, "--json"]) == 0
+    assert trace_mod.main(["r-404404", "--events", events]) == 1
+
+
+# ---------------------------------------------------------------------------
+# stub tier: relay folding, relay loss under SIGKILL, crash-trace assembly
+# ---------------------------------------------------------------------------
+
+
+class _Client:
+    def __init__(self):
+        self.events = []
+        self.done = threading.Event()
+
+    def send(self, ev):
+        self.events.append(ev)
+        if ev.get("kind") in ("result", "reject"):
+            self.done.set()
+
+    @property
+    def terminal(self):
+        return self.events[-1] if self.events else None
+
+
+def _submit(queue, scene, i, **kw):
+    client = _Client()
+    req = protocol.build_request({"op": "scene", "scene": scene, **kw},
+                                 f"r-{i:06d}")
+    req.send = client.send
+    queue.submit(req)
+    return client
+
+
+def _counter(name):
+    return obs.registry().snapshot()["counters"].get(name, 0.0)
+
+
+def test_stub_relay_folds_and_crash_loses_window_not_registry(
+        tmp_path, monkeypatch):
+    """Relay-loss unit: a worker SIGKILL mid-window loses at most the
+    unshipped delta — the parent registry keeps every folded counter AND
+    the parent-booked crash counters; obs.trace then reconstructs the
+    crash -> requeue -> respawn request end-to-end."""
+    from maskclustering_tpu.obs.trace import assemble_trace, render_trace
+    from maskclustering_tpu.serve.admission import AdmissionQueue
+    from maskclustering_tpu.serve.router import Router
+    from maskclustering_tpu.serve.supervisor import WorkerSupervisor
+
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    events = str(tmp_path / "stub_events.jsonl")
+    obs.configure(events, truncate=True, meta={"tool": "serve"})
+    agg = telemetry.WindowAggregator(window_s=0.2)
+    telemetry.install(agg)
+    cfg = load_config("scannet").replace(
+        data_root=str(tmp_path), config_name="stubtel", step=1,
+        worker_heartbeat_s=1.0, retry_backoff_s=0.05)
+    queue = AdmissionQueue(8)
+    sup = WorkerSupervisor(cfg, queue, Router(cfg),
+                           journal_dir=str(tmp_path / "journals"),
+                           child_argv=[sys.executable, STUB],
+                           start_timeout_s=15.0, poll_s=0.05)
+    sup.start()
+    try:
+        crash = _submit(queue, "stub-crash", 1)
+        assert crash.done.wait(30.0) and crash.terminal["status"] == "ok"
+        ok = _submit(queue, "stub-ok", 2)
+        assert ok.done.wait(30.0) and ok.terminal["status"] == "ok"
+        assert sup.wait_idle(5.0)
+        # the satellite status surface: liveness visible BEFORE a wedge
+        w = sup.stats()["worker"]
+        assert w["alive"] is True
+        assert w["hb_age_s"] < 5.0 and w["hb_budget_s"] == 1.0
+        assert w["consecutive_respawns"] == 0  # reset on the healthy ready
+        assert w["inflight"] is None and w["inflight_crashes"] == 0
+        json.dumps(w)
+    finally:
+        stopped = sup.stop(timeout_s=10.0)
+        telemetry.install(None)
+        row = agg.roll()
+        obs.flush_metrics()
+        obs.disable()
+    assert stopped
+
+    # folded relay state: the stub shipped one telem line per SERVED
+    # request (the crashed first execution died before its flush — that
+    # window's delta is lost, nothing else is)
+    assert _counter("worker.telem_messages") >= 2.0
+    assert _counter("serve.requests_ok") >= 2.0
+    assert _counter("d2h.bytes") >= 2 * 4096
+    assert _counter("pipeline.host_sync") >= 2.0
+    # the parent-booked crash accounting survived the relay loss
+    assert _counter("serve.worker_crashes") == 1.0
+    assert _counter("serve.requests_requeued") == 1.0
+    # and the windowed plane booked the crash + both latencies
+    assert row["crashes"] + sum(
+        wd.get("crashes", 0) for wd in agg.snapshot()["windows"]) >= 1
+
+    # obs.trace: crash -> requeue -> respawn, end to end, with queue waits
+    trace = assemble_trace("r-000001", events,
+                           journal_dir=str(tmp_path / "journals"))
+    kinds = [s["kind"] for s in trace["segments"]]
+    assert kinds.count("queue_wait") >= 2, kinds  # admission + requeue
+    assert "crash" in kinds
+    assert "attempt" in kinds  # the respawned worker's relayed execution
+    assert any(s["kind"] == "journal" and "INTERRUPTED" in s["label"]
+               for s in trace["segments"])
+    # causality: the crash precedes the (respawned) relayed execution
+    assert kinds.index("crash") < kinds.index("attempt")
+    text = render_trace(trace)
+    assert "WORKER CRASH" in text and "queue wait" in text
+
+    # the Serving report over the same events file shows the relayed
+    # counters and the crash containment lines — nothing stranded
+    run = RunData(events)
+    serving = render_serving(run)
+    assert "worker crashes 1" in serving
+    assert run._counters["serve.requests_ok"] >= 2.0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: topology parity — in-process vs --isolate-worker
+# ---------------------------------------------------------------------------
+
+SPEC_SMALL = {"num_boxes": 3, "num_frames": 6, "image_hw": (48, 64),
+              "spacing": 0.08, "seed": 11}   # == test_serve_supervisor's
+SPEC_A = {"num_boxes": 3, "num_frames": 10, "image_hw": (60, 80),
+          "spacing": 0.06, "seed": 40}       # == test_serve / test_executor
+SCENE_SMALL, SCENE_A = "scene0000_00", "scene0002_00"
+
+PARITY_FAMILIES = ("serve.", "d2h.", "h2d.", "pipeline.", "run.")
+
+
+def _family_counters(counters):
+    return {k: v for k, v in counters.items()
+            if k.startswith(PARITY_FAMILIES)
+            and not k.startswith("serve.queue_depth")}
+
+
+def _normalize_serving(text):
+    """Serving sections compare structurally: latency/telemetry numbers
+    are timing, everything else must match verbatim."""
+    out = []
+    for line in text.splitlines():
+        if line.startswith(("request latency:", "telemetry:")):
+            out.append(re.sub(r"\d+(\.\d+)?", "#", line))
+        else:
+            out.append(line)
+    return "\n".join(out)
+
+
+def _soak(root, tmp_path, label, isolate):
+    from maskclustering_tpu.serve.client import ServeClient
+    from maskclustering_tpu.serve.daemon import ServeDaemon
+
+    events = str(tmp_path / f"{label}_events.jsonl")
+    sock = str(tmp_path / f"{label}.sock")
+    cfg = load_config("scannet").replace(
+        data_root=root, config_name=label, step=1,
+        distance_threshold=0.05, mask_pad_multiple=32,
+        worker_heartbeat_s=60.0)
+    obs.configure(events, truncate=True, meta={"tool": "serve",
+                                               "config": label})
+    daemon = ServeDaemon(cfg, socket_path=sock, capacity=4,
+                         journal_dir=str(tmp_path / f"{label}_journals"),
+                         warm_scenes=(SCENE_SMALL, SCENE_A),
+                         freeze_after_warm=False,
+                         isolate_worker=isolate,
+                         telemetry_window_s=0.5)
+    telemetry_doc = None
+    try:
+        daemon.start()
+        with ServeClient(sock, timeout_s=600.0) as client:
+            for i, (scene, spec) in enumerate(
+                    [(SCENE_SMALL, SPEC_SMALL), (SCENE_A, SPEC_A)] * 2):
+                terminal, _st, _lat = client.run_scene(
+                    scene,
+                    synthetic=dict(spec, image_hw=list(spec["image_hw"])),
+                    tag=f"par-{i}")
+                assert terminal.get("status") == "ok", (label, terminal)
+            telemetry_doc = client.telemetry()
+    finally:
+        daemon.request_stop()
+        daemon.shutdown()
+        daemon.emit_serve_counters()
+        obs.flush_metrics()
+        counters = dict(obs.registry().snapshot()["counters"])
+        obs.disable()
+    return {"events": events, "counters": counters,
+            "telemetry": telemetry_doc, "daemon": daemon}
+
+
+def test_topology_parity_serving_report_and_relayed_counters(tmp_path):
+    """ISSUE-13 acceptance: the same 4-request mixed-bucket soak renders
+    the same Serving section and books the same serve./d2h./h2d./pipeline.
+    counter names AND values in-process and under --isolate-worker
+    (modulo the worker.* relay tag) — the production topology reports
+    exactly what the test topology does."""
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    write_scannet_layout)
+
+    root = str(tmp_path / "data")
+    for seq, spec in ((SCENE_SMALL, SPEC_SMALL), (SCENE_A, SPEC_A)):
+        write_scannet_layout(make_scene(**spec), root, seq)
+
+    inproc = _soak(root, tmp_path, "telin", isolate=False)
+    iso = _soak(root, tmp_path, "teliso", isolate=True)
+
+    # counter parity: same names, same values, modulo the process tag
+    a = _family_counters(inproc["counters"])
+    b = _family_counters(iso["counters"])
+    assert a, "in-process soak booked no parity-family counters"
+    assert set(a) == set(b), (set(a) ^ set(b))
+    mismatched = {k: (a[k], b[k]) for k in a if a[k] != b[k]}
+    assert not mismatched, mismatched
+    # the relay tag exists only on the isolated side
+    assert iso["counters"].get("worker.telem_messages", 0) >= 1
+    assert "worker.telem_messages" not in inproc["counters"]
+
+    # Serving report parity (rendered from each topology's events file)
+    run_a, run_b = RunData(inproc["events"]), RunData(iso["events"])
+    sec_a, sec_b = render_serving(run_a), render_serving(run_b)
+    assert "requests 4" in sec_a and "ok 4" in sec_a
+    assert _normalize_serving(sec_a) == _normalize_serving(sec_b), \
+        f"--- in-process ---\n{sec_a}\n--- isolated ---\n{sec_b}"
+    # span-table parity of names: the relayed child spans land under the
+    # same stage names the in-process run books directly
+    for name in ("serve.request", "serve.queue_wait", "associate"):
+        assert name in run_a.spans, name
+        assert name in run_b.spans, name
+
+    # the telemetry op answered live in BOTH topologies, and the isolated
+    # stats carry the worker-liveness satellite fields
+    for res in (inproc, iso):
+        tel = res["telemetry"]["telemetry"]
+        assert tel["windows"], "no telemetry window closed during the soak"
+        assert tel["cumulative"]["counters"]["serve.requests"] >= 4
+    w = iso["telemetry"]["worker"]
+    assert w["alive"] is True and w["consecutive_respawns"] == 0
+    assert isinstance(w["hb_age_s"], float) and w["hb_age_s"] < 60.0
+
+    # obs.trace assembles a served request end-to-end from the ISOLATED
+    # topology's events: queue wait + relayed execution with stage spans
+    from maskclustering_tpu.obs.trace import assemble_trace
+
+    rid = "r-000001"
+    trace = assemble_trace(rid, iso["events"],
+                           journal_dir=str(tmp_path / "teliso_journals"))
+    kinds = [s["kind"] for s in trace["segments"]]
+    assert "queue_wait" in kinds and "attempt" in kinds
+    execution = next(s for s in trace["segments"] if s["kind"] == "attempt")
+    assert "worker pid" in execution["detail"]
+    assert any(c["label"] == "associate" for c in execution["children"])

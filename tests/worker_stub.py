@@ -1,10 +1,13 @@
 """A jax-free stand-in for serve/worker_main.py (supervisor unit tests).
 
-Speaks the exact stdio pipe protocol (ready / hb / status / result / bye)
-in milliseconds, so the supervisor's heartbeat, SIGKILL-on-wedge, respawn,
-requeue and drain logic are all testable without paying two jax startups.
-Scene names script behaviors; "once-only" behaviors leave a marker file in
-$STUB_DIR so the RESPAWNED stub serves the same scene cleanly:
+Speaks the exact stdio pipe protocol (ready / hb / telem / status /
+result / bye) in milliseconds, so the supervisor's heartbeat,
+SIGKILL-on-wedge, respawn, requeue, drain AND telemetry-relay logic are
+all testable without paying two jax startups. Each request emits one
+``telem`` line (counter deltas + a relayed ``serve.request`` span) before
+its result, mirroring worker_main's request-boundary flush. Scene names
+script behaviors; "once-only" behaviors leave a marker file in $STUB_DIR
+so the RESPAWNED stub serves the same scene cleanly:
 
     stub-ok     answer ok after 50 ms
     stub-crash  SIGKILL this process mid-request (once; then ok)
@@ -40,6 +43,7 @@ def once(name) -> bool:
 
 def main():
     hb_stop = threading.Event()
+    seq = [0]  # telem sequence counter (one line per served request)
 
     def hb():
         while not hb_stop.wait(0.05):
@@ -73,7 +77,20 @@ def main():
             hb_stop.set()
             while True:
                 time.sleep(60)
-        time.sleep(1.5 if scene == "stub-slow" else 0.05)
+        dur = 1.5 if scene == "stub-slow" else 0.05
+        time.sleep(dur)
+        # worker_main's request-boundary telemetry flush, in miniature:
+        # counter deltas fold into the parent registry, the span replays
+        seq[0] += 1
+        emit({"kind": "telem", "v": 1, "seq": seq[0],
+              "metrics": {"counters": {"serve.requests": 1,
+                                       "serve.requests_ok": 1,
+                                       "d2h.bytes": 4096,
+                                       "pipeline.host_sync": 1},
+                          "gauges": {}},
+              "spans": [{"name": "serve.request", "dur_s": dur,
+                         "sync_s": 0.0, "depth": 0, "ts": time.time(),
+                         "attrs": {"request": rid, "scene": scene}}]})
         emit({"kind": "result", "id": rid, "status": "ok", "seconds": 0.05,
               "attempts": 1, "rung": doc.get("crashes", 0),
               "buckets_new": 0, "crashes_seen": doc.get("crashes", 0)})
