@@ -110,6 +110,11 @@ def _emit(args, times, error=None, stage_timings=None):
     # be attributable in the trajectory (obs.report --regress flags flips)
     line["postprocess_path"] = (
         "host" if getattr(args, "host_postprocess", False) else "device")
+    # point-shard attribution: bench.py itself is the single-chip harness
+    # (point_shards lives on the fused mesh path — scripts/mesh_bench.py
+    # carries the knob), so the stamp records the era's unsharded baseline
+    # the same way plane_dtype does; mesh rows stamp their true count
+    line["point_shards"] = 1
     if getattr(args, "obs_events", None) and not getattr(args, "no_obs", False):
         # point the record at its own span stream (report CLI renders it)
         line["obs_events"] = args.obs_events
@@ -383,6 +388,7 @@ def _supervise(args):
         line.setdefault("plane_dtype", "int16")
         line.setdefault("postprocess_path",
                         "host" if args.host_postprocess else "device")
+        line.setdefault("point_shards", 1)
         return line
 
     def _on_term(signum, frame):

@@ -4,7 +4,7 @@
 
     python -m maskclustering_tpu.analysis \
         [--baseline analysis_baseline.json] [--format text|json] \
-        [--families ir,ast,concurrency] [--mesh SxF ...] \
+        [--families ir,ast,concurrency] [--mesh SxF[xP] ...] \
         [--events out.jsonl] [--write-baseline PATH]
 
 Exit codes: 0 clean (every finding suppressed by the baseline), 2 on any
@@ -85,12 +85,12 @@ def run_analysis(families: List[str], meshes, repo_root: str,
     if "ir" in families or retrace_needs_lowerings:
         from maskclustering_tpu.analysis.ir_checks import (
             CANONICAL_SHAPE,
-            LATTICE,
+            FULL_LATTICE,
         )
         from maskclustering_tpu.obs.cost import ensure_cpu_devices, observe_costs
 
         ensure_cpu_devices(8)
-        rows = observe_costs(tuple(meshes or LATTICE), stages=("fused",),
+        rows = observe_costs(tuple(meshes or FULL_LATTICE), stages=("fused",),
                              keep_texts=True, **CANONICAL_SHAPE)
         lowerings = {tuple(r["mesh"]): (r["stablehlo"], r["compiled_text"])
                      for r in rows if "stablehlo" in r}
@@ -103,9 +103,12 @@ def run_analysis(families: List[str], meshes, repo_root: str,
 
         findings += analyze_concurrency(repo_root)
     if "ir" in families:
-        from maskclustering_tpu.analysis.ir_checks import LATTICE, analyze_ir
+        from maskclustering_tpu.analysis.ir_checks import (
+            FULL_LATTICE,
+            analyze_ir,
+        )
 
-        ir_findings, rows = analyze_ir(meshes or LATTICE,
+        ir_findings, rows = analyze_ir(meshes or FULL_LATTICE,
                                        repo_root=repo_root,
                                        lowerings=lowerings)
         findings += ir_findings
@@ -132,9 +135,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--families", default="ast,ir,concurrency,retrace",
                    help="comma-subset of {ast,ir,concurrency,retrace} "
                         "(default all)")
-    p.add_argument("--mesh", action="append", default=None, metavar="SxF",
+    p.add_argument("--mesh", action="append", default=None,
+                   metavar="SxF[xP]",
                    help="IR-family mesh config, repeatable (default: the "
-                        "full divisor lattice of 8)")
+                        "full (scene, frame) divisor lattice of 8 plus "
+                        "the canonical point-sharded cell 1x2x4)")
     p.add_argument("--events", default=None,
                    help="append findings as schema-versioned 'analysis' "
                         "events to this JSONL (render with obs.report)")

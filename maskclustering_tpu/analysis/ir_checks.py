@@ -33,6 +33,7 @@ need no chip and no new compile machinery. Four invariants:
 from __future__ import annotations
 
 import ast
+import math
 import os
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -50,6 +51,14 @@ CANONICAL_SHAPE = dict(frames=8, points=1024, image_hw=(24, 32), k_max=7)
 
 # the full divisor lattice of 8: every (scene, frame) factorization
 LATTICE: Tuple[Tuple[int, int], ...] = ((1, 8), (2, 4), (4, 2), (8, 1))
+
+# the point-sharded lattice cell the gate lowers by default: one 3-axis
+# (scene, frame, point) mesh is enough to pin the psum-over-point program
+# shape (the full 3-axis divisor sweep runs execution-level, slow-marked,
+# in tests/test_point_sharding.py). Kept to ONE mesh so the tier-1
+# conftest sweep pays a single extra AOT compile.
+POINT_LATTICE: Tuple[Tuple[int, int, int], ...] = ((1, 2, 4),)
+FULL_LATTICE: Tuple[Tuple[int, ...], ...] = LATTICE + POINT_LATTICE
 
 # counting-contraction operand class per cfg.count_dtype (ops/counting.py)
 COUNTING_DOT_CLASS = {"bf16": "bf16xbf16->f32", "int8": "i8xi8->i32"}
@@ -73,6 +82,13 @@ SCENE_DP_ICI_BUDGET_BYTES = 2.0
 # benign layout drift while a new data collective (~M_pad*F bytes at
 # minimum) still lands far outside it
 FRAME_SHARDED_ICI_BUDGET_BYTES = 128.0 * 1024
+# point-sharded envelope at CANONICAL_SHAPE (MESH_BENCH.md point-axis
+# census): the psum-over-point partial counts + routing gathers measured
+# 46-179 KB across the 3-axis lattice cells (1x2x4 = 162,660 B); 256 KiB
+# leaves ~45% headroom while the pathology this gate exists to catch —
+# the ~100 MB estimate-spacing all-to-all a naive point constraint
+# produced — lands 400x outside it
+POINT_SHARDED_ICI_BUDGET_BYTES = 256.0 * 1024
 
 # donated fused-step params: depths (1) and segs (2) — parallel/sharded.py
 # build_fused_step donate_argnums; utils/donation.py documents why their
@@ -185,11 +201,30 @@ def check_host_transfers(compiled_text: str, label: str) -> List[Finding]:
 
 def check_collective_budget(ici_bytes: float,
                             collectives: Dict[str, Dict[str, float]],
-                            mesh: Tuple[int, int], label: str,
+                            mesh: Tuple[int, ...], label: str,
                             canonical_shape: bool = True) -> List[Finding]:
     """Scene-DP <= 2 bytes always; frame-sharded within the envelope at
-    the canonical shape (budgets are shape-dependent there)."""
-    _, f_ax = mesh
+    the canonical shape (budgets are shape-dependent there); point-sharded
+    meshes get their own envelope — the psum-over-point partial counts are
+    sanctioned traffic, a resharding all-to-all of the (F, N) planes is
+    not."""
+    f_ax = mesh[1]
+    p_ax = mesh[2] if len(mesh) == 3 else 1
+    if p_ax > 1:
+        if not canonical_shape:
+            return []
+        if ici_bytes > POINT_SHARDED_ICI_BUDGET_BYTES:
+            return [Finding(
+                id=make_id("IR.COLLECTIVE.POINT", label),
+                check="IR.COLLECTIVE.POINT", family="ir",
+                message=f"{label}: point-sharded ICI payload "
+                        f"{ici_bytes:.0f} B exceeds the "
+                        f"{POINT_SHARDED_ICI_BUDGET_BYTES:.0f} B canonical-"
+                        f"shape envelope — a reshard of an N-sized "
+                        f"resident joined the fused step (the sanctioned "
+                        f"traffic is partial-count psums + small gathers; "
+                        f"see MESH_BENCH.md point-axis census)")]
+        return []
     if f_ax == 1:
         data_colls = {k: v for k, v in collectives.items()
                       if k != "all-reduce"}
@@ -350,7 +385,13 @@ def check_source_sync_sites(pipeline_path: str,
 # ---------------------------------------------------------------------------
 
 
-def _lower_fused(mesh_shape: Tuple[int, int], cfg, shape: Dict):
+def _mesh_label(mesh_shape: Tuple[int, ...]) -> str:
+    """SxF / SxFxP label (stdlib mirror of parallel.mesh.mesh_label so a
+    pure-AST analysis run never imports jax through this module)."""
+    return "x".join(str(int(d)) for d in mesh_shape)
+
+
+def _lower_fused(mesh_shape: Tuple[int, ...], cfg, shape: Dict):
     """(lowered, label) for the fused step on one lattice mesh."""
     from maskclustering_tpu.parallel.mesh import make_mesh
     from maskclustering_tpu.parallel.sharded import (
@@ -390,7 +431,7 @@ def _lower_groupcounts(shape: Dict):
 
 
 def analyze_ir(
-    meshes: Sequence[Tuple[int, int]] = LATTICE,
+    meshes: Sequence[Tuple[int, ...]] = FULL_LATTICE,
     *,
     shape: Optional[Dict] = None,
     cfg=None,
@@ -432,12 +473,12 @@ def analyze_ir(
     ab_dots: Dict[str, Dict] = {}
     analyzed = 0
     for mesh_shape in meshes:
-        if mesh_shape[0] * mesh_shape[1] != n_dev:
+        if math.prod(mesh_shape) != n_dev:
             # a mesh that does not fit the backend is skipped — but see the
             # IR.MESH backstop below: skipping EVERY mesh must not pass
             continue
         analyzed += 1
-        label = f"fused@{mesh_shape[0]}x{mesh_shape[1]}"
+        label = f"fused@{_mesh_label(mesh_shape)}"
         pre = (lowerings or {}).get(tuple(mesh_shape))
         if pre is not None:
             stablehlo, compiled_text = pre
@@ -454,7 +495,15 @@ def analyze_ir(
         findings += check_host_transfers(compiled_text, label)
         findings += check_collective_budget(ici, colls, mesh_shape, label,
                                             canonical_shape=canonical)
-        findings += check_donation(stablehlo, FUSED_DONATE_ARGNUMS, label)
+        if len(mesh_shape) < 3:
+            # the donation marker is a property of (program, backend), not
+            # of the mesh factorization: on this CPU gate it is ALWAYS
+            # dropped-as-unusable (the four 2-axis labels' baselined
+            # concession says exactly that), so a point-mesh instance
+            # would only mint another identical suppression.
+            # IR.DONATION.WIRING keeps source-level teeth on every mesh.
+            findings += check_donation(stablehlo, FUSED_DONATE_ARGNUMS,
+                                       label)
         rows.append({"target": label, "mesh": list(mesh_shape),
                      "count_dtype": cfg.count_dtype, "dots": dots,
                      "collectives": colls, "ici_bytes": ici,

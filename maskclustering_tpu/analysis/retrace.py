@@ -743,23 +743,31 @@ def compile_surface(cfg=None) -> Dict:
     }
 
 
-_MAIN_SIG_RE = re.compile(r"func\.func public @main\((.*?)\)",
+# anchor the close on ") ->" (the result arrow): a bare first-")" stop
+# truncates at sharding annotations that themselves contain parens — the
+# 3-axis meshes' device-order transposes lower as e.g. "<=[2,4]T(1,0)"
+_MAIN_SIG_RE = re.compile(r"func\.func public @main\((.*?)\)\s*->",
                           re.DOTALL)
 _TENSOR_RE = re.compile(r"tensor<([^>]+)>")
 
 
-def fused_surface_rows(lowerings: Dict[Tuple[int, int],
+def fused_surface_rows(lowerings: Dict[Tuple[int, ...],
                                        Tuple[str, str]]) -> List[str]:
     """One census row per fused-step lowering: mesh + the argument-shape
     digest read from the ACTUAL StableHLO main signature (the obs/cost.py
-    AOT seam) — a silent signature change is a surface change."""
+    AOT seam) — a silent signature change is a surface change. Mesh keys
+    are (scene, frame) or (scene, frame, point) tuples; the label is the
+    shared SxF / SxFxP vocabulary (parallel.mesh.mesh_label), so the
+    point-sharded fused-step variants are first-class census rows."""
+    from maskclustering_tpu.analysis.ir_checks import _mesh_label
+
     rows: List[str] = []
     for mesh, (stablehlo, _) in sorted(lowerings.items()):
         m = _MAIN_SIG_RE.search(stablehlo)
         shapes = _TENSOR_RE.findall(m.group(1)) if m else []
         digest = hashlib.sha1(
             ";".join(shapes).encode("utf-8")).hexdigest()[:12]
-        rows.append(f"fn=per_scene mesh={mesh[0]}x{mesh[1]} "
+        rows.append(f"fn=per_scene mesh={_mesh_label(mesh)} "
                     f"args={len(shapes)} sig={digest}")
     return rows
 
@@ -921,13 +929,13 @@ def analyze_retrace(
     if lowerings is None and lower_missing:
         from maskclustering_tpu.analysis.ir_checks import (
             CANONICAL_SHAPE,
-            LATTICE,
+            FULL_LATTICE,
         )
         from maskclustering_tpu.obs.cost import ensure_cpu_devices, observe_costs
 
         ensure_cpu_devices(8)
-        rows = observe_costs(LATTICE, stages=("fused",), keep_texts=True,
-                             **CANONICAL_SHAPE)
+        rows = observe_costs(FULL_LATTICE, stages=("fused",),
+                             keep_texts=True, **CANONICAL_SHAPE)
         lowerings = {tuple(r["mesh"]): (r["stablehlo"], r["compiled_text"])
                      for r in rows if "stablehlo" in r}
     if lowerings:

@@ -115,6 +115,20 @@ class PipelineConfig:
     # (scene, frame) device-mesh factorization for the fused multi-chip path
     # (parallel/batch.py); empty = single-device host pipeline
     mesh_shape: Tuple[int, ...] = ()
+    # third mesh axis: shard the scene-point dimension N over this many
+    # chips (parallel/mesh.py "point"). The (F, N) claim planes,
+    # mask_of_point and the cloud — the largest long-lived HBM residents
+    # — divide by it, turning the 192k-point honest ceiling into a knob
+    # (a 1M+ point ScanNet++/Matterport mesh fits at point_shards >= 4);
+    # the graph co-occurrence contractions psum partial counts over the
+    # axis, byte-identical under both count_dtype encodings
+    # (tests/test_point_sharding.py). Requires mesh_shape (the fused mesh
+    # path owns the axis); the device product becomes
+    # scene * frame * point_shards. Capacity note: an HBM-capacity
+    # failure at high N degrades best by RAISING this knob (more shards,
+    # same artifacts), not by dropping to the host-postprocess rung —
+    # the ladder's single-chip rung resets it to 1 like the mesh.
+    point_shards: int = 1
 
     # --- scene executor (run.py, single-chip scene queue) ---
     # overlap scene N's host tail (DBSCAN split, merge, export) on a worker
@@ -201,6 +215,14 @@ class PipelineConfig:
         if self.mesh_shape and len(self.mesh_shape) != 2:
             raise ValueError(
                 f"mesh_shape must be (scene, frame), got {self.mesh_shape}")
+        if self.point_shards < 1:
+            raise ValueError(
+                f"point_shards must be >= 1, got {self.point_shards}")
+        if self.point_shards > 1 and not self.mesh_shape:
+            raise ValueError(
+                "point_shards > 1 requires the fused mesh path — set "
+                "mesh_shape (scene, frame); the point axis is the mesh's "
+                "third axis, not a single-chip mode")
         if self.prefetch_depth < 0:
             raise ValueError(
                 f"prefetch_depth must be >= 0, got {self.prefetch_depth}")

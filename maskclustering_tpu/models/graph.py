@@ -117,6 +117,17 @@ def _cooccurrence(mask_of_point: jnp.ndarray, boundary: jnp.ndarray,
     accumulate in the encoding's exact accumulator dtype (f32 or s32) and
     the final c converts to f32 — exact for any count below 2^24, so both
     encodings return identical arrays.
+
+    Point-sharded meshes (parallel/mesh.py "point" axis): ``mask_of_point``
+    arrives with N sharded, the contraction dimension of every chunk
+    matmul — GSPMD computes each shard's partial count and psums the
+    (M_pad, M_pad) accumulator over the ``point`` axis (the SNIPPETS
+    partition-rule pattern: a contraction over a sharded dim is partial
+    results + all-reduce). Partial-sum order cannot move a byte: the
+    summands are exact integers and both accumulators (f32 below 2^24,
+    s32 below 2^31) are associative on them, which is why the
+    sharded-vs-unsharded byte-identity pin holds for BOTH count_dtype
+    encodings (tests/test_point_sharding.py).
     """
     f, n = mask_of_point.shape
     m_pad = mask_frame.shape[0]

@@ -646,6 +646,18 @@ def postprocess_scene_device(
     chunks materialize and unpack in order — the unpack of chunk i rides
     under chunk i+1's DMA (byte-identical at any chunk size).
 
+    Point-sharded inputs (the fused mesh path with ``cfg.point_shards``
+    > 1 hands ``first``/``last`` in with their N columns sharded over the
+    ``point`` mesh axis) run this chain unchanged: the kernels compile
+    against the committed shardings, the claim planes are still consumed
+    in HBM, and each drained chunk assembles per-shard (one DMA per
+    addressable shard under ``copy_to_host_async``). The largest single
+    host materialization stays one chunk of bit-packed survivor rows —
+    ``pull_chunk x ceil(N/8)`` bytes, O(N) not O(F*N) — recorded on the
+    ``post.drain.max_chunk_bytes`` gauge, which the 1M-point acceptance
+    test pins far below one (F, N) plane
+    (tests/test_point_sharding.py).
+
     ``donate=True`` donates the (F, N) first/last tensors into the final
     group-counts kernel — their HBM frees mid-postprocess instead of at
     scene teardown. The caller must not touch them afterwards.
@@ -829,14 +841,22 @@ def postprocess_scene_device(
             _start_host_copy(c)
         _start_host_copy(inter_d)
         pulled = 0
+        max_chunk = 0
         parts = []
         for c in chunks:
             h = np.asarray(c)  # already landed (or blocks on the DMA)
             pulled += h.nbytes
+            max_chunk = max(max_chunk, h.nbytes)
             parts.append(_unpack_bits(h, n))
         member = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
         inter = np.asarray(inter_d)[:o, :o]
         sp.set(chunks=len(chunks))
+        # the drain's host-buffer ceiling: the largest single pull any
+        # scene of this process materialized (high-water, so multi-scene
+        # runs keep the worst case). The point-sharding acceptance test
+        # pins it under one (F, N) claim plane — the emit-only contract
+        # stated as a counter, not a comment
+        obs.gauge_max("post.drain.max_chunk_bytes", float(max_chunk))
         obs.count_transfer("d2h", pulled + np.asarray(inter_d).nbytes,
                            "post.drain")
     t.mark("emit")

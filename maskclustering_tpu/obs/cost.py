@@ -249,27 +249,28 @@ DEFAULT_MESHES: Tuple[Tuple[int, int], ...] = ((1, 8), (8, 1))
 ALL_STAGES = ("backprojection", "graph", "clustering", "postprocess", "fused")
 
 
-def parse_mesh_specs(specs: Sequence[str]) -> List[Tuple[int, int]]:
+def parse_mesh_specs(specs: Sequence[str]) -> List[Tuple[int, ...]]:
     """CLI mesh parsing shared by ``obs.cost`` and ``report --cost``.
 
-    Accepts ``SCENExFRAME`` items, each optionally comma-joined
-    (``["1x8", "8x1"]`` or ``["1x8,8x1"]``). Raises ValueError with a
-    message the CLIs can surface instead of a traceback.
+    Accepts ``SCENExFRAME`` or ``SCENExFRAMExPOINT`` items, each
+    optionally comma-joined (``["1x8", "1x2x4"]`` or ``["1x8,1x2x4"]``).
+    Raises ValueError with a message the CLIs can surface instead of a
+    traceback.
     """
-    meshes: List[Tuple[int, int]] = []
+    meshes: List[Tuple[int, ...]] = []
     for item in specs:
         for m in item.split(","):
             if not m:
                 continue
-            s, sep, f = m.partition("x")
+            parts = m.split("x")
             try:
-                if not sep:
+                if len(parts) not in (2, 3):
                     raise ValueError
-                meshes.append((int(s), int(f)))
+                meshes.append(tuple(int(p) for p in parts))
             except ValueError:
                 raise ValueError(
-                    f"bad mesh spec {m!r}: expected SCENExFRAME, e.g. 1x8"
-                ) from None
+                    f"bad mesh spec {m!r}: expected SCENExFRAME[xPOINT], "
+                    f"e.g. 1x8 or 1x2x4") from None
     return meshes
 
 
@@ -351,14 +352,20 @@ def observe_costs(
                    "image_hw": list(image_hw), "k_max": k_max,
                    "backend": jax.default_backend()}
     for mesh_shape in mesh_shapes:
-        s_ax, f_ax = mesh_shape
-        if s_ax * f_ax != n_dev:
+        # 2-tuple = (scene, frame); 3-tuple adds the point axis
+        s_ax, f_ax = mesh_shape[0], mesh_shape[1]
+        p_ax = mesh_shape[2] if len(mesh_shape) == 3 else 1
+        if s_ax * f_ax * p_ax != n_dev:
             log.warning("cost observatory: mesh %s needs %d devices, have %d "
-                        "— skipped", mesh_shape, s_ax * f_ax, n_dev)
+                        "— skipped", mesh_shape, s_ax * f_ax * p_ax, n_dev)
             continue
         if frames % f_ax:
             log.warning("cost observatory: frames=%d not divisible by frame "
                         "axis %d — mesh %s skipped", frames, f_ax, mesh_shape)
+            continue
+        if points % p_ax:
+            log.warning("cost observatory: points=%d not divisible by point "
+                        "axis %d — mesh %s skipped", points, p_ax, mesh_shape)
             continue
         mesh = make_mesh(mesh_shape)
         scenes = s_ax
@@ -510,8 +517,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="AOT cost observatory: collective census + rooflines "
                     "per (stage, mesh), computed on CPU virtual devices")
     p.add_argument("--mesh", action="append", default=None,
-                   metavar="SxF", help="mesh config, e.g. 1x8 (repeatable; "
-                   "default: 1x8 and 8x1)")
+                   metavar="SxF[xP]",
+                   help="mesh config, e.g. 1x8 or 1x2x4 — a third factor "
+                        "shards the point axis (repeatable; default: 1x8 "
+                        "and 8x1)")
     p.add_argument("--stages", default=",".join(ALL_STAGES),
                    help=f"comma-separated subset of {ALL_STAGES}")
     p.add_argument("--frames", type=int, default=8)

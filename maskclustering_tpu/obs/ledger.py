@@ -91,8 +91,8 @@ def bench_row(verdict: Dict, **extra) -> Dict:
            "unit": verdict.get("unit", "s/scene")}
     for k in ("vs_baseline", "spread_pct", "stages", "attempts",
               "frame_batch", "count_dtype", "plane_dtype",
-              "postprocess_path", "retrace_compiles", "retrace_repeats",
-              "retrace_post_freeze", "error"):
+              "postprocess_path", "point_shards", "retrace_compiles",
+              "retrace_repeats", "retrace_post_freeze", "error"):
         if verdict.get(k) is not None:
             row[k] = verdict[k]
     row.update(extra)
@@ -174,8 +174,8 @@ def serve_row(verdict: Dict, **extra) -> Dict:
            "unit": verdict.get("unit", "s/request")}
     for k in ("p95_s", "throughput_rps", "requests", "concurrency",
               "scenes", "buckets", "rejects", "failed", "warmup_s",
-              "count_dtype", "plane_dtype", "retrace_compiles",
-              "retrace_repeats", "retrace_post_freeze",
+              "count_dtype", "plane_dtype", "point_shards",
+              "retrace_compiles", "retrace_repeats", "retrace_post_freeze",
               "retrace_cache_hits", "aot_restored", "worker_crashes",
               "worker_respawns", "telemetry_windows", "window_p95",
               "error"):
@@ -282,8 +282,12 @@ def check_regression(current: Optional[Dict], baseline: Optional[Dict], *,
     # historical defaults; postprocess_path predates as "device": rows
     # before the knob ran the default device path)
     knob_flips = []
+    # point_shards defaults to 1: rows predating the knob ran unsharded,
+    # so a sharded row against an old baseline reads as a knob flip (the
+    # resharded program has its own compile surface and ICI profile)
     for knob, default in (("count_dtype", "bf16"), ("plane_dtype", "int32"),
-                          ("postprocess_path", "device")):
+                          ("postprocess_path", "device"),
+                          ("point_shards", 1)):
         c, b = current.get(knob, default), baseline.get(knob, default)
         if c != b:
             knob_flips.append(knob)

@@ -25,6 +25,16 @@ exceed the operand dtype's range — see ARCHITECTURE.md "Integer counting
 dtype policy" for the per-site audit. Small multi-valued operands (the
 postprocess claim-correction matrix holds {0, 1, 2}) are fine: both bf16
 and int8 represent them exactly.
+
+Sharded contraction dims (the point-axis mesh, parallel/mesh.py): when a
+caller's contraction dimension is sharded — the graph co-occurrence and
+node-stats counts contract over the point-sharded N — XLA partitions the
+dot into per-shard partials accumulated in the SAME exact dtype this
+module selects (f32 or s32), then psums over the axis. Exactness is what
+makes that safe under BOTH encodings: integer summands in an associative
+accumulator mean shard order cannot change a byte, so the byte-identity
+contract extends to any shard count without a per-site audit
+(tests/test_point_sharding.py pins it end-to-end).
 """
 
 from __future__ import annotations

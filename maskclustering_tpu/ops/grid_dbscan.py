@@ -43,6 +43,17 @@ Distances compare in f32 on device vs f64 on host; both see the same
 f32 coordinates, so decisions only diverge for pairs within f32 rounding
 of ``eps`` exactly — the same tolerance `dbscan_fixed_jax` already accepts
 on the parity path.
+
+Point-sharded scenes (``cfg.point_shards`` > 1, the fused mesh path):
+the split kernel's inputs arrive with their N dimension sharded over the
+``point`` mesh axis; the pair compaction (`jnp.nonzero` at the C_pad
+bucket) is a global enumeration, so GSPMD gathers the (r_pad, N)
+candidate plane once — bounded, bool-typed, and orders of magnitude
+under the (F, N) claim planes the emit-only drain keeps in HBM. The
+grid itself is host geometry either way (the cloud never left the host
+on any path), so nothing here depends on the shard count; byte-identity
+across shard counts rides the same label-for-label pin as the host
+dispatch (tests/test_point_sharding.py).
 """
 
 from __future__ import annotations

@@ -1,7 +1,14 @@
 """Mesh sharding and multi-chip execution (ICI/DCN collectives via XLA)."""
 
 from maskclustering_tpu.parallel.batch import cluster_scene_batch, fused_scene_objects
-from maskclustering_tpu.parallel.mesh import constrain, make_mesh, sharding
+from maskclustering_tpu.parallel.mesh import (
+    constrain,
+    make_mesh,
+    mesh_label,
+    point_axis_size,
+    point_spec,
+    sharding,
+)
 from maskclustering_tpu.parallel.sharded import (
     FusedStepResult,
     build_fused_step,
@@ -13,6 +20,9 @@ __all__ = [
     "constrain",
     "fused_scene_objects",
     "make_mesh",
+    "mesh_label",
+    "point_axis_size",
+    "point_spec",
     "sharding",
     "FusedStepResult",
     "build_fused_step",

@@ -29,7 +29,7 @@ from maskclustering_tpu.config import PipelineConfig
 from maskclustering_tpu.datasets.base import SceneTensors
 from maskclustering_tpu.models.pipeline import bucket_k_max
 from maskclustering_tpu.models.postprocess import SceneObjects
-from maskclustering_tpu.parallel.mesh import make_mesh
+from maskclustering_tpu.parallel.mesh import make_mesh, point_axis_size
 from maskclustering_tpu.parallel.sharded import build_fused_step
 
 from maskclustering_tpu.datasets.base import PAD_COORD as _PAD_COORD
@@ -41,12 +41,18 @@ def _round_up(value: int, multiple: int) -> int:
 
 def batch_shapes(tensors_list: Sequence[SceneTensors], cfg: PipelineConfig,
                  mesh) -> Tuple[int, int]:
-    """(F_pad, N_pad) shared static shapes for a scene batch on ``mesh``."""
+    """(F_pad, N_pad) shared static shapes for a scene batch on ``mesh``.
+
+    On a point mesh N additionally pads to a multiple of the point axis
+    so every shard holds an equal column slice of the (F, N) planes (the
+    lcm keeps the historical point_chunk rounding when the axis is 1 or
+    divides the chunk, which every pow2 shard count does).
+    """
     f_axis = int(mesh.shape["frame"])
     f_mult = math.lcm(f_axis, max(cfg.frame_pad_multiple, 1))
     f_pad = _round_up(max(t.num_frames for t in tensors_list), f_mult)
-    n_pad = _round_up(max(t.num_points for t in tensors_list),
-                      max(cfg.point_chunk, 1))
+    n_mult = math.lcm(point_axis_size(mesh), max(cfg.point_chunk, 1))
+    n_pad = _round_up(max(t.num_points for t in tensors_list), n_mult)
     return f_pad, n_pad
 
 
@@ -194,5 +200,15 @@ def cluster_scene_batch(
 
 
 def make_run_mesh(cfg: PipelineConfig):
-    """Mesh from cfg.mesh_shape over the available devices."""
-    return make_mesh(tuple(cfg.mesh_shape))
+    """Mesh from cfg.mesh_shape (+ cfg.point_shards) over the devices.
+
+    ``point_shards > 1`` appends the third mesh axis: the device product
+    becomes scene * frame * point, validated by make_mesh against the
+    backend's device count (config.py already rejects point_shards > 1
+    without a mesh). ``point_shards == 1`` builds the historical 2-axis
+    mesh — same axis names, same programs, same compile-cache keys.
+    """
+    shape = tuple(cfg.mesh_shape)
+    if cfg.point_shards > 1:
+        shape = shape + (int(cfg.point_shards),)
+    return make_mesh(shape)

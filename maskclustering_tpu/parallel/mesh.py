@@ -14,6 +14,17 @@ Axis convention for this workload:
   per-frame association is embarrassingly parallel and the mask axis
   (masks are ordered by frame) inherits the same sharding for the
   O(M^2) affinity matmuls.
+- ``point``  — optional third axis (``cfg.point_shards > 1``): the scene
+  cloud and every (.., N)-shaped resident — ``mask_of_point`` and the
+  (F, N) first/last claim planes, the scene's largest HBM tenants —
+  shard over it, so million-point scenes divide across chips instead of
+  hitting one chip's HBM wall. Points are embarrassingly parallel
+  through backprojection/association; the graph co-occurrence
+  contractions reduce over the point axis, so XLA turns them into
+  per-shard partial counts + a psum over ``point`` (exact in either
+  counting encoding: the accumulators are f32/s32 and the summands are
+  integers, so partial-sum order cannot change a byte). Per-frame
+  camera/image tensors stay replicated across ``point``.
 """
 
 from __future__ import annotations
@@ -24,10 +35,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+# canonical axis-name ladder: a 2-tuple shape is (scene, frame), a 3-tuple
+# adds the trailing point axis (ONE vocabulary across parallel/, the cost
+# observatory, mct-check's IR lattice and the AOT-cache mesh coordinate)
+MESH_AXIS_NAMES: Tuple[str, ...] = ("scene", "frame", "point")
+
 
 def make_mesh(
     shape: Optional[Tuple[int, ...]] = None,
-    axis_names: Tuple[str, ...] = ("scene", "frame"),
+    axis_names: Optional[Tuple[str, ...]] = None,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build a Mesh over the available devices.
@@ -35,14 +51,50 @@ def make_mesh(
     With ``shape=None`` all devices land on the last axis (pure
     sequence/tensor parallelism); a leading ``scene`` axis of size 1 keeps
     the in_shardings uniform whether or not scene DP is used.
+    ``axis_names=None`` resolves from the canonical ladder by rank: a
+    2-tuple shape is ``(scene, frame)``, a 3-tuple ``(scene, frame,
+    point)``.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    if axis_names is None:
+        rank = len(shape) if shape is not None else 2
+        if not (1 <= rank <= len(MESH_AXIS_NAMES)):
+            raise ValueError(f"mesh shape {shape} has rank {rank}; the "
+                             f"axis ladder is {MESH_AXIS_NAMES}")
+        axis_names = MESH_AXIS_NAMES[:rank]
     if shape is None:
         shape = (1,) * (len(axis_names) - 1) + (n,)
     if int(np.prod(shape)) != n:
         raise ValueError(f"mesh shape {shape} does not cover {n} devices")
     return Mesh(np.array(devices).reshape(shape), axis_names)
+
+
+def mesh_label(shape: Tuple[int, ...]) -> str:
+    """``SxF`` / ``SxFxP`` label of a mesh shape — ONE string vocabulary
+    across the cost observatory rows, mct-check's fused-surface census,
+    the AOT-cache mesh coordinate and the CLI ``--mesh`` grammar."""
+    return "x".join(str(int(d)) for d in shape)
+
+
+def point_spec(mesh: Optional[Mesh]) -> Optional[str]:
+    """``"point"`` when the mesh carries a point axis, else None.
+
+    A None entry in a PartitionSpec means replicated, so constraint sites
+    can thread this straight into their specs: 2-axis meshes compile the
+    byte-identical historical program (the point entry degenerates to
+    replication) and 3-axis meshes shard the N-sized dimensions.
+    """
+    if mesh is not None and "point" in mesh.axis_names:
+        return "point"
+    return None
+
+
+def point_axis_size(mesh: Optional[Mesh]) -> int:
+    """Size of the mesh's point axis (1 when absent — unsharded points)."""
+    if mesh is not None and "point" in mesh.axis_names:
+        return int(mesh.shape["point"])
+    return 1
 
 
 def sharding(mesh: Mesh, *spec) -> NamedSharding:

@@ -14,7 +14,17 @@ over a `jax.sharding.Mesh`, with a leading scene batch axis:
 - masks   -> masks are ordered by frame, so the (M_pad, F) visibility and
   (M_pad, M_pad) containment/affinity matrices row-shard over the same
   ``frame`` axis; the V@V^T / C@C^T consensus matmuls become
-  all-gather + local matmul, inserted by XLA from the constraints.
+  all-gather + local matmul, inserted by XLA from the constraints;
+- points  -> with a ``point`` mesh axis (cfg.point_shards > 1) the scene
+  cloud, ``mask_of_point`` and the (F, N) first/last claim planes — the
+  largest long-lived HBM residents — column-shard over it. Association
+  is elementwise in N (each shard backprojects its own points against
+  the replicated frames), and the graph co-occurrence/observer
+  contractions reduce over N, which XLA partitions as per-shard partial
+  counts + a psum over ``point`` — exact under both counting encodings
+  (integer summands in f32/s32 accumulators; order cannot move a byte),
+  so artifacts stay byte-identical to the unsharded program
+  (tests/test_point_sharding.py).
 
 This fused path uses a *dense* mask slot table (slot = frame * K_max + id),
 trading padding FLOPs for zero host syncs — the right trade on a pod where
@@ -38,7 +48,12 @@ from maskclustering_tpu.io.feed import (
 from maskclustering_tpu.models.backprojection import associate_frame, estimate_spacing
 from maskclustering_tpu.models.clustering import iterative_clustering
 from maskclustering_tpu.models.graph import compute_graph_stats, observer_schedule_device
-from maskclustering_tpu.parallel.mesh import constrain, sharding
+from maskclustering_tpu.parallel.mesh import (
+    constrain,
+    mesh_label,
+    point_spec,
+    sharding,
+)
 
 
 def _maybe_constrain(x, mesh, *spec):
@@ -78,8 +93,22 @@ def _assoc_stage(cfg, k_max, mesh, scene_points, depths, segs, intrinsics,
     segs = decode_seg(segs)
 
     # ---- association: vmap over frames (sequence-parallel) ----
+    # the point-axis constraints are strictly additive: on a 2-axis mesh
+    # pt is None and no new constraint is emitted, so the historical
+    # frame-sharded program lowers unchanged
+    pt = point_spec(mesh)
+    spacing_cloud = scene_points
+    if pt is not None:
+        # the spacing estimate is a scalar statistic of a ~2k-point
+        # sample; feeding it the point-sharded cloud makes GSPMD reshard
+        # the (sample, chunk) all-pairs intermediate mid-reduction
+        # (observed: a ~100 MB all-to-all at the 1k-point canonical
+        # shape). A replicated copy costs one N x 3 all-gather and the
+        # estimate runs shard-locally, byte-identically.
+        spacing_cloud = _maybe_constrain(scene_points, mesh, None, None)
+        scene_points = _maybe_constrain(scene_points, mesh, pt, None)
     vox_size = jnp.maximum(jnp.float32(cfg.distance_threshold),
-                           estimate_spacing(scene_points))
+                           estimate_spacing(spacing_cloud))
 
     def one_frame(depth, seg, intr, c2w, fv):
         fa = associate_frame(
@@ -95,12 +124,17 @@ def _assoc_stage(cfg, k_max, mesh, scene_points, depths, segs, intrinsics,
 
     mop, first, last, mask_valid = jax.vmap(one_frame)(
         depths, segs, intrinsics, cam_to_world, frame_valid)
-    mop = _maybe_constrain(mop, mesh, "frame", None)
-    first = _maybe_constrain(first, mesh, "frame", None)
-    last = _maybe_constrain(last, mesh, "frame", None)
+    # the (F, N) residents shard over frame AND — on a point mesh — the
+    # point axis (their N columns divide across chips; that residency cut
+    # is the whole reason the axis exists)
+    mop = _maybe_constrain(mop, mesh, "frame", pt)
+    first = _maybe_constrain(first, mesh, "frame", pt)
+    last = _maybe_constrain(last, mesh, "frame", pt)
 
     # cross-frame reductions: XLA lowers these to psums over `frame`
     boundary = jnp.any(first != last, axis=0)
+    if pt is not None:
+        boundary = _maybe_constrain(boundary, mesh, pt)
     return mop, first, last, mask_valid, boundary
 
 
@@ -194,8 +228,14 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
         return jax.jit(jax.vmap(per_scene))
     batched = jax.vmap(per_scene, spmd_axis_name="scene")
 
+    # point-axis policy: the scene cloud and the (F, N) planes shard their
+    # N dimension over `point`; per-frame camera/image tensors omit the
+    # axis from their spec, i.e. stay replicated across it (every point
+    # shard backprojects against the full frame set). pt is None on a
+    # 2-axis mesh, where these specs are exactly the historical ones.
+    pt = point_spec(mesh)
     in_shardings = (
-        sharding(mesh, "scene"),                 # scene_points (S, N, 3)
+        sharding(mesh, "scene", pt),             # scene_points (S, N, 3)
         sharding(mesh, "scene", "frame"),        # depths (S, F, H, W)
         sharding(mesh, "scene", "frame"),        # segs
         sharding(mesh, "scene", "frame"),        # intrinsics
@@ -206,9 +246,9 @@ def build_fused_step(mesh, cfg, *, k_max: int = 15, donate: bool = False):
         assignment=sharding(mesh, "scene", "frame"),
         node_visible=sharding(mesh, "scene", "frame", None),
         mask_active=sharding(mesh, "scene", "frame"),
-        mask_of_point=sharding(mesh, "scene", "frame", None),
-        first_id=sharding(mesh, "scene", "frame", None),
-        last_id=sharding(mesh, "scene", "frame", None),
+        mask_of_point=sharding(mesh, "scene", "frame", pt),
+        first_id=sharding(mesh, "scene", "frame", pt),
+        last_id=sharding(mesh, "scene", "frame", pt),
         num_objects=sharding(mesh, "scene"),
     )
     return jax.jit(
@@ -234,11 +274,15 @@ def fused_step_aot_key(mesh, cfg, k_max: int, args):
     per mesh. ``args`` supplies the batched arg avals (shapes + dtypes,
     nothing is read); parallel/batch.py consults/captures through this
     seam so a respawned process re-dispatches the serialized step instead
-    of re-tracing ~400 frames of scan body.
+    of re-tracing ~400 frames of scan body. The mesh descriptor is the
+    compile-surface mesh label — ``SxF`` historically, ``SxFxP`` on a
+    point mesh — so the point-shard count is a first-class cache-key
+    coordinate (a resharded deployment never dispatches a stale layout).
     """
     from maskclustering_tpu.utils import aot_cache
 
-    mesh_desc = (f"{int(mesh.shape['scene'])}x{int(mesh.shape['frame'])}"
+    mesh_desc = (mesh_label(tuple(int(mesh.shape[a])
+                                  for a in mesh.axis_names))
                  if mesh is not None else "none")
     return aot_cache.key_for(
         "per_scene", args,
@@ -290,13 +334,14 @@ def build_stage_step(stage: str, mesh, cfg, *, k_max: int = 15,
 
         return jax.jit(post)
 
+    pt = point_spec(mesh)
     if stage == "backprojection":
         fn = lambda *args: _assoc_stage(cfg, k_max, mesh, *args)  # noqa: E731
-        specs = (("scene",), ("scene", "frame"), ("scene", "frame"),
+        specs = (("scene", pt), ("scene", "frame"), ("scene", "frame"),
                  ("scene", "frame"), ("scene", "frame"), ("scene", "frame"))
     elif stage == "graph":
         fn = lambda *args: _graph_stage(cfg, k_max, mesh, *args)  # noqa: E731
-        specs = (("scene", "frame", None), ("scene",), ("scene", "frame"))
+        specs = (("scene", "frame", pt), ("scene", pt), ("scene", "frame"))
     else:  # clustering
         fn = lambda *args: _cluster_stage(cfg, mesh, *args)  # noqa: E731
         specs = (("scene", "frame", None), ("scene", "frame", None),

@@ -1276,6 +1276,7 @@ def _run_pipeline_body(
                             # --regress flags flips instead of blaming code
                             count_dtype=cfg.count_dtype,
                             plane_dtype="int16",
+                            point_shards=int(cfg.point_shards),
                             postprocess_path=("device"
                                               if cfg.device_postprocess
                                               else "host")))
@@ -1319,6 +1320,17 @@ def main(argv=None) -> int:
                         help="serialize the scene loop (disable the "
                              "overlapped executor; artifacts are identical "
                              "either way)")
+    parser.add_argument("--point-shards", type=int, default=None,
+                        help="shard the scene-point axis N over this many "
+                             "chips (third mesh axis; needs the config's "
+                             "mesh_shape — device product becomes "
+                             "scene*frame*point). The (F, N) claim planes "
+                             "and the cloud divide by it, so 1M+ point "
+                             "scenes fit; artifacts are byte-identical at "
+                             "any shard count "
+                             "(tests/test_point_sharding.py). The ledger "
+                             "row stamps point_shards so --regress "
+                             "attributes the flip, not code drift")
     parser.add_argument("--no-resume", action="store_true",
                         help="recompute even when artifacts exist")
     parser.add_argument("--encoder", default="hash",
@@ -1415,6 +1427,8 @@ def main(argv=None) -> int:
         overrides["prefetch_depth"] = args.prefetch_depth
     if args.no_overlap:
         overrides["scene_overlap"] = False
+    if args.point_shards is not None:
+        overrides["point_shards"] = args.point_shards
     if args.scene_retries is not None:
         overrides["scene_retries"] = args.scene_retries
     if args.watchdog_device is not None:

@@ -121,6 +121,13 @@ def main(argv=None) -> int:
                              "worker reaches first dispatch with zero "
                              "compiles (flag alone: aot_cache/ next to "
                              "the perf ledger; also via $MCT_AOT_CACHE)")
+    parser.add_argument("--point-shards", type=int, default=None,
+                        help="shard the scene-point axis over this many "
+                             "chips (third mesh axis of the fused path; "
+                             "needs the config's mesh_shape). Million-"
+                             "point requests fit without widening any "
+                             "per-chip HBM bucket; shorthand for "
+                             "--set point_shards=N")
     parser.add_argument("--set", action="append", default=[],
                         metavar="KEY=VALUE", dest="overrides",
                         help="override a config field (repeatable; value "
@@ -145,6 +152,8 @@ def main(argv=None) -> int:
 
     overrides = {"data_root": args.data_root} if args.data_root else {}
     overrides.update(_parse_overrides(args.overrides))
+    if args.point_shards is not None:
+        overrides["point_shards"] = args.point_shards
     if args.aot_cache is not None:
         overrides["aot_cache_dir"] = args.aot_cache
     cfg = load_config(args.config, **overrides)

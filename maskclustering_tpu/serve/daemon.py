@@ -431,6 +431,10 @@ class ServeDaemon:
             retrace = retrace_sanitizer.summary()
         return {
             "config": self.cfg.config_name,
+            # perf-attribution coordinate (ledger serve rows + --regress
+            # knob-flip advisory): a resharded daemon's latency profile is
+            # the knob's, not code drift's
+            "point_shards": int(self.cfg.point_shards),
             "uptime_s": round(time.monotonic() - self._started_at, 2)
             if self._started_at else 0.0,
             "warmup_s": round(self._warmup_s, 2),

@@ -410,7 +410,13 @@ def _cfg_statics(cfg) -> Dict[str, str]:
     actually dispatch. Keys absent from an entry's statics (or from this
     map — e.g. ``k_max``, which legitimately varies per shape bucket)
     never disqualify it."""
-    mesh_desc = ("x".join(str(int(d)) for d in cfg.mesh_shape)
+    # the SAME SxF / SxFxP label fused_step_aot_key stamps (parallel/
+    # mesh.mesh_label): point_shards is a compile-surface coordinate, so
+    # a resharded deployment filters to its own mesh's entries
+    shape = tuple(cfg.mesh_shape)
+    if cfg.mesh_shape and cfg.point_shards > 1:
+        shape = shape + (int(cfg.point_shards),)
+    mesh_desc = ("x".join(str(int(d)) for d in shape)
                  if cfg.mesh_shape else "none")
     return {
         "window": str(cfg.association_window),

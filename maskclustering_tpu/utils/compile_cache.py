@@ -18,6 +18,7 @@ compilation of the association scan is the single largest fixed cost
 from __future__ import annotations
 
 import logging
+import math
 import os
 from typing import Optional, Set, Tuple
 
@@ -86,9 +87,18 @@ def bucket_size(value: int, multiple: int) -> int:
 
 
 def scene_pads(cfg, frames: int, points: int) -> Tuple[int, int]:
-    """(f_pad, n_pad) of a scene under ``cfg``'s padding multiples."""
+    """(f_pad, n_pad) of a scene under ``cfg``'s padding multiples.
+
+    ``point_shards`` joins the N multiple (lcm with the point chunk) so
+    the ONE bucket vocabulary — serving router, retrace census, this
+    classifier — always yields pads every point shard can hold an equal
+    slice of. Power-of-two shard counts divide the 8192 default chunk,
+    so the historical pads are unchanged there.
+    """
+    n_mult = math.lcm(max(cfg.point_chunk, 1),
+                      max(getattr(cfg, "point_shards", 1), 1))
     return (bucket_size(frames, max(cfg.frame_pad_multiple, 1)),
-            bucket_size(points, max(cfg.point_chunk, 1)))
+            bucket_size(points, n_mult))
 
 
 def scene_bucket(cfg, frames: int, points: int, max_id: int) -> Tuple[int, int, int]:

@@ -323,7 +323,14 @@ class RetryPolicy:
 _LADDER_RUNGS = (
     ("sequential-executor", {"scene_overlap": False},
      lambda cfg: bool(cfg.scene_overlap)),
-    ("single-chip", {"mesh_shape": ()},
+    # the single-chip rung retires the whole mesh, point axis included
+    # (point_shards > 1 without mesh_shape is invalid config). Shard-count
+    # awareness: an HBM-capacity failure at high N is better answered by
+    # RAISING cfg.point_shards — more shards keep the scene on device with
+    # byte-identical artifacts — than by riding the ladder down to
+    # single-chip/host; the ladder stays a survival path, not a capacity
+    # plan (README "Scaling past the point ceiling").
+    ("single-chip", {"mesh_shape": (), "point_shards": 1},
      lambda cfg: bool(cfg.mesh_shape)),
     ("donation-off", {"donate_buffers": False},
      lambda cfg: bool(cfg.donate_buffers)),

@@ -33,6 +33,10 @@
 #   5. bench.py --frame-batch 8 (A/B; VERDICT  -> bench_fb8.out (JSON line)
 #      Weak #4's decision record — this capture flips the
 #      association_frame_batch default to 8 or kills the knob)
+#   5b. point-shard A/B (ISSUE 14, advisory)   -> point_shard_{a,b}.out
+#      mesh_bench 1M-point fused workload, frame-only 1x8 vs point-sharded
+#      1x2x4 on the LIVE backend — the on-chip number next to
+#      MESH_BENCH.md's static point-axis census
 #   6. northstar sweep (multi-bucket, ~3 min)  -> northstar.out + NORTHSTAR_live.md
 #   7. obs report render of the bench captures -> obs_report.out
 #      (+ per-stage diffs of both A/B runs against the default)
@@ -128,6 +132,17 @@ if [ -n "${MCT_XPROF:-}" ] && [ -z "${MCT_NO_OBS:-}" ]; then
     --obs-events "$OUT/xprof_events.jsonl" --xprof "$MCT_XPROF" --xprof-dir "$OUT/xprof" \
     --no-ledger ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
 fi
+# point-shard A/B (ADVISORY, ISSUE 14): the on-chip half of the
+# MESH_BENCH.md point-axis census — the same 1M-point fused workload over
+# frame-only (1x8) vs point-sharded (1x2x4) meshes; the wall-clock delta
+# is the ICI cost of the psum-over-point traffic the CPU census bounds
+# statically. MCT_QUICK drops to the tiny 128k shape.
+PS_SHAPE=(--scenes 2 --frames 8 --points 1048576 --image-h 48 --image-w 64)
+[ -n "${MCT_QUICK:-}" ] && PS_SHAPE=(--scenes 2 --frames 8 --points 131072 --image-h 48 --image-w 64)
+run point_shard_a 900 python scripts/mesh_bench.py --platform tpu --mesh 1 8 \
+  --out "$OUT/POINT_SHARD_A.md" "${PS_SHAPE[@]}"
+run point_shard_b 900 python scripts/mesh_bench.py --platform tpu --mesh 1 2 \
+  --point-shards 4 --out "$OUT/POINT_SHARD_B.md" "${PS_SHAPE[@]}"
 run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md" ${PLAT[@]+"${PLAT[@]}"} ${NS_QUICK[@]+"${NS_QUICK[@]}"}
 if [ -z "${MCT_NO_OBS:-}" ] && [ -f "$OUT/bench_default_events.jsonl" ]; then
   if [ -f "$OUT/bench_int8_events.jsonl" ]; then

@@ -92,6 +92,10 @@ fail() { [ "$rc" -eq 0 ] && rc=$1 || true; }  # first failure wins the exit code
 
 WALL_WARN="${MCT_TIER1_WALL_WARN:-800}"
 T1LOG=$(mktemp /tmp/mct_tier1_XXXX.log)
+# the point-axis sharding identity path (tests/test_point_sharding.py:
+# 2-shard fused-step byte identity + sharded batch artifacts + drain
+# counter pins) rides THIS gate — no separate gate needed; the 1M-point
+# acceptance scene and the 3-axis lattice sweep are slow-marked
 echo "== ci: tier-1 tests =="
 t0=$(date +%s)
 if ! timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -346,6 +346,10 @@ def run_smoke(args) -> int:
         failures.append("daemon printed no final digest line")
     else:
         verdict["warmup_s"] = digest.get("warmup_s")
+        if digest.get("point_shards") is not None:
+            # serve rows carry the shard-count coordinate so --regress
+            # attributes a resharded daemon's latency delta to the knob
+            verdict["point_shards"] = int(digest["point_shards"])
         retrace = digest.get("retrace") or {}
         verdict["retrace_compiles"] = retrace.get("compiles")
         verdict["retrace_repeats"] = retrace.get("repeats")

@@ -27,8 +27,6 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-
 import numpy as np  # noqa: E402
 
 
@@ -46,8 +44,24 @@ def main():
     p.add_argument("--image-w", type=int, default=320)
     p.add_argument("--mesh", type=int, nargs=2, default=(2, 4),
                    metavar=("SCENE", "FRAME"))
+    p.add_argument("--point-shards", type=int, default=1,
+                   help="shard the point axis over this many chips (third "
+                        "mesh axis; scene*frame*point must equal the "
+                        "device count — e.g. --mesh 1 2 --point-shards 4). "
+                        "Artifacts are byte-identical at any value; this "
+                        "is the on-chip A/B knob chip_session.sh's "
+                        "point_shard_ab step drives")
+    p.add_argument("--platform", default="cpu", choices=("cpu", "tpu"),
+                   help="cpu (default): 8 virtual host devices — the "
+                        "orchestration harness; tpu: the real backend "
+                        "(chip_session's on-chip point-shard A/B)")
     p.add_argument("--out", default="MESH_BENCH.md")
     args = p.parse_args()
+    # the platform must be pinned through jax.config BEFORE backend init
+    # (the environment may preload a TPU plugin — see tests/conftest.py);
+    # nothing touches a device until jax.devices() below, so deciding it
+    # here from the parsed flag covers both values
+    jax.config.update("jax_platforms", args.platform)
 
     from maskclustering_tpu.config import PipelineConfig
     from maskclustering_tpu.parallel.batch import cluster_scene_batch, make_run_mesh
@@ -60,9 +74,9 @@ def main():
           file=sys.stderr, flush=True)
 
     cfg = PipelineConfig(
-        config_name="mesh_bench", dataset="demo", backend="cpu",
+        config_name="mesh_bench", dataset="demo", backend=args.platform,
         distance_threshold=0.03, point_chunk=8192, frame_pad_multiple=8,
-        mesh_shape=tuple(args.mesh),
+        mesh_shape=tuple(args.mesh), point_shards=args.point_shards,
     )
     mesh = make_run_mesh(cfg)
 
@@ -97,28 +111,41 @@ def main():
           f"({per_scene:.2f} s/scene), objects {counts}, peak RSS {rss:.2f} GB",
           file=sys.stderr, flush=True)
 
+    # the generated record must say where its numbers came from: the CPU
+    # harness measures orchestration, a --platform tpu run (chip_session's
+    # point_shard_ab) is the real wall-clock — mislabeling either poisons
+    # the A/B archive
+    dev_desc = (f"{len(devices)} virtual CPU devices"
+                if args.platform == "cpu"
+                else f"{len(devices)} {devices[0].platform} device(s)")
+    notes = (
+        "Notes: virtual CPU devices measure the orchestration + sharding "
+        "path\n(compile-correctness, padding invariants, per-scene "
+        "artifact fan-out), not\nTPU arithmetic; absolute s/scene on CPU "
+        "is not comparable to the\nsingle-chip TPU bench (bench.py)."
+        if args.platform == "cpu" else
+        "Notes: LIVE-backend run (--platform tpu) — these are real "
+        "accelerator\nwall-clock numbers, comparable across meshes within "
+        "this session.")
     with open(args.out, "w") as f:
-        f.write(f"""# Mesh-path benchmark (8 virtual CPU devices)
+        f.write(f"""# Mesh-path benchmark ({dev_desc})
 
 Fused multi-chip pipeline (`parallel/batch.cluster_scene_batch`) over a
-`(scene={args.mesh[0]}, frame={args.mesh[1]})` mesh of 8 virtual CPU
-devices — the same code path the driver dry-runs, at real scale and
-end-to-end through device post-process + host DBSCAN/merge.
+`(scene={args.mesh[0]}, frame={args.mesh[1]}, point={args.point_shards})`
+mesh of {dev_desc} — the same code path the driver dry-runs, at real
+scale and end-to-end through device post-process + host DBSCAN/merge.
 
 | quantity | value |
 |---|---|
 | scenes | {args.scenes} ({args.boxes} objects, {args.frames} frames, {args.image_h}x{args.image_w}, {args.points // 1024}k pts each) |
-| mesh | scene={args.mesh[0]} x frame={args.mesh[1]} (8 virtual CPU devices) |
+| mesh | scene={args.mesh[0]} x frame={args.mesh[1]} x point={args.point_shards} ({dev_desc}) |
 | warm-up batch (incl. compile) | {warm_s:.1f} s |
 | full run | {run_s:.1f} s |
 | **s/scene** | **{per_scene:.2f}** |
 | peak RSS | {rss:.2f} GB |
 | objects recovered | {counts} |
 
-Notes: virtual CPU devices measure the orchestration + sharding path
-(compile-correctness, padding invariants, per-scene artifact fan-out), not
-TPU arithmetic; absolute s/scene on CPU is not comparable to the
-single-chip TPU bench (bench.py). Generated by `scripts/mesh_bench.py`.
+{notes} Generated by `scripts/mesh_bench.py`.
 """)
     print(f"[mesh-bench] wrote {args.out}", file=sys.stderr, flush=True)
 

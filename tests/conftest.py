@@ -38,20 +38,25 @@ def rng():
 
 @pytest.fixture(scope="session")
 def fused_lattice_aot():
-    """ONE AOT sweep of the fused step over the divisor lattice of 8,
-    at the analyzer's canonical shape.
+    """ONE AOT sweep of the fused step over the divisor lattice of 8
+    (plus the canonical point-sharded cell), at the analyzer's shape.
 
-    test_cost.py (collective/dot census assertions) and test_analysis.py
-    (the IR invariant gate) used to each perform their own fused-step
+    test_cost.py (collective/dot census assertions), test_analysis.py
+    (the IR invariant gate), test_retrace.py (the surface census) and
+    test_point_sharding.py used to each perform their own fused-step
     lowering+compile sweep; session-scoping the sweep here pays the
     compiles once per tier-1 run. ``keep_texts`` attaches the StableHLO /
     optimized-HLO text per row so ``analyze_ir(lowerings=...)`` reads the
     same programs the cost rows describe.
     """
-    from maskclustering_tpu.analysis.ir_checks import CANONICAL_SHAPE, LATTICE
+    from maskclustering_tpu.analysis.ir_checks import (
+        CANONICAL_SHAPE,
+        FULL_LATTICE,
+    )
     from maskclustering_tpu.obs.cost import observe_costs
 
-    rows = observe_costs(LATTICE, stages=("fused",), keep_texts=True,
+    rows = observe_costs(FULL_LATTICE, stages=("fused",), keep_texts=True,
                          **CANONICAL_SHAPE)
-    assert len(rows) == len(LATTICE), "every lattice mesh must fit the 8 devices"
+    assert len(rows) == len(FULL_LATTICE), \
+        "every lattice mesh must fit the 8 devices"
     return {tuple(r["mesh"]): r for r in rows}

@@ -117,8 +117,9 @@ def test_dot_census_classes_and_bytes():
 
 _TINY = dict(frames=8, points=512, image_hw=(16, 24), k_max=7)
 
-# the full divisor lattice of 8: every (scene, frame) factorization
-_LATTICE = [(1, 8), (2, 4), (4, 2), (8, 1)]
+# the full divisor lattice of 8: every (scene, frame) factorization,
+# plus the canonical point-sharded (scene, frame, point) cell
+_LATTICE = [(1, 8), (2, 4), (4, 2), (8, 1), (1, 2, 4)]
 
 
 @pytest.fixture()
