@@ -50,6 +50,7 @@ DEVICE_PATH_MODULES = (
     "maskclustering_tpu/models/graph.py",
     "maskclustering_tpu/models/clustering.py",
     "maskclustering_tpu/models/postprocess_device.py",
+    "maskclustering_tpu/models/streaming.py",
     "maskclustering_tpu/parallel/sharded.py",
     "maskclustering_tpu/parallel/batch.py",
     # io/feed.py is deliberately absent: the codec's encode half works on

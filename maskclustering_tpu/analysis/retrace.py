@@ -104,6 +104,16 @@ CACHED_BY_CALLER = frozenset({"build_fused_step", "build_stage_step"})
 #                   the "masks" shape-bucket kind; pow2-bounded)
 #        "post"   = keyed by the device post-process's data-dependent pow2
 #                   buckets (recorded as the post.* shape-bucket kinds)
+#        "stream" = the streaming accumulator's programs (models/
+#                   streaming.py), keyed by the stream's (m_pad, f_alloc,
+#                   n_pad) bucket (the "stream" shape-bucket kind) — ONE
+#                   bucket per stream by construction (every chunk pads
+#                   to the same coordinates), so the bucket's FIRST chunk
+#                   compiles them and every later chunk (and later
+#                   same-bucket stream) dispatches warm. On a FROZEN
+#                   serving daemon a cold stream bucket books post-freeze
+#                   compiles exactly like a cold scene bucket: warm it or
+#                   expect the gate to say so
 #        "config" = one executable per config (static scalars only)
 #   flags: subset of {"dtype", "donate"} — extra key axes
 SERVING_PROGRAMS: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
@@ -113,6 +123,10 @@ SERVING_PROGRAMS: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
     ("compute_graph_stats", "masks", ("dtype",)),
     ("observer_schedule_device", "scene", ()),
     ("_iterative_clustering_jit", "masks", ("dtype",)),
+    ("_iterative_clustering_warm_jit", "stream", ("dtype",)),
+    ("_stream_merge_impl", "stream", ("dtype",)),
+    ("_stream_recluster_impl", "stream", ("dtype",)),
+    ("_rep_plane_update_impl", "stream", ()),
     ("_live_count_kernel", "post", ()),
     ("_prep_kernel", "post", ()),
     ("_node_stats_kernel", "post", ("dtype",)),
@@ -719,7 +733,7 @@ def compile_surface(cfg=None) -> Dict:
         coords: List[str]
         if key == "scene":
             coords = [f"bucket=k{k}:f{f}:n{n}" for k, f, n in buckets]
-        elif key in ("masks", "post"):
+        elif key in ("masks", "post", "stream"):
             coords = [f"bucket=<data:{key}>"]
         else:
             coords = ["bucket=<config>"]

@@ -130,6 +130,39 @@ class PipelineConfig:
     # the ladder's single-chip rung resets it to 1 like the mesh.
     point_shards: int = 1
 
+    # --- streaming incremental clustering (models/streaming.py) ---
+    # frames per accumulation chunk (0 = off, the classic offline-batch
+    # pipeline). > 0 routes the scene through the chunked accumulator:
+    # only one chunk's (F', N) claim planes plus the O(M^2) accumulator
+    # state are ever resident (stream.max_plane_bytes pins it), partial
+    # instances are exported per chunk, and the final answer converges to
+    # the batch result — byte-identical when one chunk covers the whole
+    # scene, AP-equivalent at smaller chunks (tests/test_streaming.py).
+    # Single-chip mode: incompatible with mesh_shape (the fused mesh path
+    # owns whole scenes) and with use_exact_ball_query (host parity path)
+    streaming_chunk: int = 0
+    # re-cluster cadence in chunks (1 = after every chunk). Between
+    # re-clusters new masks stay their own partial instances; the warm
+    # start from the previous assignment makes a re-cluster O(iterations
+    # to absorb the new chunk), not a from-singletons solve
+    stream_recluster_every: int = 1
+    # mask-capacity headroom of the streaming accumulator: the global
+    # M_pad bucket is projected from the first chunk's mask density x
+    # the chunk count x this factor, so later chunks land in the SAME
+    # bucket (zero post-warm compiles). A projection overflow grows the
+    # bucket (a counted recompile), never drops masks
+    stream_mask_headroom: float = 1.5
+    # extra attempts per failed chunk (mid-stream faults retry the CHUNK
+    # with the accumulator intact, not the scene; 0 = fail fast to the
+    # scene supervisor)
+    stream_chunk_retries: int = 2
+    # accumulator snapshot cadence in chunks (crash resume): every
+    # snapshot drains the O(M^2) state to host and writes an npz, which
+    # is real per-chunk latency at production M — 1 journals every chunk
+    # (lose nothing on a kill), N journals every Nth chunk (lose at most
+    # N-1 chunks of re-runnable work); 0 disables the journal entirely
+    stream_journal_every: int = 1
+
     # --- scene executor (run.py, single-chip scene queue) ---
     # overlap scene N's host tail (DBSCAN split, merge, export) on a worker
     # thread with scene N+1's device phase; artifacts are byte-identical to
@@ -223,6 +256,33 @@ class PipelineConfig:
                 "point_shards > 1 requires the fused mesh path — set "
                 "mesh_shape (scene, frame); the point axis is the mesh's "
                 "third axis, not a single-chip mode")
+        if self.streaming_chunk < 0:
+            raise ValueError(
+                f"streaming_chunk must be >= 0, got {self.streaming_chunk}")
+        if self.streaming_chunk > 0 and self.mesh_shape:
+            raise ValueError(
+                "streaming_chunk is a single-chip mode — the fused mesh "
+                "path (mesh_shape) consumes whole scenes; unset one")
+        if self.streaming_chunk > 0 and self.use_exact_ball_query:
+            raise ValueError(
+                "streaming_chunk cannot run the exact ball-query parity "
+                "path (host-only, no chunk planes); unset one")
+        if self.stream_recluster_every < 1:
+            raise ValueError(
+                f"stream_recluster_every must be >= 1, "
+                f"got {self.stream_recluster_every}")
+        if self.stream_mask_headroom < 1.0:
+            raise ValueError(
+                f"stream_mask_headroom must be >= 1.0, "
+                f"got {self.stream_mask_headroom}")
+        if self.stream_chunk_retries < 0:
+            raise ValueError(
+                f"stream_chunk_retries must be >= 0, "
+                f"got {self.stream_chunk_retries}")
+        if self.stream_journal_every < 0:
+            raise ValueError(
+                f"stream_journal_every must be >= 0, "
+                f"got {self.stream_journal_every}")
         if self.prefetch_depth < 0:
             raise ValueError(
                 f"prefetch_depth must be >= 0, got {self.prefetch_depth}")

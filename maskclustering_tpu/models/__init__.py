@@ -18,6 +18,7 @@ from maskclustering_tpu.models.postprocess import (
     export_artifacts,
     postprocess_scene,
 )
+from maskclustering_tpu.models.streaming import StreamAccumulator, stream_scene
 
 __all__ = [
     "FrameAssociation",
@@ -36,4 +37,6 @@ __all__ = [
     "SceneObjects",
     "export_artifacts",
     "postprocess_scene",
+    "StreamAccumulator",
+    "stream_scene",
 ]

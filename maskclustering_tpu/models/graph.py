@@ -187,34 +187,7 @@ def compute_graph_stats(
         counting.operand_dtype(count_dtype))
 
     # ---- segmented max + sum over each frame's masks ----
-    # Table columns are sorted by (frame, id), so each frame's masks occupy
-    # a CONTIGUOUS column range [starts[j], starts[j+1]): the segmented max
-    # is F dynamic slices of width k_max — sequential reads at HBM speed —
-    # instead of an (M_pad * F * k_max)-element random gather (~1 s/scene
-    # at ScanNet shape, see PROFILE.md's gather cost). Ties resolve to the
-    # lowest mask id in both formulations (columns ascend by id). The same
-    # slices yield n_vis (per-(mask, frame) visible counts — masks of a
-    # frame are disjoint) as a zero-masked row sum, replacing the old
-    # ``c @ frame_onehot`` f32 matmul: c's entries are counts up to N, too
-    # wide for any narrow MXU operand encoding, and the slice reduction is
-    # O(M_pad^2) reads instead of O(M_pad^2 * F) MACs.
-    starts = jnp.searchsorted(mask_frame, jnp.arange(f + 1, dtype=jnp.int32)
-                              ).astype(jnp.int32)  # padding has frame == F
-    c_ext = jnp.concatenate(
-        [c, jnp.full((m_pad, k_max), -1.0)], axis=1)  # slice overrun guard
-
-    def frame_max(j):
-        sl = jax.lax.dynamic_slice(c_ext, (0, starts[j]), (m_pad, k_max))
-        valid_col = jnp.arange(k_max) < (starts[j + 1] - starts[j])
-        slm = jnp.where(valid_col[None, :], sl, -1.0)
-        return (jnp.max(slm, axis=1),
-                starts[j] + jnp.argmax(slm, axis=1).astype(jnp.int32),
-                jnp.sum(jnp.where(valid_col[None, :], sl, 0.0), axis=1))
-
-    cmax, top_global, n_vis = jax.lax.map(frame_max, jnp.arange(f))  # (F, M_pad) x3
-    cmax = cmax.T  # (M_pad, F)
-    top_global = top_global.T
-    n_vis = n_vis.T
+    cmax, top_global, n_vis = frame_segment_stats(c, mask_frame, f, k_max)
 
     # ---- visibility / containment / undersegmentation logic ----
     safe_tot = jnp.maximum(n_tot, 1.0)[:, None]
@@ -254,8 +227,23 @@ def compute_graph_stats(
     # f32 lerp can land epsilon above an integer count and flip an
     # `observers >= threshold` decision.
     observers = counting.count_dot(visible, visible.T, count_dtype=count_dtype)
+    observer_hist = observer_histogram(observers, f + 1)
+
+    return GraphStats(visible=visible, contained=contained, undersegment=undersegment,
+                      n_tot=n_tot, observer_hist=observer_hist)
+
+
+def observer_histogram(observers: jnp.ndarray, nbins: int) -> jnp.ndarray:
+    """Exact integer histogram of an (M, M) observer-count matrix.
+
+    Counts are small integers <= F, so ~F/8 fused compare-and-count
+    passes over the matrix replace an O(M^2 log M^2) sort; order
+    statistics read off the cumulative histogram equal sorted-array
+    indexing. Shared by ``compute_graph_stats`` and the streaming
+    re-cluster program (models/streaming.py), which computes the same
+    percentile schedule over its accumulated visibility matrix.
+    """
     obs_flat = observers.reshape(-1)
-    nbins = f + 1
     pad_bins = -(-nbins // 8) * 8
     bin_vals = jnp.arange(pad_bins, dtype=jnp.float32).reshape(-1, 8)
 
@@ -263,10 +251,46 @@ def compute_graph_stats(
         return None, jnp.sum(obs_flat[None, :] == vals[:, None], axis=1)
 
     _, hist8 = jax.lax.scan(hist_chunk, None, bin_vals)
-    observer_hist = hist8.reshape(-1)[:nbins].astype(jnp.int32)
+    return hist8.reshape(-1)[:nbins].astype(jnp.int32)
 
-    return GraphStats(visible=visible, contained=contained, undersegment=undersegment,
-                      n_tot=n_tot, observer_hist=observer_hist)
+
+def frame_segment_stats(c: jnp.ndarray, mask_frame: jnp.ndarray, f: int,
+                        k_max: int):
+    """Per-frame segmented (max, argmax, sum) over a count matrix's mask
+    columns: ``(cmax, top_global, n_vis)``, each (rows, F).
+
+    Table columns are sorted by (frame, id), so each frame's masks occupy
+    a CONTIGUOUS column range [starts[j], starts[j+1]): the segmented max
+    is F dynamic slices of width k_max — sequential reads at HBM speed —
+    instead of an (rows * F * k_max)-element random gather (~1 s/scene
+    at ScanNet shape, see PROFILE.md's gather cost). Ties resolve to the
+    lowest mask id in both formulations (columns ascend by id). The same
+    slices yield n_vis (per-(row, frame) visible counts — masks of a
+    frame are disjoint) as a zero-masked row sum, replacing the old
+    ``c @ frame_onehot`` f32 matmul: c's entries are counts up to N, too
+    wide for any narrow MXU operand encoding, and the slice reduction is
+    O(rows * M) reads instead of O(rows * M * F) MACs. ``top_global`` is
+    the argmax COLUMN index (the (frame, id)-sorted slot). Shared by
+    ``compute_graph_stats`` and the streaming merge program
+    (models/streaming.py), whose cross-term rows walk the same chunk
+    columns — one copy of the overrun-guard/valid-column semantics.
+    """
+    rows = c.shape[0]
+    starts = jnp.searchsorted(mask_frame, jnp.arange(f + 1, dtype=jnp.int32)
+                              ).astype(jnp.int32)  # padding has frame == F
+    c_ext = jnp.concatenate(
+        [c, jnp.full((rows, k_max), -1.0)], axis=1)  # slice overrun guard
+
+    def frame_max(j):
+        sl = jax.lax.dynamic_slice(c_ext, (0, starts[j]), (rows, k_max))
+        valid_col = jnp.arange(k_max) < (starts[j + 1] - starts[j])
+        slm = jnp.where(valid_col[None, :], sl, -1.0)
+        return (jnp.max(slm, axis=1),
+                starts[j] + jnp.argmax(slm, axis=1).astype(jnp.int32),
+                jnp.sum(jnp.where(valid_col[None, :], sl, 0.0), axis=1))
+
+    cmax, top, n_vis = jax.lax.map(frame_max, jnp.arange(f))  # (F, rows) x3
+    return cmax.T, top.T, n_vis.T
 
 
 def observer_schedule_device(observer_hist: jnp.ndarray,

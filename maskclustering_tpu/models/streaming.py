@@ -1,0 +1,972 @@
+"""Streaming incremental clustering: chunked frame accumulation.
+
+The batch pipeline (models/pipeline.py) keeps every frame's (F, N) claim
+planes resident until one graph solve — full-plane residency is the scale
+ceiling on frames, and a live scanner gets nothing until the scan ends.
+This module processes frames in chunks of ``cfg.streaming_chunk`` and
+maintains a device-resident accumulator whose footprint is one chunk's
+(F', N) planes plus O(M^2) graph state:
+
+- **within-chunk statistics are exact**: each chunk runs the batch
+  ``compute_graph_stats`` program over its own claim planes and mask
+  table — the same counting contractions (ops/counting.py), which are
+  additive over frame chunks;
+- **cross-chunk statistics run at representative granularity**: past
+  chunks survive as the point-level ``rep_plane`` (point -> current
+  cluster representative, the SAM3D-style progressive instance map) and
+  the accumulated visibility/containment matrices. A new chunk's merge
+  program computes, with ONE counting matmul per point chunk, how every
+  existing representative projects into the new frames (the
+  view-consensus analog of SAM3D's progressive mask merging);
+- **periodic re-cluster warm-starts from the previous assignment**:
+  connected components under the observer schedule restart from the
+  prior labels (``iterative_clustering(init=...)``), not singletons;
+- **anytime partial instances**: after every chunk the rep plane yields
+  the current instance map; the chunk digest carries the live instance
+  count and ``partial_objects()`` exports a full partial artifact set.
+
+Convergence contract (tests/test_streaming.py): when one chunk covers
+the whole scene the accumulator degenerates to the batch program chain —
+artifacts are BYTE-IDENTICAL under both ``count_dtype`` encodings — and
+at smaller chunks the final AP matches the batch path within the pinned
+tolerance on the solvable synthetic scene.
+
+Compile surface: chunks route through the same
+``utils/compile_cache.scene_bucket`` vocabulary as whole scenes (a chunk
+is just another bucket coordinate), every chunk pads to the SAME
+(f_chunk_pad, n_pad) bucket (partial last chunks included), and the
+global mask axis is pre-sized from the first chunk's density
+(``cfg.stream_mask_headroom``) — so chunk 1 compiles the stream's
+programs once and chunks 2..K dispatch with zero compiles (the retrace
+sanitizer pins it; the streaming jits are classified in
+analysis/retrace.SERVING_PROGRAMS).
+
+Residency contract: ``stream.max_plane_bytes`` (gauge_max) records the
+largest per-chunk claim-plane materialization — strictly under the full
+scene's plane set at any multi-chunk split — and ``stream.state_bytes``
+the accumulator itself. Host syncs are booked on ``stream.host_sync``
+(two per chunk: the irreducible mask-table pull + the partial-instance
+scalar), marked with ``sanctioned_pull`` windows like the batch path's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from maskclustering_tpu import obs
+from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.analysis.transfer_guard import sanctioned_pull
+from maskclustering_tpu.config import PipelineConfig
+from maskclustering_tpu.datasets.base import SceneTensors
+from maskclustering_tpu.models.backprojection import associate_scene_tensors
+from maskclustering_tpu.models.clustering import iterative_clustering
+from maskclustering_tpu.models.graph import (
+    MaskTable,
+    build_mask_table,
+    compute_graph_stats,
+    frame_segment_stats,
+    observer_histogram,
+    observer_schedule_device,
+)
+from maskclustering_tpu.models.pipeline import (
+    DeviceHandoff,
+    SceneResult,
+    bucket_k_max,
+    pad_scene_tensors,
+)
+from maskclustering_tpu.models.postprocess import (
+    SceneObjects,
+    _merge_overlapping,
+    export_artifacts,
+)
+from maskclustering_tpu.ops import counting
+from maskclustering_tpu.ops.dbscan import dbscan_labels_parallel
+from maskclustering_tpu.utils import faults
+from maskclustering_tpu.utils.compile_cache import (
+    record_shape_bucket,
+    scene_pads,
+)
+
+log = logging.getLogger("maskclustering_tpu")
+
+# streaming accumulator state-journal schema (resume compatibility gate)
+STREAM_STATE_VERSION = 1
+
+
+class StaleChunkAttempt(RuntimeError):
+    """A watchdog-abandoned push_chunk attempt reached its bind point
+    after a retry superseded it; the bind was dropped (the accumulator is
+    the RETRY's state). Raised on the abandoned daemon thread only —
+    callers on the live path never see it."""
+
+    def __init__(self, seq_name, chunk: int):
+        super().__init__(f"stream {seq_name}: abandoned chunk {chunk} "
+                         f"attempt superseded; bind dropped")
+
+
+def slice_scene_frames(tensors: SceneTensors, start: int,
+                       stop: int) -> SceneTensors:
+    """The frame window [start, stop) of a scene as its own SceneTensors.
+
+    The cloud is shared (same object); frame arrays slice along axis 0.
+    Host numpy stays host (the compact-feed codec contract,
+    models/pipeline.pad_scene_tensors).
+    """
+    return dataclasses.replace(
+        tensors,
+        depths=tensors.depths[start:stop],
+        segmentations=tensors.segmentations[start:stop],
+        intrinsics=tensors.intrinsics[start:stop],
+        cam_to_world=tensors.cam_to_world[start:stop],
+        frame_valid=np.asarray(tensors.frame_valid)[start:stop],  # mct-ok: AST.HOSTSYNC (host numpy by SceneTensors contract, no device sync)
+        frame_ids=list(tensors.frame_ids)[start:stop],
+    )
+
+
+# ---------------------------------------------------------------------------
+# the three streaming device programs
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k_max", "point_chunk", "mask_visible_threshold",
+                     "contained_threshold", "big_mask_point_count",
+                     "count_dtype"),
+)
+def _stream_merge_impl(
+    visible_acc: jnp.ndarray,  # (M, F_alloc) bool accumulated visibility
+    contained_acc: jnp.ndarray,  # (M, M) bool accumulated containment
+    active_acc: jnp.ndarray,  # (M,) bool
+    n_tot_acc: jnp.ndarray,  # (M,) f32
+    assignment: jnp.ndarray,  # (M,) int32 current assignment
+    rep_plane: jnp.ndarray,  # (N,) int32 point -> rep slot + 1 (0 = none)
+    mask_of_point: jnp.ndarray,  # (Fc, N) int32 chunk claim planes
+    vis_k: jnp.ndarray,  # (Mk, Fc) bool chunk-local visible (post-undo)
+    con_k: jnp.ndarray,  # (Mk, Mk) bool chunk-local contained
+    act_k: jnp.ndarray,  # (Mk,) bool chunk-local active
+    ntot_k: jnp.ndarray,  # (Mk,) f32
+    chunk_frame: jnp.ndarray,  # (Mk,) int32 local frame per chunk mask
+    chunk_id: jnp.ndarray,  # (Mk,) int32 (-1 padding)
+    slot_offset: jnp.ndarray,  # () int32 global slot of chunk mask 0
+    frames_base: jnp.ndarray,  # () int32 column base of this chunk
+    *,
+    k_max: int,
+    point_chunk: int,
+    mask_visible_threshold: float,
+    contained_threshold: float,
+    big_mask_point_count: int,
+    count_dtype: str,
+):
+    """Fold one chunk into the accumulator: exact within-chunk blocks +
+    rep-level cross terms, all via the additive counting contractions.
+
+    ``c_cross[r, m'] = #points of representative r claimed by chunk mask
+    m'`` is the same chunked ``count_dot`` the batch co-occurrence uses
+    (models/graph._cooccurrence), with the representative membership
+    one-hot (from ``rep_plane``) standing in for the frame claim rows —
+    summing these per-chunk contractions over the stream IS the additive
+    decomposition the counting accumulators make exact.
+    """
+    m_pad = visible_acc.shape[0]
+    fc, n = mask_of_point.shape
+    arange_m = jnp.arange(m_pad, dtype=jnp.int32)
+    # prior representatives: active fixpoints of the current assignment
+    # (new slots are not active yet, so chunk 1 has none)
+    is_rep = active_acc & (assignment == arange_m)
+
+    # ---- c_cross via chunked counting matmuls ----
+    n_chunks = max(1, -(-n // point_chunk))
+    n_padded = n_chunks * point_chunk
+    mop = jnp.pad(mask_of_point, ((0, 0), (0, n_padded - n)))
+    rp = jnp.pad(rep_plane, (0, n_padded - n))
+    safe_frame = jnp.minimum(chunk_frame, fc - 1)
+    acc_dtype = counting.accumulator_dtype(count_dtype)
+    mk = chunk_frame.shape[0]
+
+    def body(carry, start):
+        c_acc, npts_acc = carry
+        mc = jax.lax.dynamic_slice(mop, (0, start), (fc, point_chunk))
+        rc = jax.lax.dynamic_slice(rp, (start,), (point_chunk,))
+        ids = mc[safe_frame, :].T  # (Nc, Mk)
+        w = (ids == chunk_id[None, :])
+        a = (rc[:, None] == (arange_m[None, :] + 1))  # (Nc, M) rep membership
+        cw = counting.count_dot(a.T, w, count_dtype=count_dtype,
+                                out_dtype=None)
+        return (c_acc + cw,
+                npts_acc + jnp.sum(a, axis=0).astype(jnp.float32)), None
+
+    init = (jnp.zeros((m_pad, mk), acc_dtype), jnp.zeros((m_pad,), jnp.float32))
+    (c_cross, rep_npts), _ = jax.lax.scan(
+        body, init, jnp.arange(n_chunks) * point_chunk)
+    c_cross = c_cross.astype(jnp.float32)
+
+    # ---- per-frame segmented max/sum over the chunk's mask columns ----
+    # (chunk masks are (frame, id)-sorted — the ONE shared batch
+    # formulation, models/graph.frame_segment_stats)
+    cmax, top_local, n_vis = frame_segment_stats(c_cross, chunk_frame, fc,
+                                                 k_max)  # (M, Fc) x3
+
+    # ---- representative visibility/containment in the new frames ----
+    # (the batch visibility test, models/graph.py, with the rep's point
+    # count as n_tot; reps never re-enter the undersegment logic)
+    safe_tot = jnp.maximum(rep_npts, 1.0)[:, None]
+    vis_ratio = n_vis / safe_tot
+    visible_test = ((vis_ratio >= mask_visible_threshold)
+                    | (n_vis >= big_mask_point_count)) \
+        & (n_vis > 0) & is_rep[:, None]
+    passes = (cmax / jnp.maximum(n_vis, 1.0)) > contained_threshold
+    vis_cross = visible_test & passes  # (M, Fc)
+
+    rows = jnp.broadcast_to(arange_m[:, None], (m_pad, fc))
+    safe_top = jnp.where(vis_cross, slot_offset + top_local, m_pad)
+    contained_new = jnp.zeros((m_pad, m_pad), dtype=bool)
+    contained_new = contained_new.at[
+        rows.reshape(-1), safe_top.reshape(-1)].set(True, mode="drop")
+
+    # ---- fold the chunk blocks into the accumulator ----
+    vis_cols = jax.lax.dynamic_update_slice(vis_cross, vis_k,
+                                            (slot_offset, jnp.int32(0)))
+    visible_acc = jax.lax.dynamic_update_slice(
+        visible_acc, vis_cols, (jnp.int32(0), frames_base))
+    con_block = jnp.zeros((m_pad, m_pad), dtype=bool)
+    con_block = jax.lax.dynamic_update_slice(
+        con_block, con_k, (slot_offset, slot_offset))
+    contained_acc = contained_acc | con_block | contained_new
+    active_acc = jax.lax.dynamic_update_slice(active_acc, act_k,
+                                              (slot_offset,))
+    n_tot_acc = jax.lax.dynamic_update_slice(n_tot_acc, ntot_k,
+                                             (slot_offset,))
+    return visible_acc, contained_acc, active_acc, n_tot_acc
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_len", "view_consensus_threshold", "count_dtype"),
+)
+def _stream_recluster_impl(
+    visible_acc: jnp.ndarray,  # (M, F_alloc) bool
+    contained_acc: jnp.ndarray,  # (M, M) bool
+    active_acc: jnp.ndarray,  # (M,) bool
+    prev_assign: jnp.ndarray,  # (M,) int32 warm-start labels
+    *,
+    max_len: int,
+    view_consensus_threshold: float,
+    count_dtype: str,
+):
+    """Periodic re-cluster over the accumulated state.
+
+    The observer-percentile schedule recomputes from the accumulated
+    visibility exactly as the batch graph stage does (shared
+    ``observer_histogram`` / ``observer_schedule_device`` formulations),
+    then the iterative merge restarts from the PREVIOUS assignment — new
+    chunk masks enter as singletons, existing clusters as themselves, so
+    the solve costs the iterations to absorb the new chunk rather than a
+    from-scratch component search.
+    """
+    observers = counting.count_dot(visible_acc, visible_acc.T,
+                                   count_dtype=count_dtype)
+    hist = observer_histogram(observers, visible_acc.shape[1] + 1)
+    schedule = observer_schedule_device(hist, max_len=max_len)
+    return iterative_clustering(
+        visible_acc, contained_acc, active_acc, schedule, prev_assign,
+        view_consensus_threshold=view_consensus_threshold,
+        count_dtype=count_dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk_frames", "min_points"))
+def _rep_plane_update_impl(
+    rep_plane: jnp.ndarray,  # (N,) int32 point -> rep slot + 1
+    rep_votes: jnp.ndarray,  # (N,) int32 supporting-claim count
+    first_id: jnp.ndarray,  # (Fc, N) int16 chunk claim planes
+    last_id: jnp.ndarray,  # (Fc, N) int16
+    slot_of: jnp.ndarray,  # (Fc, k_max + 2) int32 (frame, id) -> slot, -1 none
+    assignment: jnp.ndarray,  # (M,) int32
+    *,
+    chunk_frames: int,  # candidate claim rows to read (<= Fc)
+    min_points: int,  # liveness floor of the partial-instance count
+):
+    """Streaming majority vote: fold one chunk's claims into the point ->
+    representative plane.
+
+    Candidates per point: its prior representative (weighted by the
+    accumulated supporting-claim count, listed FIRST so ties keep the
+    prior) plus the chunk's first/last claims mapped through the current
+    assignment (``last`` deduped against ``first`` exactly like the batch
+    claims COO, models/postprocess._claims_coo). The winner is the
+    candidate with the most supporting claims — a per-point streaming
+    mode estimate whose weight is total evidence, so a long-standing
+    assignment is not flipped by one noisy frame.
+    """
+    m = assignment.shape[0]
+    # points follow their representative through merges first
+    prior_slot = jnp.maximum(rep_plane - 1, 0)
+    prior = jnp.where(rep_plane > 0, assignment[prior_slot] + 1, 0)
+
+    first = first_id[:chunk_frames].astype(jnp.int32)
+    last = last_id[:chunk_frames].astype(jnp.int32)
+    last = jnp.where(last == first, 0, last)  # each claim counts once
+
+    def rep_of(ids, f):
+        slot = slot_of[f, jnp.clip(ids, 0, slot_of.shape[1] - 1)]
+        rep = jnp.where(slot >= 0,
+                        assignment[jnp.clip(slot, 0, m - 1)] + 1, 0)
+        return jnp.where(ids > 0, rep, 0)
+
+    cand = jnp.stack(
+        [prior]
+        + [rep_of(first[f], f) for f in range(chunk_frames)]
+        + [rep_of(last[f], f) for f in range(chunk_frames)], axis=0)
+    c_rows = cand.shape[0]
+    weights = jnp.concatenate(
+        [jnp.maximum(rep_votes, 1)[None, :],
+         jnp.ones((c_rows - 1, cand.shape[1]), jnp.int32)], axis=0)
+
+    def tally(votes, j):
+        eq = (cand == cand[j][None, :]) & (cand > 0)
+        return votes + eq.astype(jnp.int32) * weights[j][None, :], None
+
+    votes, _ = jax.lax.scan(
+        tally, jnp.zeros(cand.shape, jnp.int32),
+        jnp.arange(c_rows))
+    winner = jnp.argmax(votes, axis=0)  # first max wins: prior row is first
+    new_rep = jnp.take_along_axis(cand, winner[None, :], axis=0)[0]
+    new_votes = jnp.max(votes, axis=0)
+
+    sizes = jnp.zeros(m + 1, jnp.int32).at[
+        jnp.clip(new_rep, 0, m)].add(1)
+    partial = jnp.sum(sizes[1:] >= min_points).astype(jnp.int32)
+    return new_rep, new_votes, partial
+
+
+# ---------------------------------------------------------------------------
+# the accumulator
+# ---------------------------------------------------------------------------
+
+
+class StreamAccumulator:
+    """Device-resident streaming state for one scene's chunked stream.
+
+    ``push_chunk`` is transactional: all device programs run against the
+    CURRENT state and the new state binds only after every program
+    dispatched — so a mid-chunk fault leaves the accumulator exactly at
+    the previous chunk's fixpoint and the chunk retries cleanly (the
+    ``chunk`` fault seam + tests/test_streaming.py pin it). The bind is
+    additionally EPOCH-FENCED: a watchdog-abandoned push_chunk keeps
+    running on its daemon thread (``faults.call_with_deadline``
+    semantics) and could otherwise bind its chunk AFTER the retry
+    re-ran it — every push_chunk entry invalidates all older in-flight
+    attempts, so a stale attempt's bind raises instead of
+    double-accumulating (run.py's chunk retry and the serve path's
+    client resend both ride this fence).
+    """
+
+    def __init__(self, cfg: PipelineConfig, *, total_frames: int,
+                 num_points: int, k_max: Optional[int] = None,
+                 seq_name: Optional[str] = None):
+        if cfg.streaming_chunk <= 0:
+            raise ValueError("StreamAccumulator needs cfg.streaming_chunk > 0")
+        self.cfg = cfg
+        self.seq_name = seq_name
+        self.total_frames = int(total_frames)
+        self.chunk_frames = min(int(cfg.streaming_chunk), self.total_frames)
+        self.n_chunks = max(-(-self.total_frames // self.chunk_frames), 1)
+        self.single = self.n_chunks == 1
+        f_pad_full, self.n_pad = scene_pads(cfg, self.total_frames,
+                                            num_points)
+        # every chunk (partial last one included) pads to ONE bucket so
+        # chunks 2..K dispatch the exact programs chunk 1 compiled
+        self.f_chunk_pad = (f_pad_full if self.single
+                            else scene_pads(cfg, self.chunk_frames,
+                                            num_points)[0])
+        self.f_alloc = self.n_chunks * self.f_chunk_pad
+        self.n_real = int(num_points)
+        self.k_max = int(k_max) if k_max else 0
+        # host-side global mask table (grows by chunk, (frame, id)-sorted
+        # because frames arrive in order and chunks append)
+        self.m_pad = 0
+        self.masks_used = 0
+        self.g_frame: Optional[np.ndarray] = None
+        self.g_mask_id: Optional[np.ndarray] = None
+        self.frame_ids: List = []
+        self.chunks_done = 0
+        self.frames_done = 0
+        self.partial_instances = 0
+        self.timings: Dict[str, float] = {}
+        # device state (allocated at the first chunk, once m_pad is sized)
+        self.visible = None
+        self.contained = None
+        self.active = None
+        self.n_tot = None
+        self.assignment = None
+        self.node_visible = None
+        self.rep_plane = None
+        self.rep_votes = None
+        self.scene_points: Optional[np.ndarray] = None
+        # single-chunk streams keep the chunk's planes for the exact
+        # batch post-process (the byte-identity path)
+        self._single_assoc = None
+        self._single_points = None
+        self._single_frame_ids = None
+        self._single_table: Optional[MaskTable] = None
+        # the abandoned-attempt fence (see class docstring): entry bumps
+        # the epoch, the bind re-checks it under the lock
+        self._epoch = 0
+        self._bind_lock = mct_lock("streaming.StreamAccumulator._bind_lock")
+
+    # -- sizing -------------------------------------------------------------
+
+    def _presize_m_pad(self, chunk_table: MaskTable) -> int:
+        """Global mask-axis bucket: exact for single-chunk streams (the
+        batch m_pad, so the post-process shapes match bit-for-bit),
+        projected from the first chunk's density otherwise."""
+        from maskclustering_tpu.utils.compile_cache import bucket_size
+
+        if self.single:
+            return chunk_table.m_pad
+        projected = int(math.ceil(
+            max(chunk_table.num_masks, 1) * self.n_chunks
+            * self.cfg.stream_mask_headroom))
+        return max(bucket_size(projected, self.cfg.mask_pad_multiple),
+                   chunk_table.m_pad)
+
+    def _alloc_state(self, m_pad: int) -> None:
+        # host-built zeros device_put in (jnp.asarray): eager jnp.zeros/
+        # arange dispatch tiny broadcast_in_dim/iota programs per
+        # allocation, which the retrace sanitizer would book as repeat
+        # compiles on every new stream — device_put compiles nothing
+        self.m_pad = m_pad
+        self.g_frame = np.full(m_pad, self.total_frames, dtype=np.int32)
+        self.g_mask_id = np.full(m_pad, -1, dtype=np.int32)
+        self.visible = jnp.asarray(
+            np.zeros((m_pad, self.f_alloc), dtype=bool))
+        self.contained = jnp.asarray(np.zeros((m_pad, m_pad), dtype=bool))
+        self.active = jnp.asarray(np.zeros((m_pad,), dtype=bool))
+        self.n_tot = jnp.asarray(np.zeros((m_pad,), np.float32))
+        self.assignment = jnp.asarray(np.arange(m_pad, dtype=np.int32))
+        self.node_visible = jnp.asarray(
+            np.zeros((m_pad, self.f_alloc), dtype=bool))
+        self.rep_plane = jnp.asarray(np.zeros((self.n_pad,), np.int32))
+        self.rep_votes = jnp.asarray(np.zeros((self.n_pad,), np.int32))
+
+    def _grow_state(self, needed: int) -> None:
+        """Mask-capacity overflow: grow the bucket (a counted recompile —
+        the projection headroom exists to make this rare), never drop."""
+        from maskclustering_tpu.utils.compile_cache import bucket_size
+
+        new_pad = bucket_size(needed, self.cfg.mask_pad_multiple)
+        log.warning("stream %s: mask capacity %d -> %d (projection "
+                    "overflow; chunk programs recompile at the new bucket)",
+                    self.seq_name, self.m_pad, new_pad)
+        obs.count("stream.mask_capacity_growths")
+        dm = new_pad - self.m_pad
+        self.g_frame = np.concatenate(
+            [self.g_frame, np.full(dm, self.total_frames, np.int32)])
+        self.g_mask_id = np.concatenate(
+            [self.g_mask_id, np.full(dm, -1, np.int32)])
+
+        # growth IS a pull seam: the accumulator round-trips host once to
+        # re-pad (rare by construction; device_put back compiles nothing)
+        with sanctioned_pull("stream.capacity_growth"):
+            self.visible = jnp.asarray(
+                np.pad(np.asarray(self.visible), ((0, dm), (0, 0))))
+            self.contained = jnp.asarray(
+                np.pad(np.asarray(self.contained), ((0, dm), (0, dm))))
+            self.active = jnp.asarray(
+                np.pad(np.asarray(self.active), (0, dm)))
+            self.n_tot = jnp.asarray(
+                np.pad(np.asarray(self.n_tot), (0, dm)))
+            self.assignment = jnp.asarray(np.concatenate(
+                [np.asarray(self.assignment),
+                 np.arange(self.m_pad, new_pad, dtype=np.int32)]))
+            self.node_visible = jnp.asarray(
+                np.pad(np.asarray(self.node_visible), ((0, dm), (0, 0))))
+        self.m_pad = new_pad
+
+    # -- per-chunk update ---------------------------------------------------
+
+    def _bind_state(self, visible, contained, active, n_tot, assignment,
+                    node_visible, rep_plane, rep_votes, table_k, offset,
+                    num_k, chunk_tensors, real_frames, partial) -> None:
+        """The transaction body (caller holds ``_bind_lock`` and has
+        verified the attempt's epoch): pure attribute/array assignments,
+        no locks, no IO."""
+        self.visible, self.contained = visible, contained
+        self.active, self.n_tot = active, n_tot
+        self.assignment, self.node_visible = assignment, node_visible
+        self.rep_plane, self.rep_votes = rep_plane, rep_votes
+        self.g_frame[offset:offset + num_k] = (
+            self.frames_done + table_k.frame[:num_k])
+        self.g_mask_id[offset:offset + num_k] = table_k.mask_id[:num_k]
+        self.masks_used = offset + num_k
+        self.frame_ids.extend(list(chunk_tensors.frame_ids)[:real_frames])
+        self.frames_done += real_frames
+        self.chunks_done += 1
+        self.partial_instances = partial
+
+    def push_chunk(self, chunk_tensors: SceneTensors) -> Dict:
+        """Accumulate one frame chunk; returns the chunk digest."""
+        cfg = self.cfg
+        t0 = time.perf_counter()
+        with self._bind_lock:
+            # every new attempt supersedes all in-flight older ones: a
+            # watchdog-abandoned thread that later reaches its bind point
+            # finds a stale epoch and aborts instead of double-binding
+            self._epoch += 1
+            epoch = self._epoch
+        ci = self.chunks_done
+        # fault seam: deterministic injection point for one chunk (a
+        # scripted fault here retries the CHUNK, accumulator intact)
+        faults.inject("chunk", self.seq_name)
+        real_frames = chunk_tensors.num_frames
+        with obs.span("stream.chunk", scene=self.seq_name, chunk=ci,
+                      frames=real_frames) as sp:
+            if self.k_max <= 0:
+                from maskclustering_tpu.utils.compile_cache import max_seg_id
+
+                self.k_max = bucket_k_max(max_seg_id(
+                    chunk_tensors.segmentations))
+            padded = pad_scene_tensors(chunk_tensors, self.f_chunk_pad,
+                                       self.n_pad)
+            # one bucket vocabulary with the batch path: a chunk is just
+            # another scene-bucket coordinate
+            record_shape_bucket("scene", self.k_max, self.f_chunk_pad,
+                                self.n_pad)
+            assoc = associate_scene_tensors(padded, cfg, k_max=self.k_max)
+            plane_bytes = (assoc.mask_of_point.nbytes + assoc.first_id.nbytes
+                           + assoc.last_id.nbytes + assoc.point_visible.nbytes
+                           + assoc.boundary.nbytes)
+            obs.gauge_max("stream.max_plane_bytes", float(plane_bytes))
+
+            # the irreducible pull: the chunk mask table's bucket is
+            # data-dependent (the batch path's one host sync, per chunk)
+            faults.inject("pull", self.seq_name)
+            with sanctioned_pull("stream.mask_valid"):
+                mask_valid_host = np.asarray(assoc.mask_valid)
+            obs.count("stream.host_sync")
+            table_k = build_mask_table(mask_valid_host,
+                                       pad_multiple=cfg.mask_pad_multiple)
+            sp.set(m_pad=table_k.m_pad, plane_bytes=plane_bytes)
+
+            if self.chunks_done == 0:
+                self._alloc_state(self._presize_m_pad(table_k))
+                record_shape_bucket("stream", self.m_pad, self.f_alloc,
+                                    self.n_pad)
+                self.scene_points = np.asarray(chunk_tensors.scene_points)  # mct-ok: AST.HOSTSYNC (host numpy by SceneTensors contract)
+            elif self.masks_used + table_k.m_pad > self.m_pad:
+                self._grow_state(self.masks_used + table_k.m_pad)
+                record_shape_bucket("stream", self.m_pad, self.f_alloc,
+                                    self.n_pad)
+
+            offset = self.masks_used
+            num_k = table_k.num_masks
+
+            # exact within-chunk graph statistics (the batch program)
+            stats = compute_graph_stats(
+                assoc.mask_of_point, assoc.boundary,
+                jnp.asarray(table_k.frame), jnp.asarray(table_k.mask_id),
+                jnp.asarray(table_k.valid),
+                k_max=self.k_max, point_chunk=cfg.point_chunk,
+                mask_visible_threshold=cfg.mask_visible_threshold,
+                contained_threshold=cfg.contained_threshold,
+                undersegment_filter_threshold=cfg.undersegment_filter_threshold,
+                big_mask_point_count=cfg.big_mask_point_count,
+                count_dtype=cfg.count_dtype)
+            act_k = jnp.asarray(table_k.valid) & ~stats.undersegment
+
+            visible, contained, active, n_tot = _stream_merge_impl(
+                self.visible, self.contained, self.active, self.n_tot,
+                self.assignment, self.rep_plane,
+                assoc.mask_of_point, stats.visible, stats.contained,
+                act_k, stats.n_tot,
+                jnp.asarray(table_k.frame), jnp.asarray(table_k.mask_id),
+                np.int32(offset), np.int32(ci * self.f_chunk_pad),
+                k_max=self.k_max, point_chunk=cfg.point_chunk,
+                mask_visible_threshold=cfg.mask_visible_threshold,
+                contained_threshold=cfg.contained_threshold,
+                big_mask_point_count=cfg.big_mask_point_count,
+                count_dtype=cfg.count_dtype)
+
+            assignment, node_visible = self.assignment, self.node_visible
+            if (ci + 1) % max(cfg.stream_recluster_every, 1) == 0 \
+                    or ci + 1 == self.n_chunks:
+                result = _stream_recluster_impl(
+                    visible, contained, active, self.assignment,
+                    max_len=cfg.max_cluster_iterations,
+                    view_consensus_threshold=cfg.view_consensus_threshold,
+                    count_dtype=cfg.count_dtype)
+                assignment, node_visible = (result.assignment,
+                                            result.node_visible)
+                obs.count("stream.reclusters")
+
+            # fold the chunk's claims into the point -> rep plane
+            slot_of = np.full((self.f_chunk_pad, self.k_max + 2), -1,
+                              dtype=np.int32)
+            valid_rows = table_k.valid[:num_k]
+            slot_of[table_k.frame[:num_k][valid_rows],
+                    table_k.mask_id[:num_k][valid_rows]] = (
+                offset + np.nonzero(valid_rows)[0])
+            rep_plane, rep_votes, partial = _rep_plane_update_impl(
+                self.rep_plane, self.rep_votes,
+                assoc.first_id, assoc.last_id, jnp.asarray(slot_of),
+                assignment,
+                chunk_frames=self.chunk_frames,
+                min_points=max(cfg.dbscan_split_min_points, 1))
+            # the anytime scalar: live partial-instance count, one 4-byte
+            # pull (drains the chunk's dispatch chain)
+            with sanctioned_pull("stream.partials"):
+                partial = int(partial)
+            obs.count("stream.host_sync")
+
+            # ---- transaction point: every program dispatched — bind ----
+            with self._bind_lock:
+                stale = epoch != self._epoch
+                if stale:
+                    # a retry (or a client resend) superseded this
+                    # attempt while its watchdog-abandoned thread kept
+                    # running: binding now would accumulate the chunk
+                    # twice — abort on this (abandoned) thread (the
+                    # counter + raise happen OUTSIDE the lock:
+                    # CONC.BLOCKING forbids a second lock under it)
+                    pass
+                else:
+                    self._bind_state(
+                        visible, contained, active, n_tot, assignment,
+                        node_visible, rep_plane, rep_votes, table_k,
+                        offset, num_k, chunk_tensors, real_frames, partial)
+            if stale:
+                obs.count("stream.stale_binds_dropped")
+                raise StaleChunkAttempt(self.seq_name, ci)
+            if self.single:
+                self._single_assoc = assoc
+                self._single_points = np.asarray(padded.scene_points)  # mct-ok: AST.HOSTSYNC (host numpy; pad_scene_tensors keeps host frames host)
+                self._single_frame_ids = list(padded.frame_ids)
+                self._single_table = table_k
+            state_bytes = sum(int(a.nbytes) for a in (
+                self.visible, self.contained, self.active, self.n_tot,
+                self.assignment, self.node_visible, self.rep_plane,
+                self.rep_votes))
+            obs.gauge_max("stream.state_bytes", float(state_bytes))
+            obs.gauge("stream.partial_instances", float(partial))
+            obs.count("stream.chunks")
+            obs.count("stream.frames", real_frames)
+            sp.set(partial_instances=partial, masks=self.masks_used)
+        seconds = time.perf_counter() - t0
+        self.timings["stream.chunks"] = (
+            self.timings.get("stream.chunks", 0.0) + seconds)
+        return {"chunk": ci, "frames": real_frames,
+                "frames_done": self.frames_done,
+                "total_frames": self.total_frames,
+                "masks": self.masks_used,
+                "partial_instances": partial,
+                "plane_bytes": int(plane_bytes),
+                "seconds": round(seconds, 4),
+                "done": self.frames_done >= self.total_frames}
+
+    # -- global table / export ----------------------------------------------
+
+    def global_table(self) -> MaskTable:
+        valid = np.zeros(self.m_pad, dtype=bool)
+        valid[:self.masks_used] = self.g_mask_id[:self.masks_used] >= 0
+        return MaskTable(frame=self.g_frame.copy(),
+                         mask_id=self.g_mask_id.copy(), valid=valid,
+                         num_masks=int(valid.sum()),
+                         num_frames=self.total_frames, k_max=self.k_max)
+
+    def partial_objects(self) -> SceneObjects:
+        """Anytime partial instances from the current rep plane (the same
+        export the finalize path uses, valid after any chunk)."""
+        if self.chunks_done == 0:
+            raise ValueError("partial_objects() before any chunk was pushed")
+        return self._objects_from_rep_plane()
+
+    def _objects_from_rep_plane(self) -> SceneObjects:
+        cfg = self.cfg
+        with sanctioned_pull("stream.rep_plane"):
+            rep_h = np.asarray(self.rep_plane)[:self.n_real]
+            assign_h = np.asarray(self.assignment)
+            active_h = np.asarray(self.active)
+        obs.count("stream.host_sync")
+        member_count = np.bincount(assign_h[active_h], minlength=self.m_pad) \
+            if active_h.any() else np.zeros(self.m_pad, np.int64)
+        reps = np.unique(rep_h[rep_h > 0]) - 1
+        reps = [int(r) for r in reps
+                if member_count[r] >= cfg.min_masks_per_object]
+        rep_points = {r: np.nonzero(rep_h == r + 1)[0] for r in reps}
+        reps = [r for r in reps
+                if len(rep_points[r]) >= cfg.dbscan_split_min_points]
+        labels_by_rep = dict(zip(reps, dbscan_labels_parallel(
+            [self.scene_points[rep_points[r]] for r in reps],
+            cfg.dbscan_split_eps, cfg.dbscan_split_min_points)))
+        members: Dict[int, List[int]] = {}
+        for m in np.nonzero(active_h)[0]:
+            members.setdefault(int(assign_h[m]), []).append(int(m))
+        point_ids, bboxes, mask_lists = [], [], []
+        for r in reps:
+            pts = rep_points[r]
+            labels = labels_by_rep[r]
+            # noise (-1) keeps its own candidate group, like the batch
+            # post-process's group 0
+            for g in range(int(labels.max()) + 2):
+                sel = (labels + 1) == g
+                if not sel.any():
+                    continue
+                obj_pts = pts[sel]
+                if len(obj_pts) < cfg.dbscan_split_min_points:
+                    continue
+                share = len(obj_pts) / max(len(pts), 1)
+                # streaming approximation: the rep's whole mask list rides
+                # every split component (per-mask point sets are not
+                # retained at O(M^2) state; coverage is the component's
+                # point share) — documented in ARCHITECTURE §Streaming
+                mlist = [(self.frame_ids[self.g_frame[m]],
+                          int(self.g_mask_id[m]), share)
+                         for m in members.get(r, [])
+                         if self.g_frame[m] < len(self.frame_ids)]
+                if len(mlist) < cfg.min_masks_per_object:
+                    continue
+                pts3d = self.scene_points[obj_pts]
+                point_ids.append(obj_pts)
+                bboxes.append((pts3d.min(axis=0), pts3d.max(axis=0)))
+                mask_lists.append(mlist)
+        point_ids, mask_lists = _merge_overlapping(
+            point_ids, bboxes, mask_lists, cfg.overlap_merge_ratio)
+        return SceneObjects(point_ids_list=point_ids, mask_list=mask_lists,
+                            num_points=self.n_real)
+
+    def finalize(self, *, export: bool = False,
+                 object_dict_dir: Optional[str] = None,
+                 prediction_root: str = "data/prediction") -> SceneResult:
+        """The stream's final answer.
+
+        Single-chunk streams (chunk >= F) hand the chunk's planes plus the
+        accumulated assignment to the EXACT batch host phase — artifacts
+        byte-identical to ``run_scene`` by construction. Multi-chunk
+        streams export from the rep plane (split + merge via the batch
+        post-process helpers).
+        """
+        from maskclustering_tpu.models.pipeline import run_scene_host
+
+        if self.chunks_done == 0:
+            raise ValueError("finalize() before any chunk was pushed")
+        if self.single:
+            assoc = self._single_assoc
+            handoff = DeviceHandoff(
+                table=self._single_table, assignment=self.assignment,
+                active=self.active, node_visible=self.node_visible,
+                first_id=assoc.first_id, last_id=assoc.last_id,
+                scene_points=self._single_points,
+                frame_ids=self._single_frame_ids, k_max=self.k_max,
+                n_real=self.n_real, seq_name=self.seq_name,
+                timings=dict(self.timings))
+            return run_scene_host(handoff, self.cfg, export=export,
+                                  object_dict_dir=object_dict_dir,
+                                  prediction_root=prediction_root)
+        with obs.span("stream.finalize", scene=self.seq_name):
+            objects = self._objects_from_rep_plane()
+            with sanctioned_pull("stream.assignment"):
+                assignment = np.asarray(self.assignment)
+            if export:
+                if self.seq_name is None or object_dict_dir is None:
+                    raise ValueError(
+                        "export=True requires seq_name and object_dict_dir")
+                faults.inject("export", self.seq_name)
+                export_artifacts(objects, self.seq_name,
+                                 self.cfg.config_name, object_dict_dir,
+                                 prediction_root=prediction_root,
+                                 top_k_repre=self.cfg.num_representative_masks)
+        return SceneResult(objects=objects, table=self.global_table(),
+                           assignment=assignment,
+                           timings=dict(self.timings))
+
+    # -- accumulator journal (crash resume) ---------------------------------
+
+    def save_state(self, path: str) -> None:
+        """Atomic accumulator snapshot (multi-chunk streams only — a
+        single-chunk stream re-runs its one chunk instead of persisting
+        the full planes)."""
+        if self.single:
+            return
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp.npz"
+        # the journal drain IS a pull seam: the whole accumulator crosses
+        # to host once per chunk, after the chunk's dispatch chain retired
+        with sanctioned_pull("stream.state_journal"):
+            np.savez(
+                tmp,
+                version=STREAM_STATE_VERSION,
+                config_name=self.cfg.config_name,
+                count_dtype=self.cfg.count_dtype,
+                total_frames=self.total_frames,
+                chunk_frames=self.chunk_frames,
+                k_max=self.k_max,
+                n_pad=self.n_pad,
+                m_pad=self.m_pad,
+                masks_used=self.masks_used,
+                chunks_done=self.chunks_done,
+                frames_done=self.frames_done,
+                partial_instances=self.partial_instances,
+                g_frame=self.g_frame, g_mask_id=self.g_mask_id,
+                frame_ids=np.asarray(self.frame_ids, dtype=object),
+                visible=np.asarray(self.visible),
+                contained=np.asarray(self.contained),
+                active=np.asarray(self.active),
+                n_tot=np.asarray(self.n_tot),
+                assignment=np.asarray(self.assignment),
+                node_visible=np.asarray(self.node_visible),
+                rep_plane=np.asarray(self.rep_plane),
+                rep_votes=np.asarray(self.rep_votes),
+                scene_points=self.scene_points,
+            )
+        os.replace(tmp, path)
+        obs.count("stream.state_saves")
+
+    def load_state(self, path: str) -> bool:
+        """Resume from a journaled accumulator; False = not resumable
+        (missing, torn, or a different stream's coordinates)."""
+        if self.single or not os.path.exists(path):
+            return False
+        try:
+            with np.load(path, allow_pickle=True) as z:
+                if int(z["version"]) != STREAM_STATE_VERSION:
+                    return False
+                if (str(z["config_name"]) != self.cfg.config_name
+                        or str(z["count_dtype"]) != self.cfg.count_dtype
+                        or int(z["total_frames"]) != self.total_frames
+                        or int(z["chunk_frames"]) != self.chunk_frames
+                        or int(z["n_pad"]) != self.n_pad):
+                    return False
+                self.k_max = int(z["k_max"])
+                self.m_pad = int(z["m_pad"])
+                self.masks_used = int(z["masks_used"])
+                self.chunks_done = int(z["chunks_done"])
+                self.frames_done = int(z["frames_done"])
+                self.partial_instances = int(z["partial_instances"])
+                self.g_frame = z["g_frame"].copy()
+                self.g_mask_id = z["g_mask_id"].copy()
+                self.frame_ids = list(z["frame_ids"])
+                self.visible = jnp.asarray(z["visible"])
+                self.contained = jnp.asarray(z["contained"])
+                self.active = jnp.asarray(z["active"])
+                self.n_tot = jnp.asarray(z["n_tot"])
+                self.assignment = jnp.asarray(z["assignment"])
+                self.node_visible = jnp.asarray(z["node_visible"])
+                self.rep_plane = jnp.asarray(z["rep_plane"])
+                self.rep_votes = jnp.asarray(z["rep_votes"])
+                self.scene_points = z["scene_points"].copy()
+        except Exception:  # noqa: BLE001 — a torn snapshot restarts clean
+            log.exception("stream %s: unreadable state journal %s "
+                          "(restarting the stream)", self.seq_name, path)
+            return False
+        obs.count("stream.state_resumes")
+        log.info("stream %s: resumed at chunk %d/%d from %s",
+                 self.seq_name, self.chunks_done, self.n_chunks, path)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# the scene-level driver (run.py's streaming mode)
+# ---------------------------------------------------------------------------
+
+
+def stream_state_path(state_dir: str, seq_name: str) -> str:
+    return os.path.join(state_dir, f"{seq_name}.stream.npz")
+
+
+def stream_scene(tensors: SceneTensors, cfg: PipelineConfig, *,
+                 seq_name: Optional[str] = None, export: bool = False,
+                 object_dict_dir: Optional[str] = None,
+                 prediction_root: str = "data/prediction",
+                 state_dir: Optional[str] = None,
+                 resume: bool = True) -> SceneResult:
+    """Cluster one scene through the chunked streaming accumulator.
+
+    The streaming analog of ``models.pipeline.run_scene``: frames feed in
+    ``cfg.streaming_chunk``-sized chunks, a failed chunk retries (up to
+    ``cfg.stream_chunk_retries``, device watchdog per chunk) with the
+    accumulator intact, and — when ``state_dir`` is given — every chunk
+    journals the accumulator so a killed process resumes mid-stream
+    instead of restarting the scan.
+    """
+    from maskclustering_tpu.utils.compile_cache import max_seg_id
+
+    k_max = bucket_k_max(max_seg_id(tensors.segmentations))
+    acc = StreamAccumulator(cfg, total_frames=tensors.num_frames,
+                            num_points=tensors.num_points, k_max=k_max,
+                            seq_name=seq_name)
+    state_path = (stream_state_path(state_dir, seq_name)
+                  if state_dir and seq_name else None)
+    if state_path and resume:
+        acc.load_state(state_path)
+    policy = faults.RetryPolicy(attempts=cfg.stream_chunk_retries + 1,
+                                base_s=cfg.retry_backoff_s,
+                                cap_s=max(cfg.retry_backoff_s * 8.0, 0.0))
+    t0 = time.perf_counter()
+    with obs.span("stream.scene", scene=seq_name,
+                  chunks=acc.n_chunks, chunk_frames=acc.chunk_frames):
+        for ci in range(acc.chunks_done, acc.n_chunks):
+            chunk = slice_scene_frames(
+                tensors, ci * acc.chunk_frames,
+                min((ci + 1) * acc.chunk_frames, tensors.num_frames))
+            attempt = 0
+            while True:
+                try:
+                    digest = faults.call_with_deadline(
+                        lambda chunk=chunk: acc.push_chunk(chunk),
+                        cfg.watchdog_device_s, seam="device",
+                        scene=seq_name)
+                    break
+                except Exception as e:  # noqa: BLE001 — chunk retry loop
+                    if (faults.classify_error(e) == "terminal"
+                            or attempt >= cfg.stream_chunk_retries
+                            or faults.stop_requested()):
+                        raise
+                    attempt += 1
+                    delay = policy.backoff(attempt)
+                    obs.count("stream.chunk_retries")
+                    log.warning("stream %s: chunk %d failed (%s); retry "
+                                "%d/%d in %.2fs", seq_name, ci, e, attempt,
+                                cfg.stream_chunk_retries, delay)
+                    if delay > 0:
+                        time.sleep(delay)
+            log.info("stream %s: chunk %d/%d, %d frames, %d partial "
+                     "instance(s)", seq_name, digest["chunk"] + 1,
+                     acc.n_chunks, digest["frames_done"],
+                     digest["partial_instances"])
+            # snapshot cadence (cfg.stream_journal_every): every snapshot
+            # drains the accumulator to host + writes an npz — real
+            # latency against the per-chunk SLO at production M_pad — so
+            # a >1 cadence trades at most N-1 re-runnable chunks on a
+            # kill for N-1 snapshot-free chunks (0 = never). The FINAL
+            # chunk never snapshots: finalize follows immediately and
+            # deletes the file, so that drain would be pure waste (a
+            # crash between here and finalize re-runs from artifacts)
+            if state_path and cfg.stream_journal_every > 0 \
+                    and (ci + 1) % cfg.stream_journal_every == 0 \
+                    and ci + 1 < acc.n_chunks:
+                acc.save_state(state_path)
+        result = faults.call_with_deadline(
+            lambda: acc.finalize(export=export,
+                                 object_dict_dir=object_dict_dir,
+                                 prediction_root=prediction_root),
+            cfg.watchdog_host_s, seam="host", scene=seq_name)
+    if state_path and os.path.exists(state_path):
+        # the scene is done: the state journal must not resume a finished
+        # stream into a double-accumulation
+        os.remove(state_path)
+    timings = dict(result.timings)
+    timings["stream.total"] = round(time.perf_counter() - t0, 4)
+    timings["stream.num_chunks"] = float(acc.n_chunks)
+    return SceneResult(objects=result.objects, table=result.table,
+                       assignment=result.assignment, timings=timings)

@@ -175,6 +175,7 @@ def serve_row(verdict: Dict, **extra) -> Dict:
     for k in ("p95_s", "throughput_rps", "requests", "concurrency",
               "scenes", "buckets", "rejects", "failed", "warmup_s",
               "count_dtype", "plane_dtype", "point_shards",
+              "streaming_chunk",
               "retrace_compiles", "retrace_repeats", "retrace_post_freeze",
               "retrace_cache_hits", "aot_restored", "worker_crashes",
               "worker_respawns", "telemetry_windows", "window_p95",
@@ -284,10 +285,12 @@ def check_regression(current: Optional[Dict], baseline: Optional[Dict], *,
     knob_flips = []
     # point_shards defaults to 1: rows predating the knob ran unsharded,
     # so a sharded row against an old baseline reads as a knob flip (the
-    # resharded program has its own compile surface and ICI profile)
+    # resharded program has its own compile surface and ICI profile).
+    # streaming_chunk defaults to 0 (offline batch): a chunked row's
+    # latency profile belongs to the chunk size, not code drift
     for knob, default in (("count_dtype", "bf16"), ("plane_dtype", "int32"),
                           ("postprocess_path", "device"),
-                          ("point_shards", 1)):
+                          ("point_shards", 1), ("streaming_chunk", 0)):
         c, b = current.get(knob, default), baseline.get(knob, default)
         if c != b:
             knob_flips.append(knob)

@@ -192,7 +192,7 @@ class RunData:
             "counters": {k: v for k, v in sorted(self._counters.items())
                          if k.startswith(("run.", "bench.", "compile_cache.",
                                           "pipeline.", "faults.",
-                                          "retrace.", "serve.",
+                                          "retrace.", "serve.", "stream.",
                                           "aot_cache.", "worker."))},
             # the registry's bounded histogram summaries (metrics.py
             # snapshot contract): span.* series are already covered by the
@@ -505,6 +505,57 @@ def render_retrace(counters: Dict[str, float]) -> Optional[str]:
     return line
 
 
+def render_streaming(run: "RunData") -> Optional[str]:
+    """The Streaming section: latency-per-chunk + residency digest.
+
+    Rendered only when the events carry ``stream.*`` metrics (a
+    ``--streaming-chunk`` run or a daemon serving ``stream_chunk`` ops);
+    batch reports are unchanged. Chunk p50/p95 come from the
+    ``stream.chunk`` span series; frames/s sustained is total streamed
+    frames over total chunk wall — the live-scan SLO number — and the
+    two high-water gauges pin the headline residency claim: only one
+    chunk's claim planes (``stream.max_plane_bytes``) plus the O(M^2)
+    accumulator (``stream.state_bytes``) are ever resident.
+    """
+    c, g = run._counters, run._gauges
+    chunks = int(c.get("stream.chunks", 0))
+    if not chunks:
+        return None
+    lines = ["== streaming (chunked accumulation) =="]
+    frames = int(c.get("stream.frames", 0))
+    p50 = p95 = total = None
+    for r in run.stage_rows():
+        if r["stage"] == "stream.chunk":
+            p50, p95, total = r["p50_s"], r["p95_s"], r["total_s"]
+            break
+    line = (f"chunks {chunks} | frames {frames} | "
+            f"re-clusters {int(c.get('stream.reclusters', 0))}")
+    if c.get("stream.chunk_retries"):
+        line += f" | chunk retries {int(c['stream.chunk_retries'])}"
+    if c.get("stream.state_resumes"):
+        line += f" | journal resumes {int(c['stream.state_resumes'])}"
+    if c.get("stream.mask_capacity_growths"):
+        line += (f" | mask-capacity growths "
+                 f"{int(c['stream.mask_capacity_growths'])}")
+    lines.append(line)
+    if p50 is not None:
+        sustained = frames / total if total else None
+        lines.append(
+            f"chunk latency: p50 {_fmt_s(p50)} | p95 {_fmt_s(p95)}"
+            + (f" | {sustained:.1f} frames/s sustained"
+               if sustained else ""))
+    plane = g.get("stream.max_plane_bytes")
+    state = g.get("stream.state_bytes")
+    if plane is not None or state is not None:
+        lines.append(
+            f"residency high-water: chunk planes {_fmt_bytes(plane)} | "
+            f"accumulator state {_fmt_bytes(state)}")
+    partials = g.get("stream.partial_instances")
+    if partials is not None:
+        lines.append(f"partial instances (last chunk): {int(partials)}")
+    return "\n".join(lines)
+
+
 def render_report(run: RunData) -> str:
     rows = [[r["stage"], str(r["count"]), _fmt_s(r["p50_s"]), _fmt_s(r["p95_s"]),
              _fmt_s(r["device_p50_s"]), _fmt_s(r["host_p50_s"]),
@@ -545,6 +596,9 @@ def render_report(run: RunData) -> str:
     serving_sec = render_serving(run)
     if serving_sec:
         out.append(serving_sec)
+    streaming_sec = render_streaming(run)
+    if streaming_sec:
+        out.append(streaming_sec)
     analysis_sec = render_analysis(run.analysis_rows)
     retrace_line = render_retrace(run._counters)
     if analysis_sec:

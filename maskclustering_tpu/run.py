@@ -296,14 +296,32 @@ def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
             # interrupted (in flight, must re-run) rather than dispatching
             # device work during shutdown
             return ctx.finish(SceneStatus(seq_name, "interrupted"))
-        handoff = faults.call_with_deadline(
-            lambda: run_scene_device(tensors, cfg, seq_name=seq_name),
-            cfg.watchdog_device_s, seam="device", scene=seq_name)
-        result = faults.call_with_deadline(
-            lambda: run_scene_host(handoff, cfg, export=True,
-                                   object_dict_dir=ds.object_dict_dir,
-                                   prediction_root=prediction_root),
-            cfg.watchdog_host_s, seam="host", scene=seq_name)
+        if cfg.streaming_chunk > 0:
+            # streaming mode: frames feed the chunked accumulator
+            # (models/streaming.py) — per-chunk watchdog + retry happen
+            # INSIDE stream_scene (a mid-stream fault retries the chunk,
+            # accumulator intact; the journaled state resumes a killed
+            # process mid-stream). The scene supervisor's ladder still
+            # wraps this call for errors the chunk retries cannot heal.
+            from maskclustering_tpu.models.streaming import stream_scene
+
+            result = stream_scene(
+                tensors, cfg, seq_name=seq_name, export=True,
+                object_dict_dir=ds.object_dict_dir,
+                prediction_root=prediction_root,
+                state_dir=os.path.join(
+                    prediction_root,
+                    cfg.config_name + "_stream_state"),
+                resume=resume)
+        else:
+            handoff = faults.call_with_deadline(
+                lambda: run_scene_device(tensors, cfg, seq_name=seq_name),
+                cfg.watchdog_device_s, seam="device", scene=seq_name)
+            result = faults.call_with_deadline(
+                lambda: run_scene_host(handoff, cfg, export=True,
+                                       object_dict_dir=ds.object_dict_dir,
+                                       prediction_root=prediction_root),
+                cfg.watchdog_host_s, seam="host", scene=seq_name)
         obs.count("run.scenes_ok")
         return ctx.finish(SceneStatus(
             seq_name, "ok", time.perf_counter() - t0,
@@ -642,6 +660,12 @@ def _dispatch_scenes(cfg: PipelineConfig, seq_names: Sequence[str], *,
     if cfg.mesh_shape:
         return cluster_scenes_mesh(cfg, seq_names, resume=resume, ctx=ctx)
     if workers <= 1:
+        if cfg.streaming_chunk > 0:
+            # streaming scenes pipeline INSIDE the scene (chunked
+            # accumulation); the overlapped executor's device/host split
+            # does not apply — cluster_scene routes through stream_scene
+            return _cluster_scenes_sequential(cfg, seq_names, resume=resume,
+                                              ctx=ctx)
         if cfg.scene_overlap and len(seq_names) > 1:
             return _cluster_scenes_overlapped(cfg, seq_names, resume=resume,
                                               ctx=ctx)
@@ -1277,6 +1301,7 @@ def _run_pipeline_body(
                             count_dtype=cfg.count_dtype,
                             plane_dtype="int16",
                             point_shards=int(cfg.point_shards),
+                            streaming_chunk=int(cfg.streaming_chunk),
                             postprocess_path=("device"
                                               if cfg.device_postprocess
                                               else "host")))
@@ -1331,6 +1356,22 @@ def main(argv=None) -> int:
                              "(tests/test_point_sharding.py). The ledger "
                              "row stamps point_shards so --regress "
                              "attributes the flip, not code drift")
+    parser.add_argument("--streaming-chunk", type=int, default=None,
+                        metavar="F",
+                        help="streaming incremental clustering: accumulate "
+                             "frames in chunks of F through the device-"
+                             "resident streaming accumulator (models/"
+                             "streaming.py) — only one chunk's (F, N) "
+                             "claim planes plus O(M^2) graph state are "
+                             "ever resident (stream.max_plane_bytes pins "
+                             "it), partial instances are available per "
+                             "chunk, and the final answer converges to "
+                             "the batch result (byte-identical when one "
+                             "chunk covers the scene). 0 = the classic "
+                             "offline-batch pipeline (default: config "
+                             "streaming_chunk). The ledger row stamps "
+                             "streaming_chunk so --regress attributes the "
+                             "flip, not code drift")
     parser.add_argument("--no-resume", action="store_true",
                         help="recompute even when artifacts exist")
     parser.add_argument("--encoder", default="hash",
@@ -1429,6 +1470,8 @@ def main(argv=None) -> int:
         overrides["scene_overlap"] = False
     if args.point_shards is not None:
         overrides["point_shards"] = args.point_shards
+    if args.streaming_chunk is not None:
+        overrides["streaming_chunk"] = args.streaming_chunk
     if args.scene_retries is not None:
         overrides["scene_retries"] = args.scene_retries
     if args.watchdog_device is not None:

@@ -104,6 +104,74 @@ class ServeClient:
         terminal = self.wait_result(collect=statuses)
         return terminal, statuses, time.monotonic() - t0
 
+    # -- live-scan streaming ------------------------------------------------
+
+    def stream_chunk(self, scene: str, *, chunk: int = 0,
+                     synthetic: Optional[Dict] = None, deadline_s: float = 0.0,
+                     tag: str = "") -> Tuple[Dict, List[Dict]]:
+        """Accumulate the scene's next frame chunk on the daemon.
+
+        Returns ``(terminal event, status events)`` — the terminal result
+        carries ``partial_instances`` (the anytime instance count) and
+        ``done`` (all frames consumed). ``chunk`` (frames per chunk) only
+        matters on the FIRST op of a stream; 0 uses the daemon's config.
+        """
+        doc: Dict = {"op": "stream_chunk", "scene": scene}
+        if chunk:
+            doc["chunk"] = chunk
+        if synthetic is not None:
+            doc["synthetic"] = synthetic
+        if deadline_s:
+            doc["deadline_s"] = deadline_s
+        if tag:
+            doc["tag"] = tag
+        self.send(doc)
+        first = self.recv_event()
+        if first.get("kind") == "reject":
+            return first, []
+        assert first.get("kind") == "ack", first
+        statuses: List[Dict] = []
+        return self.wait_result(collect=statuses), statuses
+
+    def stream_end(self, scene: str, *, tag: str = "") -> Tuple[Dict, List[Dict]]:
+        """Finalize a stream: export artifacts, drop the session."""
+        doc: Dict = {"op": "stream_end", "scene": scene}
+        if tag:
+            doc["tag"] = tag
+        self.send(doc)
+        first = self.recv_event()
+        if first.get("kind") == "reject":
+            return first, []
+        assert first.get("kind") == "ack", first
+        statuses: List[Dict] = []
+        return self.wait_result(collect=statuses), statuses
+
+    def stream_scene(self, scene: str, *, chunk: int = 0,
+                     synthetic: Optional[Dict] = None,
+                     max_chunks: int = 10000) -> Tuple[Dict, List[Dict]]:
+        """Drive a whole scan: stream_chunk until ``done``, then
+        stream_end. Returns the final result plus EVERY per-chunk
+        terminal event (the partial-instance trajectory) — the one
+        streaming flow load_gen, CI and the tests share."""
+        chunk_events: List[Dict] = []
+        for _ in range(max_chunks):
+            ev, _st = self.stream_chunk(scene, chunk=chunk,
+                                        synthetic=synthetic)
+            chunk_events.append(ev)
+            if ev.get("kind") != "result" or ev.get("status") != "ok":
+                return ev, chunk_events
+            if ev.get("done"):
+                break
+        else:
+            # never finalize a stream the server has not reported done —
+            # a silent partial export would be indistinguishable from a
+            # complete scan to the caller
+            raise ServeClientError(
+                f"stream {scene!r} not done after {max_chunks} chunk "
+                f"op(s); raise max_chunks or send stream_end yourself")
+        final, _st = self.stream_end(scene)
+        return final, chunk_events
+
     def stats(self, detail: str = "") -> Dict:
         doc: Dict = {"op": "status"}
         if detail:

@@ -435,6 +435,7 @@ class ServeDaemon:
             # knob-flip advisory): a resharded daemon's latency profile is
             # the knob's, not code drift's
             "point_shards": int(self.cfg.point_shards),
+            "streaming_chunk": int(self.cfg.streaming_chunk),
             "uptime_s": round(time.monotonic() - self._started_at, 2)
             if self._started_at else 0.0,
             "warmup_s": round(self._warmup_s, 2),

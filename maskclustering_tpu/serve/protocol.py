@@ -17,6 +17,19 @@ Request ops::
     {"op": "scene", "scene": "synth-a",
      "synthetic": {"num_boxes": 3, "num_frames": 10,
                    "image_hw": [60, 80], "spacing": 0.06, "seed": 40}}
+    {"op": "stream_chunk", "scene": "synth-a", "chunk": 8,
+     "synthetic": {...}}          # accumulate the scene's NEXT frame
+                                  # chunk (live-scan streaming); result
+                                  # carries partial_instances + done.
+                                  # The scene name IS the stream identity
+                                  # (one producer per scene, like the
+                                  # artifact paths) — two clients
+                                  # streaming one scene interleave on a
+                                  # single cursor
+    {"op": "stream_end", "scene": "synth-a"}  # finalize + export the
+                                  # stream's artifacts, drop its session
+                                  # (only on success — a failed export
+                                  # keeps it, resend the op)
     {"op": "status"}              # daemon stats snapshot
     {"op": "status", "detail": "telemetry"}  # + windowed telemetry ring
     {"op": "shutdown"}            # drain in-flight requests, then exit
@@ -51,7 +64,9 @@ from typing import Dict, Optional
 
 PROTOCOL_VERSION = 1
 
-OPS = ("scene", "status", "shutdown")
+OPS = ("scene", "stream_chunk", "stream_end", "status", "shutdown")
+# the ops that name a scene and ride the admission queue as work items
+SCENE_OPS = ("scene", "stream_chunk", "stream_end")
 # status op detail levels: "" (the classic point-in-time snapshot) or
 # "telemetry" (adds the windowed aggregator's ring + cumulative digest)
 STATUS_DETAILS = ("", "telemetry")
@@ -77,6 +92,8 @@ class SceneRequest:
 
     id: str
     scene: str
+    op: str = "scene"  # "scene" | "stream_chunk" | "stream_end"
+    chunk: int = 0  # stream_chunk only: frames per chunk (0 = config)
     synthetic: Optional[Dict] = None
     deadline_s: float = 0.0
     resume: bool = False
@@ -115,13 +132,19 @@ def parse_line(line: str) -> Dict:
         if detail not in STATUS_DETAILS:
             raise ProtocolError(f"unknown status detail {detail!r} "
                                 f"(one of {STATUS_DETAILS})")
-    if op == "scene":
+    if op in SCENE_OPS:
         scene = doc.get("scene")
         if not isinstance(scene, str) or not scene:
-            raise ProtocolError("scene op needs a non-empty 'scene' name")
+            raise ProtocolError(f"{op} op needs a non-empty 'scene' name")
         if os_sep_like(scene):
             raise ProtocolError(f"scene name {scene!r} must not contain "
                                 "path separators")
+        chunk = doc.get("chunk", 0)
+        if not isinstance(chunk, int) or isinstance(chunk, bool) or chunk < 0:
+            raise ProtocolError("'chunk' must be an integer >= 0")
+        if chunk and op != "stream_chunk":
+            raise ProtocolError("'chunk' only applies to the stream_chunk "
+                                "op")
         syn = doc.get("synthetic")
         if syn is not None:
             if not isinstance(syn, dict):
@@ -151,12 +174,14 @@ def os_sep_like(name: str) -> bool:
 
 
 def build_request(doc: Dict, request_id: str) -> SceneRequest:
-    """A validated ``scene`` op -> the daemon's work item."""
+    """A validated scene-naming op -> the daemon's work item."""
     deadline = float(doc.get("deadline_s", 0.0) or 0.0)
     now = time.monotonic()
     return SceneRequest(
         id=request_id,
         scene=doc["scene"],
+        op=str(doc.get("op", "scene")),
+        chunk=int(doc.get("chunk", 0) or 0),
         synthetic=doc.get("synthetic"),
         deadline_s=deadline,
         resume=bool(doc.get("resume", False)),
@@ -176,7 +201,9 @@ def forward_request(req: SceneRequest) -> Dict:
     boundaries), and the crash count (the child's SceneSupervisor starts
     pre-degraded by it).
     """
-    doc: Dict = {"op": "scene", "id": req.id, "scene": req.scene}
+    doc: Dict = {"op": req.op or "scene", "id": req.id, "scene": req.scene}
+    if req.chunk:
+        doc["chunk"] = req.chunk
     if req.synthetic is not None:
         doc["synthetic"] = req.synthetic
     if not math.isinf(req.deadline_at):

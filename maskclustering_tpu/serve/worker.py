@@ -88,6 +88,28 @@ def _scene_buckets() -> set:
     return seen_scene_buckets()
 
 
+class _StreamSession:
+    """One live scan's worker-side state (worker-thread-only access).
+
+    Holds the scene's host tensors (loaded once), the streaming
+    accumulator and the frame cursor. Sessions are keyed by scene name in
+    ``ServeWorker._streams`` — the scene name IS the stream identity
+    (same contract as the scene-artifact paths: one producer per scene;
+    two clients streaming the same scene interleave on one cursor) — and
+    the single worker thread is the only reader/writer, so no lock is
+    needed (mct-threads: the dict never escapes the worker thread).
+    """
+
+    def __init__(self, tensors, acc):
+        self.tensors = tensors
+        self.acc = acc
+        self.last_used = time.monotonic()
+
+    @property
+    def done(self) -> bool:
+        return self.acc.frames_done >= self.acc.total_frames
+
+
 class ServeWorker:
     """The daemon's single execution thread (start/stop bounded)."""
 
@@ -114,6 +136,15 @@ class ServeWorker:
         self._latencies: Deque[float] = deque(maxlen=4096)
         self._counts = {"requests": 0, "ok": 0, "failed": 0, "deadline": 0,
                         "skipped": 0, "interrupted": 0}
+        # live-scan streams (stream_chunk/stream_end ops), keyed by scene
+        # name; worker-thread-only (see _StreamSession). Bounded: a
+        # session pins the scene's host tensors AND the O(M^2) device
+        # accumulator, so abandoned streams (client gone, no stream_end)
+        # must not accumulate for the daemon's lifetime — past the cap
+        # the least-recently-used session evicts (typed counter + log;
+        # the evicted client's next op reopens from chunk 0)
+        self._streams: Dict[str, _StreamSession] = {}
+        self.max_stream_sessions = 4
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -203,6 +234,23 @@ class ServeWorker:
         return faults.RunJournal(path, self.cfg.config_name,
                                  request_id=req.id)
 
+    def _finish_request(self, req: protocol.SceneRequest, status_: str,
+                        latency: float, *, telemetry_bucket=None,
+                        **fields) -> None:
+        """The one request tail — latency window, ``serve.requests_*``
+        counter, locked counts, telemetry row, terminal result emit —
+        shared by the classic scene op and the stream ops so request
+        accounting cannot drift between the two paths."""
+        self._latencies.append(latency)
+        obs.count(f"serve.requests_{status_}")
+        with self._lock:
+            self._counts[status_] = self._counts.get(status_, 0) + 1
+        telemetry.record_request(
+            telemetry_bucket if telemetry_bucket is not None
+            else self.router.bucket_for(req.scene), latency)
+        _send(req, protocol.result(req, status_,
+                                   seconds=round(latency, 4), **fields))
+
     def _serve_one(self, req: protocol.SceneRequest) -> None:
         from maskclustering_tpu.run import SceneSupervisor
 
@@ -225,6 +273,10 @@ class ServeWorker:
                 "deadline", req=req,
                 detail=f"deadline_s={req.deadline_s:g} expired after "
                        f"{time.monotonic() - req.admitted_at:.2f}s in queue"))
+            return
+
+        if req.op in ("stream_chunk", "stream_end"):
+            self._serve_stream(req)
             return
 
         t0 = time.monotonic()
@@ -298,7 +350,6 @@ class ServeWorker:
         if bucket is not None:
             self.router.note_served(bucket)
         latency = time.monotonic() - t0
-        self._latencies.append(latency)
 
         st = statuses[0] if statuses else None
         if st is None:
@@ -315,19 +366,144 @@ class ServeWorker:
             if st.error:
                 fields["error"] = str(st.error).strip().splitlines()[-1][:200]
                 fields["error_class"] = st.error_class
-        obs.count(f"serve.requests_{status_}")
-        with self._lock:
-            self._counts[status_] = self._counts.get(status_, 0) + 1
-        telemetry.record_request(
-            bucket if bucket is not None else self.router.bucket_for(req.scene),
-            latency)
         if new_buckets:
             obs.count("serve.buckets_cold", len(new_buckets))
-        _send(req, protocol.result(
-            req, status_, seconds=round(latency, 4),
+        self._finish_request(
+            req, status_, latency, telemetry_bucket=bucket,
             buckets_new=len(new_buckets),
             **({"bucket": list(bucket)} if bucket is not None else {}),
-            **fields))
+            **fields)
+
+    # -- live-scan streaming (stream_chunk / stream_end ops) ----------------
+
+    def _open_stream(self, req: protocol.SceneRequest) -> _StreamSession:
+        """Create the scene's stream session: tensors loaded ONCE, the
+        accumulator sized for the whole scan."""
+        from maskclustering_tpu.datasets import get_dataset
+        from maskclustering_tpu.models.pipeline import bucket_k_max
+        from maskclustering_tpu.models.streaming import StreamAccumulator
+        from maskclustering_tpu.utils.compile_cache import max_seg_id
+
+        if req.synthetic is not None:
+            ensure_synthetic_scene(self.cfg, req.scene, req.synthetic)
+        ds = get_dataset(self.cfg.dataset, req.scene,
+                         data_root=self.cfg.data_root)
+        tensors = ds.load_scene_tensors(self.cfg.step)
+        chunk = int(req.chunk) or self.cfg.streaming_chunk or 8
+        cfg = (self.cfg if self.cfg.streaming_chunk == chunk
+               else self.cfg.replace(streaming_chunk=chunk))
+        acc = StreamAccumulator(
+            cfg, total_frames=tensors.num_frames,
+            num_points=tensors.num_points,
+            k_max=bucket_k_max(max_seg_id(tensors.segmentations)),
+            seq_name=req.scene)
+        while len(self._streams) >= self.max_stream_sessions:
+            victim = min(self._streams, key=lambda s:
+                         self._streams[s].last_used)
+            log.warning("serve: evicting idle stream session %r "
+                        "(cap %d; its client must restart the scan)",
+                        victim, self.max_stream_sessions)
+            obs.count("serve.streams_evicted")
+            del self._streams[victim]
+        return _StreamSession(tensors, acc)
+
+    def _serve_stream(self, req: protocol.SceneRequest) -> None:
+        """One stream op: accumulate the scene's next chunk, or finalize.
+
+        Each op is one admitted request (ack -> status -> result), so
+        streams interleave fairly with classic scene requests on the one
+        device-owning thread. The result's ``partial_instances`` /
+        ``done`` fields are the live-scan anytime contract; a failed
+        chunk answers a typed ``failed`` result with the accumulator
+        intact, so the client can simply resend the op.
+        """
+        from maskclustering_tpu.models.streaming import slice_scene_frames
+
+        t0 = time.monotonic()
+        status_ = "ok"
+        fields: Dict = {}
+        try:
+            if req.op == "stream_end":
+                sess = self._streams.get(req.scene)
+                if sess is None or sess.acc.chunks_done == 0:
+                    raise ValueError(
+                        f"no live stream for scene {req.scene!r} "
+                        f"(send stream_chunk first)")
+                sess.last_used = time.monotonic()
+                _send(req, protocol.status(
+                    req, "running", scene=req.scene, stream="end"))
+                from maskclustering_tpu.datasets import get_dataset
+
+                ds = get_dataset(self.cfg.dataset, req.scene,
+                                 data_root=self.cfg.data_root)
+                with obs.span("serve.request", request=req.id,
+                              scene=req.scene, stream="end"):
+                    result = sess.acc.finalize(
+                        export=True, object_dict_dir=ds.object_dict_dir,
+                        prediction_root=self.prediction_root)
+                # only a SUCCESSFUL finalize consumes the session: a
+                # failed export/finalize keeps the accumulated stream so
+                # the client can simply resend stream_end
+                self._streams.pop(req.scene, None)
+                fields = {"num_objects": len(result.objects.point_ids_list),
+                          "frames": sess.acc.frames_done,
+                          "chunks": sess.acc.chunks_done}
+                obs.count("serve.stream_ends")
+            else:
+                sess = self._streams.get(req.scene)
+                if sess is None:
+                    sess = self._open_stream(req)
+                    self._streams[req.scene] = sess
+                    obs.count("serve.streams_opened")
+                sess.last_used = time.monotonic()
+                acc = sess.acc
+                if sess.done:
+                    raise ValueError(
+                        f"stream {req.scene!r} already consumed all "
+                        f"{acc.total_frames} frames (send stream_end)")
+                _send(req, protocol.status(
+                    req, "running", scene=req.scene,
+                    stream="chunk", chunk_index=acc.chunks_done))
+                start = acc.chunks_done * acc.chunk_frames
+                stop = min(start + acc.chunk_frames,
+                           sess.tensors.num_frames)
+                with obs.span("serve.request", request=req.id,
+                              scene=req.scene, stream="chunk"):
+                    # the request deadline folds into the chunk watchdog
+                    # exactly like the classic scene op (min of the
+                    # config budget and the remaining deadline)
+                    digest = faults.call_with_deadline(
+                        lambda: acc.push_chunk(
+                            slice_scene_frames(sess.tensors, start, stop)),
+                        self._deadline_cfg(req).watchdog_device_s,
+                        seam="device", scene=req.scene)
+                # the per-chunk anytime signal: partial-instance count on
+                # a status event BEFORE the terminal result (live
+                # dashboards and the client's streaming helper read it)
+                _send(req, protocol.status(
+                    req, "chunk_done", scene=req.scene,
+                    chunk_index=digest["chunk"],
+                    frames_done=digest["frames_done"],
+                    total_frames=digest["total_frames"],
+                    partial_instances=digest["partial_instances"]))
+                fields = {k: digest[k]
+                          for k in ("chunk", "frames_done", "total_frames",
+                                    "partial_instances", "done")}
+                obs.count("serve.stream_chunks")
+        except Exception as e:  # noqa: BLE001 — one op, not the daemon
+            log.exception("serve: stream op %s failed for %s",
+                          req.op, req.id)
+            status_ = "failed"
+            msg = str(e).strip()
+            fields = {"error": (msg.splitlines()[-1] if msg
+                                else type(e).__name__)[:200],
+                      "error_class": faults.classify_error(e)}
+        if status_ == "failed" and req.expired():
+            # same reclassification as the classic scene op: a failure
+            # past the request's deadline answers as the deadline's
+            status_ = "deadline"
+        self._finish_request(req, status_, time.monotonic() - t0,
+                             op=req.op, **fields)
 
     # -- warm-up ------------------------------------------------------------
 

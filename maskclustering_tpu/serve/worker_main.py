@@ -242,7 +242,7 @@ def main(argv=None) -> int:
         op = doc.get("op")
         if op == "shutdown":
             break
-        if op != "scene":
+        if op not in protocol.SCENE_OPS:
             continue
         req = protocol.build_request(doc, str(doc.get("id") or "r-local"))
         req.send = emit

@@ -55,11 +55,13 @@ from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
 log = logging.getLogger("maskclustering_tpu")
 
 # seams a FaultPlan can target; these are the places run.py / models/
-# pipeline.py / models/postprocess_device.py call inject()
-# (see ARCHITECTURE.md §Fault tolerance); "post" fires at the head of the
-# device post-process chain — the seam that drives the ladder's
-# host-postprocess rung
-SEAMS = ("load", "device", "host", "export", "pull", "post")
+# pipeline.py / models/postprocess_device.py / models/streaming.py call
+# inject() (see ARCHITECTURE.md §Fault tolerance); "post" fires at the
+# head of the device post-process chain — the seam that drives the
+# ladder's host-postprocess rung — and "chunk" fires at the top of every
+# streaming accumulation chunk, the seam whose faults retry the CHUNK
+# (accumulator intact), not the scene
+SEAMS = ("load", "device", "host", "export", "pull", "post", "chunk")
 
 # error_class vocabulary stamped on SceneStatus / journal rows:
 #   retryable — transient by default (IO, unknown runtime errors)

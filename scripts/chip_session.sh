@@ -37,6 +37,9 @@
 #      mesh_bench 1M-point fused workload, frame-only 1x8 vs point-sharded
 #      1x2x4 on the LIVE backend — the on-chip number next to
 #      MESH_BENCH.md's static point-axis census
+#   5c. streaming A/B (ISSUE 15, advisory)     -> stream_ab_{batch,chunk8}.out
+#      batch vs chunk-8 accumulation, one PROCESS per variant (gauge_max
+#      isolation) — wall + residency rows in STREAM_AB_{batch,chunk8}.json
 #   6. northstar sweep (multi-bucket, ~3 min)  -> northstar.out + NORTHSTAR_live.md
 #   7. obs report render of the bench captures -> obs_report.out
 #      (+ per-stage diffs of both A/B runs against the default)
@@ -143,6 +146,46 @@ run point_shard_a 900 python scripts/mesh_bench.py --platform tpu --mesh 1 8 \
   --out "$OUT/POINT_SHARD_A.md" "${PS_SHAPE[@]}"
 run point_shard_b 900 python scripts/mesh_bench.py --platform tpu --mesh 1 2 \
   --point-shards 4 --out "$OUT/POINT_SHARD_B.md" "${PS_SHAPE[@]}"
+# streaming A/B (ADVISORY, ISSUE 15): batch vs chunked accumulation on
+# one synthetic scene — the wall-clock delta prices the per-chunk
+# re-cluster overhead, and the per-variant obs gauges carry the headline
+# residency numbers (stream.max_plane_bytes vs the batch HBM high-water)
+# for the next ROADMAP re-anchor. One PROCESS per variant: the registry's
+# gauge_max values are process-cumulative, so a shared process would fold
+# the batch peak into the chunked row and hide the residency win.
+# MCT_QUICK halves the frame count.
+SA_FRAMES=64; [ -n "${MCT_QUICK:-}" ] && SA_FRAMES=32
+cat > "$OUT/stream_ab_variant.py" <<'PYEOF'
+import json, os, sys, tempfile, time
+out, frames, tag, chunk = (sys.argv[1], int(sys.argv[2]), sys.argv[3],
+                           int(sys.argv[4]))
+from maskclustering_tpu.config import load_config
+from maskclustering_tpu.run import cluster_scenes
+from maskclustering_tpu import obs
+from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
+root = os.path.join(out, "stream_ab_data")
+scene_dir = os.path.join(root, "scannet", "processed", "scene0000_00")
+if not os.path.isdir(scene_dir):
+    write_scannet_layout(make_scene(num_boxes=6, num_frames=frames,
+                                    image_hw=(120, 160), seed=7,
+                                    spacing=0.04), root, "scene0000_00")
+cfg = load_config("scannet").replace(
+    data_root=root, config_name=f"ab_{tag}", step=1,
+    distance_threshold=0.05, frame_pad_multiple=8, streaming_chunk=chunk)
+t0 = time.perf_counter()
+sts = cluster_scenes(cfg, ["scene0000_00"], resume=False)
+wall = time.perf_counter() - t0
+g = obs.registry().snapshot()["gauges"]
+row = {"variant": tag, "streaming_chunk": chunk, "wall_s": round(wall, 3),
+       "status": [s.status for s in sts],
+       "stream_max_plane_bytes": g.get("stream.max_plane_bytes"),
+       "hbm_high_water": g.get("hbm.high_water_bytes")}
+with open(os.path.join(out, f"STREAM_AB_{tag}.json"), "w") as f:
+    json.dump(row, f, indent=2)
+print(json.dumps(row))
+PYEOF
+run stream_ab_batch  900 python "$OUT/stream_ab_variant.py" "$OUT" "$SA_FRAMES" batch 0
+run stream_ab_chunk8 900 python "$OUT/stream_ab_variant.py" "$OUT" "$SA_FRAMES" chunk8 8
 run northstar     1200 python scripts/northstar.py --out "$OUT/NORTHSTAR_live.md" ${PLAT[@]+"${PLAT[@]}"} ${NS_QUICK[@]+"${NS_QUICK[@]}"}
 if [ -z "${MCT_NO_OBS:-}" ] && [ -f "$OUT/bench_default_events.jsonl" ]; then
   if [ -f "$OUT/bench_int8_events.jsonl" ]; then

@@ -69,16 +69,26 @@
 #    pipeline.host_sync present in the parent's cumulative snapshot) —
 #    an isolated worker with a dark relay fails here.
 #
+# 3f. runs the streaming smoke (distinct exit code 9): a 2-scene CPU run
+#    at chunk 8 through the chunked streaming accumulator
+#    (scripts/stream_smoke.py) — asserts the convergence digest
+#    (chunk>=F artifacts byte-identical to batch, multi-chunk instance
+#    count matches), ZERO post-warm compiles across chunks 2..K under a
+#    frozen retrace sanitizer, and the per-chunk residency cap
+#    (stream.max_plane_bytes strictly under the full-scene plane set) —
+#    the live-scan contract, end to end (MCT_STREAM_SMOKE=0 skips).
+#    FATAL. The full acceptance matrix lives in tests/test_streaming.py.
+#
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
 # Exits non-zero on test failures (1), a fault-matrix failure (3), an
 # mct-check finding or ruff violation (4), a concurrency-family finding
 # (5), a retrace-family finding (6), a serve-smoke failure (7), a
-# crash-respawn smoke failure (8), or a perf regression (2), so it gates
-# correctness, fault tolerance, the invariants, thread safety, the
-# compile surface, the serving layer, crash containment AND the
-# trajectory.
+# crash-respawn smoke failure (8), a streaming-smoke failure (9), or a
+# perf regression (2), so it gates correctness, fault tolerance, the
+# invariants, thread safety, the compile surface, the serving layer,
+# crash containment, the streaming contract AND the trajectory.
 # Every gate still RUNS after a failure, but the exit code is the FIRST
 # failing gate's — triage by exit code points at the right gate.
 set -u -o pipefail
@@ -191,6 +201,17 @@ if [ "${MCT_SERVE_CRASH_SMOKE:-1}" != "0" ]; then
              "the request was not requeued, or the respawned worker" \
              "recompiled)" >&2
         fail 8
+    fi
+fi
+
+if [ "${MCT_STREAM_SMOKE:-1}" != "0" ]; then
+    echo "== ci: streaming smoke (2-scene chunked run, convergence + zero post-warm compiles, <240s) =="
+    # the live-scan gate: chunk>=F byte identity, multi-chunk convergence,
+    # frozen-sanitizer zero compiles across chunks 2..K, residency cap
+    if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/stream_smoke.py; then
+        echo "ci: streaming smoke FAILED (streaming diverged from batch," \
+             "a post-warm chunk compiled, or the residency cap broke)" >&2
+        fail 9
     fi
 fi
 

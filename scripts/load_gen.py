@@ -350,6 +350,9 @@ def run_smoke(args) -> int:
             # serve rows carry the shard-count coordinate so --regress
             # attributes a resharded daemon's latency delta to the knob
             verdict["point_shards"] = int(digest["point_shards"])
+        if digest.get("streaming_chunk") is not None:
+            # same move for the chunked-accumulation knob (ISSUE 15)
+            verdict["streaming_chunk"] = int(digest["streaming_chunk"])
         retrace = digest.get("retrace") or {}
         verdict["retrace_compiles"] = retrace.get("compiles")
         verdict["retrace_repeats"] = retrace.get("repeats")

@@ -1,0 +1,148 @@
+"""Streaming smoke: the chunked-accumulation contract on a 2-scene CPU run.
+
+CI's drill of the streaming layer (scripts/ci.sh, budgeted < 180 s),
+exercising the three acceptance claims deterministically at chunk 8:
+
+1. **convergence digest** — a scene one chunk covers entirely produces
+   BYTE-IDENTICAL artifacts to the batch path, and a 3-chunk scene's
+   final instances match the batch object count (the AP-equivalence
+   proxy the tier-1 suite pins in full);
+2. **zero post-warm compiles across chunks 2..K** — the retrace
+   sanitizer freezes after chunk 1 of a fresh stream; chunks 2..K must
+   book no post-freeze compile violations (a chunk is just another
+   bucket coordinate, so the steady state dispatches warm);
+3. **capped residency** — ``stream.max_plane_bytes`` stays strictly
+   under the full-scene claim-plane set.
+
+Exit 0 = every expectation held; any assertion prints and exits 1.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# the image preloads the TPU plugin via sitecustomize: the env var is too
+# late, the config flag is not (same dance as tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
+from maskclustering_tpu import obs  # noqa: E402
+from maskclustering_tpu.analysis import retrace_sanitizer  # noqa: E402
+from maskclustering_tpu.config import load_config  # noqa: E402
+from maskclustering_tpu.run import cluster_scenes  # noqa: E402
+from maskclustering_tpu.utils.compile_cache import scene_pads  # noqa: E402
+from maskclustering_tpu.utils.synthetic import (make_scene,  # noqa: E402
+                                                to_scene_tensors,
+                                                write_scannet_layout)
+
+SCENE_ONE = "scene0000_00"  # 8 frames: one chunk covers it (byte identity)
+SCENE_MULTI = "scene0001_00"  # 24 frames: 3 chunks at chunk 8
+CHUNK = 8
+
+
+def _cfg(root, name, **kw):
+    return load_config("scannet").replace(
+        data_root=root, config_name=name, step=1, distance_threshold=0.05,
+        mask_pad_multiple=32, frame_pad_multiple=4, point_chunk=2048,
+        retry_backoff_s=0.01, **kw)
+
+
+def _artifact(root, name, scene):
+    return os.path.join(root, "prediction", name + "_class_agnostic",
+                        f"{scene}.npz")
+
+
+def main() -> int:
+    root = tempfile.mkdtemp(prefix="mct_stream_smoke_")
+    failures = []
+
+    def check(ok, msg):
+        print(("ok   " if ok else "FAIL ") + msg)
+        if not ok:
+            failures.append(msg)
+
+    write_scannet_layout(
+        make_scene(num_boxes=3, num_frames=8, image_hw=(48, 64), seed=7,
+                   spacing=0.05), root, SCENE_ONE)
+    scene_b = make_scene(num_boxes=3, num_frames=24, image_hw=(48, 64),
+                         seed=11, spacing=0.05)
+    write_scannet_layout(scene_b, root, SCENE_MULTI)
+    scenes = [SCENE_ONE, SCENE_MULTI]
+
+    # batch reference
+    batch = cluster_scenes(_cfg(root, "smoke_batch"), scenes, resume=False)
+    check(all(s.status == "ok" for s in batch),
+          f"batch run ok ({[s.status for s in batch]})")
+    batch_objects = {s.seq_name: s.num_objects for s in batch}
+
+    # streaming run at chunk 8 (sanitizer armed for the whole drill)
+    retrace_sanitizer.arm(True)
+    retrace_sanitizer.install()
+    stream = cluster_scenes(_cfg(root, "smoke_stream", streaming_chunk=CHUNK),
+                            scenes, resume=False)
+    check(all(s.status == "ok" for s in stream),
+          f"streaming run ok ({[s.status for s in stream]})")
+    stream_objects = {s.seq_name: s.num_objects for s in stream}
+
+    # 1a. single-chunk convergence: byte-identical artifacts
+    with open(_artifact(root, "smoke_batch", SCENE_ONE), "rb") as f:
+        a = f.read()
+    with open(_artifact(root, "smoke_stream", SCENE_ONE), "rb") as f:
+        b = f.read()
+    check(a == b, f"chunk>=F artifacts byte-identical ({len(a)} bytes)")
+    # 1b. multi-chunk convergence digest: same instance count as batch
+    check(stream_objects[SCENE_MULTI] == batch_objects[SCENE_MULTI],
+          f"multi-chunk instance count {stream_objects[SCENE_MULTI]} == "
+          f"batch {batch_objects[SCENE_MULTI]}")
+
+    # 2. zero post-warm compiles across chunks 2..K: fresh stream, freeze
+    # after chunk 1 (which compiles the stream's programs), then the
+    # remaining chunks must dispatch entirely warm
+    from maskclustering_tpu.models.pipeline import bucket_k_max
+    from maskclustering_tpu.models.streaming import (StreamAccumulator,
+                                                     slice_scene_frames)
+    from maskclustering_tpu.utils.compile_cache import max_seg_id
+
+    cfg = _cfg(root, "smoke_freeze", streaming_chunk=CHUNK)
+    tensors = to_scene_tensors(scene_b)
+    acc = StreamAccumulator(
+        cfg, total_frames=tensors.num_frames,
+        num_points=tensors.num_points,
+        k_max=bucket_k_max(max_seg_id(tensors.segmentations)),
+        seq_name="freeze-drill")
+    acc.push_chunk(slice_scene_frames(tensors, 0, CHUNK))
+    retrace_sanitizer.freeze()
+    for ci in range(1, acc.n_chunks):
+        acc.push_chunk(slice_scene_frames(
+            tensors, ci * CHUNK, min((ci + 1) * CHUNK, tensors.num_frames)))
+    digest = retrace_sanitizer.digest()
+    post_freeze = [v for v in digest["violations"]
+                   if v["kind"] == "post_freeze"]
+    repeats = [v for v in digest["violations"] if v["kind"] == "repeat"]
+    check(not post_freeze,
+          f"zero post-warm compiles across chunks 2..{acc.n_chunks} "
+          f"(violations: {post_freeze or 'none'})")
+    check(not repeats, f"zero repeat compiles (violations: "
+                       f"{repeats or 'none'})")
+    retrace_sanitizer.thaw()
+
+    # 3. residency: the largest chunk-plane materialization stays strictly
+    # under the full-scene plane set the batch path keeps resident
+    mx = obs.registry().snapshot()["gauges"].get("stream.max_plane_bytes")
+    f_full, n_pad = scene_pads(cfg, tensors.num_frames, tensors.num_points)
+    full_set = f_full * n_pad * (4 + 2 + 2 + 1) + n_pad
+    check(mx is not None and mx < full_set,
+          f"stream.max_plane_bytes {mx} < full-scene plane set {full_set}")
+
+    print(f"stream_smoke: {'PASS' if not failures else 'FAIL'} "
+          f"({len(failures)} failure(s))")
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
