@@ -179,11 +179,22 @@ def serve_row(verdict: Dict, **extra) -> Dict:
               "retrace_compiles", "retrace_repeats", "retrace_post_freeze",
               "retrace_cache_hits", "aot_restored", "worker_crashes",
               "worker_respawns", "telemetry_windows", "window_p95",
-              "error"):
+              "tenants", "error"):
         if verdict.get(k) is not None:
             row[k] = verdict[k]
     row.update(extra)
     return row
+
+
+def tenant_dimension(row: Optional[Dict]) -> bool:
+    """True when a ledger row (or baseline) carries per-tenant sub-rows.
+
+    A serve row with a ``tenants`` dict measured a multi-tenant mix, so
+    its latency belongs to that mix: --regress fences the dimension BOTH
+    ways (obs/report.py), exactly like the tool fence above — a tenant
+    row never gates against an untenanted baseline, and vice versa.
+    """
+    return bool((row or {}).get("tenants"))
 
 
 def tier1_row(wall_s: float, passed: int, **extra) -> Dict:

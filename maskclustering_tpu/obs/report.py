@@ -447,7 +447,49 @@ def render_serving(run: "RunData") -> Optional[str]:
     tele = render_telemetry_windows(run.telemetry_rows)
     if tele:
         lines.append(tele)
+    tenants = render_tenants(run.telemetry_rows)
+    if tenants:
+        lines.extend(tenants)
     return "\n".join(lines)
+
+
+def render_tenants(rows: List[Dict]) -> List[str]:
+    """Per-tenant accounting digest summed over the telemetry windows
+    (empty list when no window carried the tenant dimension)."""
+    agg: Dict[str, Dict] = {}
+    for r in rows or ():
+        for name, t in (r.get("tenants") or {}).items():
+            a = agg.setdefault(name, {"requests": 0, "rejects": 0,
+                                      "crashes": 0, "device_s": 0.0,
+                                      "d2h_bytes": 0})
+            a["requests"] += int(t.get("requests", 0) or 0)
+            a["rejects"] += int(t.get("rejects", 0) or 0)
+            a["crashes"] += int(t.get("crashes", 0) or 0)
+            a["device_s"] += float(t.get("device_s", 0.0) or 0.0)
+            a["d2h_bytes"] += int(t.get("d2h_bytes", 0) or 0)
+    if not agg:
+        return []
+    out = ["tenants:"]
+    for name in sorted(agg):
+        a = agg[name]
+        out.append(f"  {name:<16} requests {a['requests']} | "
+                   f"rejects {a['rejects']} | crashes {a['crashes']} | "
+                   f"device {a['device_s']:.3f}s | d2h {a['d2h_bytes']}B")
+    return out
+
+
+def render_slo(run: "RunData", spec_path: Optional[str] = None) \
+        -> Optional[str]:
+    """The SLO section: the spec's burn-rate verdict over the telemetry
+    window rows the events file carries (None without any serve/telemetry
+    evidence — batch reports are unchanged)."""
+    if not run.telemetry_rows:
+        return None
+    from maskclustering_tpu.obs import slo as slo_mod
+
+    spec = slo_mod.load_spec(spec_path)
+    result = slo_mod.evaluate(spec, {"windows": run.telemetry_rows})
+    return "\n".join(["== SLO =="] + slo_mod.render_result(result))
 
 
 def render_telemetry_windows(rows: List[Dict]) -> Optional[str]:
@@ -556,7 +598,7 @@ def render_streaming(run: "RunData") -> Optional[str]:
     return "\n".join(lines)
 
 
-def render_report(run: RunData) -> str:
+def render_report(run: RunData, slo_spec: Optional[str] = None) -> str:
     rows = [[r["stage"], str(r["count"]), _fmt_s(r["p50_s"]), _fmt_s(r["p95_s"]),
              _fmt_s(r["device_p50_s"]), _fmt_s(r["host_p50_s"]),
              _fmt_s(r["total_s"]), _fmt_bytes(r["h2d_bytes"]),
@@ -596,6 +638,9 @@ def render_report(run: RunData) -> str:
     serving_sec = render_serving(run)
     if serving_sec:
         out.append(serving_sec)
+    slo_sec = render_slo(run, slo_spec)
+    if slo_sec:
+        out.append(slo_sec)
     streaming_sec = render_streaming(run)
     if streaming_sec:
         out.append(streaming_sec)
@@ -819,6 +864,13 @@ def _regress_eval(ledger_path: str, baseline_path: str,
     if stats.skipped:
         lines.append(f"WARNING: ledger skipped {stats.describe()}")
     baseline = led.load_baseline(baseline_path)
+    # tenant-dimension fence, both ways (same shape as the tool fence
+    # below): a serve row carrying per-tenant sub-rows measured a
+    # multi-tenant mix — its latency is the mix's, so it only gates
+    # against a baseline that carried the dimension too, and an
+    # untenanted baseline never gates a tenant-dimension row
+    tenancy = led.tenant_dimension(baseline or {})
+    rows = [r for r in rows if led.tenant_dimension(r) == tenancy]
     # gate comparable rows: a run-row median must not be compared against a
     # bench baseline just because it is the newest numeric row
     current = None
@@ -885,6 +937,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--regress-threshold", type=float, default=None,
                    help="relative p50 slowdown that fails the gate "
                         "(default 0.15)")
+    p.add_argument("--slo-spec", default=None, metavar="SPEC",
+                   help="SLO spec JSON for the report's SLO section "
+                        "(default: the canned serve-default; the section "
+                        "renders only when the events file carries "
+                        "telemetry windows)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
@@ -901,7 +958,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.json:
             json_doc["summary"] = run.summary()
         else:
-            sections.append(render_report(run))
+            sections.append(render_report(run, slo_spec=args.slo_spec))
             if args.diff:
                 sections.append(render_diff(run, RunData(args.diff)))
         if args.cost:

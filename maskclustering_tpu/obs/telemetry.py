@@ -218,6 +218,57 @@ def fold_telem(doc: Dict, *, child_pid: Optional[int] = None) -> None:
 _WINDOW_STATUSES = ("ok", "failed", "deadline", "skipped", "interrupted")
 _SAMPLE_CAP = 512  # per-window raw latency/wait samples before drop-count
 
+# tenant accounting bounds: identities are client-supplied (validated,
+# length-capped by the protocol), so the aggregator additionally caps how
+# many DISTINCT tenants it tracks per process — overflow lumps into one
+# bucket instead of growing every window row without bound
+_TENANT_CAP = 32
+_TENANT_OVERFLOW = "(other)"
+_TENANT_SAMPLE_CAP = 256  # per-tenant per-window raw samples
+
+
+def _attrib_counters() -> Dict[str, float]:
+    """The two attribution totals (fenced device seconds, d2h bytes) —
+    read OUTSIDE the window lock (registry has its own lock). Both are
+    plain counters, so the cross-process relay's delta fold keeps them
+    topology-invariant: a child-booked d2h byte reads like a local one."""
+    c = _metrics.registry().snapshot(include_histograms=False).get(
+        "counters") or {}
+    return {"device_s": float(c.get("device.seconds", 0.0)),
+            "d2h_bytes": float(c.get("d2h.bytes", 0.0))}
+
+
+def _new_tenant_slot() -> Dict:
+    return {"requests": 0, "by_status": {}, "rejects": 0, "crashes": 0,
+            "device_s": 0.0, "d2h_bytes": 0.0, "latency": {},
+            "queue_wait": []}
+
+
+def _tenant_rows(store: Dict[str, Dict]) -> Dict[str, Dict]:
+    """JSON-able per-tenant sub-rows (samples summarized, zeros elided)."""
+    out: Dict[str, Dict] = {}
+    for t, s in sorted(store.items()):
+        row: Dict[str, Any] = {"requests": int(s["requests"])}
+        if s["by_status"]:
+            row["by_status"] = dict(s["by_status"])
+        if s["rejects"]:
+            row["rejects"] = int(s["rejects"])
+        if s["crashes"]:
+            row["crashes"] = int(s["crashes"])
+        if s["device_s"]:
+            row["device_s"] = round(s["device_s"], 4)
+        if s["d2h_bytes"]:
+            row["d2h_bytes"] = int(s["d2h_bytes"])
+        lat = {k: _hist_summary(v) for k, v in sorted(s["latency"].items())}
+        lat = {k: v for k, v in lat.items() if v}
+        if lat:
+            row["latency"] = lat
+        qw = _hist_summary(s["queue_wait"])
+        if qw:
+            row["queue_wait"] = qw
+        out[t] = row
+    return out
+
 
 def _hist_summary(vals: List[float]) -> Optional[Dict]:
     if not vals:
@@ -252,6 +303,19 @@ class WindowAggregator:
         self._prev_counters: Dict[str, float] = {}
         self._prev_post_freeze = 0.0
         self._cum_hist: Dict[str, Histogram] = {}
+        # tenant accounting: current-window slots, monotone cumulative
+        # slots, and one capped histogram per tenant (all bounded by
+        # _TENANT_CAP; overflow lumps into _TENANT_OVERFLOW)
+        self._tenants: Dict[str, Dict] = {}
+        self._cum_tenants: Dict[str, Dict] = {}
+        self._cum_tenant_hist: Dict[str, Histogram] = {}
+        # the device-seconds / d2h attribution baseline: the counter
+        # totals at the PREVIOUS request completion — one worker
+        # serializes requests, so the delta between consecutive
+        # completions is the finishing request's consumption (under the
+        # isolated worker the relay's flush-before-result ordering folds
+        # the child's counters before the result books here)
+        self._prev_attrib = _attrib_counters()
         self.started_at = time.time()
 
     def rebase(self) -> None:
@@ -264,25 +328,69 @@ class WindowAggregator:
         """
         snap = _metrics.registry().snapshot(include_histograms=False)
         post_freeze = self._post_freeze_cum(snap.get("gauges") or {})
+        attrib = _attrib_counters()
         with self._lock:  # like roll(): no other lock acquired inside
             self._prev_counters = dict(snap.get("counters") or {})
             self._prev_post_freeze = post_freeze
+            self._prev_attrib = attrib  # warm-up device time charges no one
             self._t0 = time.time()
             self._latency = {}
             self._waits = []
+            self._tenants = {}
 
     # -- recorders (worker / supervisor threads) ----------------------------
 
-    def record_request(self, bucket, latency_s: float) -> None:
+    def _tenant_slot(self, store: Dict[str, Dict], tenant: str) -> Dict:
+        """The tenant's accumulation slot (capped; overflow shared). Caller
+        holds the window lock."""
+        key = tenant if (tenant in store or len(store) < _TENANT_CAP) \
+            else _TENANT_OVERFLOW
+        slot = store.get(key)
+        if slot is None:
+            slot = store[key] = _new_tenant_slot()
+        return slot
+
+    def record_request(self, bucket, latency_s: float, *,
+                       tenant: str = "", status: str = "ok") -> None:
         """Book one finished request's latency under its shape bucket.
 
         The cumulative stride-decimated histogram observes EVERY sample
         (it exists precisely to absorb unbounded load); only the current
         window's raw list is capped, and independently of the queue-wait
         list — a wait burst must not starve the latency view.
+
+        ``tenant`` attributes the request (count, status, latency sample,
+        and the device-seconds / d2h-bytes consumed since the previous
+        completion) to its accounting identity; "" books globally only.
         """
         key = _bucket_key(bucket)
+        attrib = _attrib_counters()  # registry lock BEFORE the window lock
         with self._lock:
+            dev_delta = max(attrib["device_s"]
+                            - self._prev_attrib["device_s"], 0.0)
+            d2h_delta = max(attrib["d2h_bytes"]
+                            - self._prev_attrib["d2h_bytes"], 0.0)
+            # every completion advances the baseline — an untenanted
+            # request's consumption is attributed to no one, not to the
+            # NEXT tenanted request
+            self._prev_attrib = attrib
+            if tenant:
+                for store in (self._tenants, self._cum_tenants):
+                    slot = self._tenant_slot(store, tenant)
+                    slot["requests"] += 1
+                    slot["by_status"][status] = \
+                        slot["by_status"].get(status, 0) + 1
+                    slot["device_s"] += dev_delta
+                    slot["d2h_bytes"] += d2h_delta
+                wslot = self._tenant_slot(self._tenants, tenant)
+                samples = wslot["latency"].setdefault(key, [])
+                if len(samples) < _TENANT_SAMPLE_CAP:
+                    samples.append(float(latency_s))
+                th = self._cum_tenant_hist.get(tenant)
+                if th is None and len(self._cum_tenant_hist) < _TENANT_CAP:
+                    th = self._cum_tenant_hist.setdefault(tenant, Histogram())
+                if th is not None:
+                    th.observe(float(latency_s))
             h = self._cum_hist.get(key)
             if h is None:
                 h = self._cum_hist.setdefault(key, Histogram())
@@ -292,12 +400,35 @@ class WindowAggregator:
                 return
             self._latency.setdefault(key, []).append(float(latency_s))
 
-    def record_queue_wait(self, wait_s: float) -> None:
+    def record_queue_wait(self, wait_s: float, *, tenant: str = "") -> None:
         with self._lock:
+            if tenant:
+                samples = self._tenant_slot(self._tenants,
+                                            tenant)["queue_wait"]
+                if len(samples) < _TENANT_SAMPLE_CAP:
+                    samples.append(float(wait_s))
             if len(self._waits) >= _SAMPLE_CAP:
                 self._dropped += 1
                 return
             self._waits.append(float(wait_s))
+
+    def record_reject(self, tenant: str) -> None:
+        """Attribute one admission/deadline reject to its tenant (global
+        reject counts stay counter-delta driven at roll time)."""
+        if not tenant:
+            return
+        with self._lock:
+            for store in (self._tenants, self._cum_tenants):
+                self._tenant_slot(store, tenant)["rejects"] += 1
+
+    def record_crash(self, tenant: str) -> None:
+        """Attribute one worker crash to the tenant whose request it was
+        executing (supervisor._on_crash)."""
+        if not tenant:
+            return
+        with self._lock:
+            for store in (self._tenants, self._cum_tenants):
+                self._tenant_slot(store, tenant)["crashes"] += 1
 
     # -- the tick -----------------------------------------------------------
 
@@ -362,6 +493,9 @@ class WindowAggregator:
                 row["queue_wait"] = waits
             if dropped:
                 row["samples_dropped"] = dropped
+            if self._tenants:
+                row["tenants"] = _tenant_rows(self._tenants)
+                self._tenants = {}
             self._windows.append(row)
             self._t0 = now
         return row
@@ -401,13 +535,22 @@ class WindowAggregator:
                             for k, v in sorted(self._latency.items()) if v},
                 "queue_wait": _hist_summary(self._waits),
             }
+            if self._tenants:
+                current["tenants"] = _tenant_rows(self._tenants)
             cum_latency = {k: h.summary()
                            for k, h in sorted(self._cum_hist.items())}
+            cum_tenants = _tenant_rows(self._cum_tenants)
+            for t, h in sorted(self._cum_tenant_hist.items()):
+                if t in cum_tenants:
+                    cum_tenants[t]["latency"] = {"all": h.summary()}
+        cumulative: Dict[str, Any] = {"counters": counters, "gauges": gauges,
+                                      "latency": cum_latency}
+        if cum_tenants:
+            cumulative["tenants"] = cum_tenants
         return {"v": TELEM_SCHEMA, "window_s": self.window_s,
                 "started_at": self.started_at,
                 "windows": windows, "current": current,
-                "cumulative": {"counters": counters, "gauges": gauges,
-                               "latency": cum_latency}}
+                "cumulative": cumulative}
 
 
 class TelemetryTicker:
@@ -471,14 +614,19 @@ def installed() -> Optional[WindowAggregator]:
         return _AGGREGATOR
 
 
-def record_request(bucket, latency_s: float) -> None:
+def record_request(bucket, latency_s: float, *, tenant: str = "",
+                   status: str = "ok") -> None:
     """Book one finished request into the current window (no-op without an
     installed aggregator — i.e. outside a daemon parent process). Window
     status attribution comes from the serve.requests_* counter deltas at
-    roll time, not from this call."""
+    roll time, not from this call; the per-TENANT sub-windows, which
+    cannot be split out of relayed counters, come from ``tenant``/
+    ``status`` here — both call sites (worker._finish_request in-process,
+    supervisor._book_result/_serve_one isolated) are parent-side, which
+    is what keeps tenant windows topology-invariant."""
     agg = installed()
     if agg is not None:
-        agg.record_request(bucket, latency_s)
+        agg.record_request(bucket, latency_s, tenant=tenant, status=status)
 
 
 def record_queue_wait(req, wait_s: float) -> None:
@@ -488,9 +636,23 @@ def record_queue_wait(req, wait_s: float) -> None:
     agg = installed()
     if agg is None:
         return
-    agg.record_queue_wait(wait_s)
+    agg.record_queue_wait(wait_s, tenant=getattr(req, "tenant", ""))
     from maskclustering_tpu import obs
 
     obs.observe("serve.queue_wait_s", float(wait_s))
     obs.record_span("serve.queue_wait", float(wait_s), request=req.id,
                     scene=req.scene, end_ts=time.time())
+
+
+def record_reject(tenant: str) -> None:
+    """Attribute one reject to its tenant (no-op untenanted / undaemoned)."""
+    agg = installed()
+    if agg is not None:
+        agg.record_reject(tenant)
+
+
+def record_crash(tenant: str) -> None:
+    """Attribute one worker crash to its tenant (supervisor._on_crash)."""
+    agg = installed()
+    if agg is not None:
+        agg.record_crash(tenant)

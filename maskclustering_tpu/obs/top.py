@@ -9,7 +9,9 @@ renders a refreshing view: request latency p50/p95 by shape bucket
 (window + cumulative), a queue-depth sparkline over the window ring,
 reject/crash/respawn rates, worker liveness (heartbeat age, consecutive
 respawns, in-flight crash count — the wedge-is-coming signals), AOT-cache
-hits and post-warm compile violations (the serve-many contract, live).
+hits and post-warm compile violations (the serve-many contract, live),
+per-tenant accounting rows and the armed SLO spec's burn-rate panel
+(the poll asks for ``detail=slo``, which is telemetry + the verdict).
 
 Rendering is a pure function over the stats document (``render_top``) so
 the dashboard is testable without a TTY; the CLI loop only clears the
@@ -153,6 +155,29 @@ def render_top(stats: Dict, *, now: Optional[float] = None) -> str:
             + (f" | {int(counters.get('worker.telem_spans_dropped', 0))} "
                f"dropped" if counters.get("worker.telem_spans_dropped")
                else ""))
+
+    # per-tenant accounting (cumulative since rebase; windows carry the
+    # same sub-rows): who is spending the device
+    cum_tenants = cum.get("tenants") or {}
+    if cum_tenants:
+        lines.append("tenants:")
+        for name in sorted(cum_tenants):
+            t = cum_tenants[name] or {}
+            lat = (t.get("latency") or {}).get("all") or {}
+            lines.append(
+                f"  {name:<16} req {int(t.get('requests', 0))} "
+                f"| rejects {int(t.get('rejects', 0))} "
+                f"| crashes {int(t.get('crashes', 0))} "
+                f"| p95 {_fmt(lat.get('p95'))} "
+                f"| device {float(t.get('device_s', 0.0)):.3f}s "
+                f"| d2h {int(t.get('d2h_bytes', 0))}B")
+
+    # the SLO burn-rate panel (status detail=slo answers only)
+    slo = stats.get("slo")
+    if slo is not None:
+        from maskclustering_tpu.obs.slo import render_result
+
+        lines.extend(render_result(slo))
     return "\n".join(lines)
 
 
@@ -160,7 +185,8 @@ def _poll(address, timeout_s: float) -> Dict:
     from maskclustering_tpu.serve.client import ServeClient
 
     with ServeClient(address, timeout_s=timeout_s) as client:
-        return client.telemetry()
+        # detail=slo is telemetry plus the armed spec's burn-rate verdict
+        return client.slo()
 
 
 def main(argv=None) -> int:

@@ -18,7 +18,11 @@ ordered timeline with per-segment durations:
   crash) — a crash->requeue->respawn request reads as
   wait -> attempt -> CRASH -> wait -> attempt -> result;
 - per-request RunJournal rows (``--journal DIR`` -> ``DIR/<id>.jsonl``):
-  attempt starts, ``interrupted`` crash stamps, and the final outcome.
+  attempt starts, ``interrupted`` crash stamps, and the final outcome;
+- ``--blackbox DUMP`` merges a flight-recorder postmortem
+  (obs/flight.py): span rows dedup against the live events, everything
+  else becomes zero-width black-box marks — the child-side spans a
+  SIGKILL'd worker never relayed appear in their true place.
 
 Relayed spans anchor on the worker's own close timestamp (the ``end_ts``
 attr the relay preserves), not the parent's re-emit time, so child and
@@ -53,12 +57,19 @@ def _span_window(ev: Dict) -> tuple:
 
 
 def assemble_trace(request_id: str, events_path: str,
-                   journal_dir: Optional[str] = None) -> Dict:
+                   journal_dir: Optional[str] = None,
+                   blackbox: Optional[str] = None) -> Dict:
     """All known segments of one request, time-ordered.
 
     Returns ``{"request": id, "segments": [...], "warnings": [...]}``;
     each segment: ``{"t0", "t1", "dur_s", "kind", "label", "detail",
     "children": [...]}`` (children only on execution windows).
+
+    ``blackbox`` (a flight-recorder dump, or a directory of them —
+    obs/flight.py) merges the postmortem ring into the same timeline:
+    the victim's final child-side spans the live relay never shipped,
+    plus admission/crash marks, so a crash->requeue->respawn request
+    reads end to end even when the worker died mid-flight.
     """
     stats = ReadStats()
     skeleton: List[Dict] = []
@@ -74,6 +85,11 @@ def assemble_trace(request_id: str, events_path: str,
     warnings: List[str] = []
     if stats.skipped:
         warnings.append(f"events reader skipped {stats.describe()}")
+
+    marks: List[Dict] = []
+    if blackbox:
+        _merge_blackbox(blackbox, request_id, skeleton, others, marks,
+                        warnings)
 
     segments: List[Dict] = []
     for ev in skeleton:
@@ -97,6 +113,7 @@ def assemble_trace(request_id: str, events_path: str,
 
     for row in _journal_rows(request_id, journal_dir, warnings):
         segments.append(row)
+    segments.extend(marks)
 
     segments.sort(key=lambda s: (s["t0"], s["t1"]))
     if not segments:
@@ -105,6 +122,70 @@ def assemble_trace(request_id: str, events_path: str,
                         f"was not obs-armed")
     return {"request": request_id, "segments": segments,
             "warnings": warnings}
+
+
+def _merge_blackbox(blackbox: str, request_id: str, skeleton: List[Dict],
+                    others: List[Dict], marks: List[Dict],
+                    warnings: List[str]) -> None:
+    """Fold a flight dump's rows into the live pools (span rows, deduped
+    against anything the events file already holds) plus zero-width
+    black-box marks (admission decisions, request lifecycle, crash and
+    fault bookkeeping that mention this request)."""
+    from maskclustering_tpu.obs import flight as _flight
+
+    path = _flight.resolve_dump(blackbox)
+    if path is None:
+        warnings.append(f"no flight dump at {blackbox}")
+        return
+    _meta, rows = _flight.read_dump(path)
+    seen = set()
+    for ev in skeleton + others:
+        s0, s1 = _span_window(ev)
+        seen.add((ev.get("name"), round(s1, 3),
+                  round(float(ev.get("dur_s", 0.0)), 5)))
+    merged = 0
+    for ev in rows:
+        kind = ev.get("kind")
+        if kind == "span":
+            name = ev.get("name")
+            if not isinstance(name, str):
+                continue
+            s0, s1 = _span_window(ev)
+            key = (name, round(s1, 3),
+                   round(float(ev.get("dur_s", 0.0)), 5))
+            if key in seen:
+                continue
+            seen.add(key)
+            attrs = ev.get("attrs") or {}
+            if name in _SKELETON:
+                if attrs.get("request") == request_id:
+                    skeleton.append(ev)
+                    merged += 1
+            else:
+                others.append(ev)
+                merged += 1
+            continue
+        if ev.get("request") != request_id:
+            continue
+        ts = float(ev.get("ts", 0.0))
+        detail = " ".join(
+            f"{k}={v}" for k, v in ev.items()
+            if k not in ("kind", "ts", "seq", "v", "pid", "request"))
+        label = {
+            _flight.KIND_ADMIT: f"blackbox {ev.get('event', 'admission')}",
+            _flight.KIND_REQUEST: f"blackbox {ev.get('event', 'request')}"
+                                  f" (pid {ev.get('pid', '?')})",
+            _flight.KIND_CRASH: "blackbox WORKER CRASH",
+            _flight.KIND_FAULT: "blackbox fault",
+        }.get(kind)
+        if label is None:
+            continue
+        marks.append({"t0": ts, "t1": ts, "dur_s": 0.0, "kind": "blackbox",
+                      "label": label, "detail": detail[:140]})
+        merged += 1
+    if not merged:
+        warnings.append(f"flight dump {path} held no new events for "
+                        f"{request_id!r}")
 
 
 def _children(others: List[Dict], t0: float, t1: float,
@@ -197,12 +278,17 @@ def main(argv=None) -> int:
     p.add_argument("--journal", default=None, metavar="DIR",
                    help="per-request journal directory (the daemon's "
                         "--journal-dir)")
+    p.add_argument("--blackbox", default=None, metavar="DUMP",
+                   help="flight-recorder dump (file or directory; "
+                        "obs/flight.py) to merge into the timeline — "
+                        "crash postmortems included")
     p.add_argument("--json", action="store_true",
                    help="emit the machine-readable trace document")
     args = p.parse_args(argv)
     try:
         trace = assemble_trace(args.request_id, args.events,
-                               journal_dir=args.journal)
+                               journal_dir=args.journal,
+                               blackbox=args.blackbox)
     except OSError as e:
         print(f"obs.trace: cannot read {args.events}: {e}", file=sys.stderr)
         return 2

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from maskclustering_tpu.obs import flight as _flight
 from maskclustering_tpu.obs import metrics as _metrics
 from maskclustering_tpu.obs.events import KIND_SPAN, EventSink
 
@@ -218,10 +219,19 @@ class Tracer:
         return deco
 
     def _finish(self, span: Span) -> None:
+        # every finished span — real, timing-only or relay-armed — lands
+        # in the in-process flight ring (obs/flight.py): the black box is
+        # always on, costing one deque append, no IO
+        _flight.record_span(span.name, span.duration, span.sync_s,
+                            span.attrs)
         if self.aggregate:
             _metrics.observe(f"span.{span.name}.s", span.duration)
             if span.sync_s:
                 _metrics.observe(f"span.{span.name}.sync_s", span.sync_s)
+                # fenced device time as a COUNTER so the cross-process
+                # relay's delta fold carries it: the per-tenant
+                # device-seconds attribution reads this, topology-invariant
+                _metrics.count("device.seconds", span.sync_s)
         if self.sink is None:
             return
         mem = _metrics.sample_hbm() if self.sample_memory else None

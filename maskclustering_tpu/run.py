@@ -1553,9 +1553,17 @@ def main(argv=None) -> int:
     if report.interrupted or faults.stop_requested():
         # SIGTERM convention (128 + 15): the run stopped cleanly with a
         # valid partial report + journal; rerun with the same --report to
-        # resume from the journal
+        # resume from the journal. Armed runs ($MCT_FLIGHT_DIR) also drop
+        # the flight ring here — the cooperative-drain dump site, never
+        # the signal handler (CONC.SIGNAL)
+        from maskclustering_tpu.obs import flight
+        flight.dump("sigterm" if faults.stop_requested() else "interrupted")
         return 143
-    return 0 if report.ok else 1
+    if not report.ok:
+        from maskclustering_tpu.obs import flight
+        flight.dump("run_failed")
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

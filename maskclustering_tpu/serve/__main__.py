@@ -93,6 +93,15 @@ def main(argv=None) -> int:
                         help="obs span/metrics JSONL path (the Serving "
                              "report section renders from it; telemetry "
                              "window rows append here too)")
+    parser.add_argument("--flight-dir", default=None,
+                        help="arm the flight recorder's dump directory "
+                             "(obs/flight.py black box; also via "
+                             "$MCT_FLIGHT_DIR — the worker subprocess "
+                             "inherits it)")
+    parser.add_argument("--slo-spec", default=None,
+                        help="SLO spec JSON for the status op's detail=slo "
+                             "answer (obs/slo.py; default: the canned "
+                             "serve-default spec)")
     parser.add_argument("--telemetry-window", type=float, default=5.0,
                         help="telemetry aggregation window seconds "
                              "(obs/telemetry.py ring; the status op's "
@@ -200,6 +209,8 @@ def main(argv=None) -> int:
         isolate_worker=args.isolate_worker,
         fault_plan_spec=args.fault_plan,
         telemetry_window_s=args.telemetry_window,
+        slo_spec=args.slo_spec,
+        flight_dir=args.flight_dir,
     )
     daemon.start()
     if args.host is not None:

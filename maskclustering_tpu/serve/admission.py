@@ -20,7 +20,16 @@ import queue
 from typing import List, Optional
 
 from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.obs import flight as _flight
 from maskclustering_tpu.serve.protocol import SceneRequest
+
+
+def _flight_admit(event: str, req: SceneRequest, **fields) -> None:
+    """One queue-transition mark in the always-on flight ring — the
+    postmortem's admission history (obs/flight.py; never raises, no IO)."""
+    _flight.record(_flight.KIND_ADMIT, event=event, request=req.id,
+                   scene=req.scene, **{k: v for k, v in fields.items()
+                                       if v not in (None, "", 0)})
 
 
 class QueueFullReject(Exception):
@@ -74,8 +83,12 @@ class AdmissionQueue:
         except queue.Full:
             if self.metered:
                 _count("serve.admission.rejects.queue_full")
+                _flight_admit("reject_queue_full", req,
+                              depth=self._q.qsize(), tenant=req.tenant)
             raise QueueFullReject(self._q.qsize(), self.capacity) from None
         depth = self._q.qsize()
+        if self.metered:
+            _flight_admit("admit", req, depth=depth, tenant=req.tenant)
         with self._lock:
             self._admitted += 1
             if depth > self._high_water:
@@ -94,6 +107,7 @@ class AdmissionQueue:
             return None
         if self.metered:
             _gauge("serve.queue_depth", float(self._q.qsize()))
+            _flight_admit("dequeue", req, depth=self._q.qsize())
         return req
 
     def requeue(self, req: SceneRequest) -> bool:
@@ -107,6 +121,8 @@ class AdmissionQueue:
             return False
         if self.metered:
             _gauge("serve.queue_depth", float(self._q.qsize()))
+            _flight_admit("requeue", req, depth=self._q.qsize(),
+                          crashes=req.crashes)
         return True
 
     def drain(self) -> List[SceneRequest]:
@@ -119,6 +135,8 @@ class AdmissionQueue:
                 break
         if self.metered:
             _gauge("serve.queue_depth", 0.0)
+            for req in out:
+                _flight_admit("drain", req)
         return out
 
     def depth(self) -> int:

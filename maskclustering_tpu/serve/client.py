@@ -67,7 +67,7 @@ class ServeClient:
 
     def request_scene(self, scene: str, *, synthetic: Optional[Dict] = None,
                       deadline_s: float = 0.0, resume: bool = False,
-                      tag: str = "") -> Dict:
+                      tag: str = "", tenant: str = "") -> Dict:
         """Submit one scene request; returns the ack or reject event."""
         doc: Dict = {"op": "scene", "scene": scene}
         if synthetic is not None:
@@ -78,6 +78,8 @@ class ServeClient:
             doc["resume"] = True
         if tag:
             doc["tag"] = tag
+        if tenant:
+            doc["tenant"] = tenant
         self.send(doc)
         return self.recv_event()
 
@@ -108,7 +110,7 @@ class ServeClient:
 
     def stream_chunk(self, scene: str, *, chunk: int = 0,
                      synthetic: Optional[Dict] = None, deadline_s: float = 0.0,
-                     tag: str = "") -> Tuple[Dict, List[Dict]]:
+                     tag: str = "", tenant: str = "") -> Tuple[Dict, List[Dict]]:
         """Accumulate the scene's next frame chunk on the daemon.
 
         Returns ``(terminal event, status events)`` — the terminal result
@@ -125,6 +127,8 @@ class ServeClient:
             doc["deadline_s"] = deadline_s
         if tag:
             doc["tag"] = tag
+        if tenant:
+            doc["tenant"] = tenant
         self.send(doc)
         first = self.recv_event()
         if first.get("kind") == "reject":
@@ -186,6 +190,11 @@ class ServeClient:
         """The stats snapshot plus the windowed telemetry ring (the
         ``obs.top`` dashboard's poll)."""
         return self.stats(detail="telemetry")
+
+    def slo(self) -> Dict:
+        """Telemetry plus the armed SLO spec's burn-rate verdict
+        (obs/slo.py) under the ``slo`` key."""
+        return self.stats(detail="slo")
 
     def shutdown(self) -> Dict:
         self.send({"op": "shutdown"})

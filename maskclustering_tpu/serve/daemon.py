@@ -44,6 +44,8 @@ from typing import Dict, List, Optional, Tuple
 
 from maskclustering_tpu import obs
 from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.obs import flight as _flight
+from maskclustering_tpu.obs import slo as _slo
 from maskclustering_tpu.obs import telemetry
 from maskclustering_tpu.serve import protocol
 from maskclustering_tpu.serve.admission import AdmissionQueue, QueueFullReject
@@ -94,7 +96,9 @@ class ServeDaemon:
                  default_deadline_s: float = 0.0,
                  isolate_worker: bool = False,
                  fault_plan_spec: Optional[str] = None,
-                 telemetry_window_s: float = 5.0):
+                 telemetry_window_s: float = 5.0,
+                 slo_spec: Optional[str] = None,
+                 flight_dir: Optional[str] = None):
         if socket_path is None and host is None:
             raise ValueError("need a socket_path (AF_UNIX) or host/port (TCP)")
         self.cfg = cfg
@@ -146,6 +150,15 @@ class ServeDaemon:
         self.aggregator = telemetry.WindowAggregator(
             window_s=telemetry_window_s)
         self._ticker = telemetry.TelemetryTicker(self.aggregator)
+        # the SLO plane (obs/slo.py): a bad spec must fail daemon startup
+        # loudly, not surface as a broken `status` answer hours later
+        self.slo_spec = _slo.load_spec(slo_spec)
+        if flight_dir:
+            # arm this process AND (via env) any worker subprocess it
+            # spawns — the child's flight ring needs somewhere to dump too
+            _flight.arm(flight_dir)
+            os.environ[_flight.ENV_DIR] = flight_dir
+        self._capacity_dumped = threading.Event()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -304,6 +317,12 @@ class ServeDaemon:
             handlers = list(self._handlers)
         for t in handlers:
             t.join(2.0)
+        # cooperative-drain black box (the SIGTERM handler itself is
+        # flag-only — CONC.SIGNAL): armed runs keep the daemon's final
+        # admission/span history next to any worker-crash dumps
+        _flight.record(_flight.KIND_SIGNAL, what="daemon_drained",
+                       clean=drained_clean)
+        _flight.dump("sigterm" if faults.stop_requested() else "shutdown")
         log.info("mct-serve: shutdown complete (%s)", self.stats()["counts"])
 
     # -- socket front -------------------------------------------------------
@@ -377,8 +396,12 @@ class ServeDaemon:
             op = doc["op"]
             if op == "status":
                 doc_stats = self.stats()
-                if doc.get("detail") == "telemetry":
+                detail = doc.get("detail")
+                if detail in ("telemetry", "slo"):
                     doc_stats["telemetry"] = self.aggregator.snapshot()
+                if detail == "slo":
+                    doc_stats["slo"] = _slo.evaluate(
+                        self.slo_spec, doc_stats["telemetry"])
                 send({"v": protocol.PROTOCOL_VERSION, "kind": "stats",
                       **doc_stats})
                 return
@@ -411,6 +434,13 @@ class ServeDaemon:
             send(protocol.reject("bad_request", detail=str(e), tag=tag))
             return
         except QueueFullReject as e:
+            telemetry.record_reject(str(doc.get("tenant", "")))
+            if not self._capacity_dumped.is_set():
+                # first capacity error per process: what the admission
+                # plane looked like when backpressure began (later
+                # queue_full rejects are ordinary backpressure, not news)
+                self._capacity_dumped.set()
+                _flight.dump("capacity")
             send(protocol.reject(
                 "queue_full", tag=tag,
                 detail=f"{e.depth}/{e.capacity} queued; retry with backoff"))

@@ -13,7 +13,12 @@ Request ops::
     {"op": "scene", "scene": "scene0001_00",
      "deadline_s": 30.0,          # optional per-request budget (0 = none)
      "resume": false,             # optional: artifact/journal resume
-     "tag": "client-key"}         # optional: echoed on every event
+     "tag": "client-key",         # optional: echoed on every event
+     "tenant": "team-a"}          # optional: accounting identity — the
+                                  # telemetry plane attributes requests,
+                                  # latency, queue waits, crashes,
+                                  # device-seconds and d2h bytes per
+                                  # tenant (obs/telemetry.py windows)
     {"op": "scene", "scene": "synth-a",
      "synthetic": {"num_boxes": 3, "num_frames": 10,
                    "image_hw": [60, 80], "spacing": 0.06, "seed": 40}}
@@ -64,12 +69,18 @@ from typing import Dict, Optional
 
 PROTOCOL_VERSION = 1
 
+# accounting identities are dict keys in telemetry windows and column
+# labels in obs.top — bound their length so a hostile client cannot bloat
+# every window row
+TENANT_MAX_LEN = 64
+
 OPS = ("scene", "stream_chunk", "stream_end", "status", "shutdown")
 # the ops that name a scene and ride the admission queue as work items
 SCENE_OPS = ("scene", "stream_chunk", "stream_end")
-# status op detail levels: "" (the classic point-in-time snapshot) or
+# status op detail levels: "" (the classic point-in-time snapshot),
 # "telemetry" (adds the windowed aggregator's ring + cumulative digest)
-STATUS_DETAILS = ("", "telemetry")
+# or "slo" (telemetry plus the armed spec's burn-rate verdict, obs/slo.py)
+STATUS_DETAILS = ("", "telemetry", "slo")
 REJECT_REASONS = ("queue_full", "deadline", "bad_request", "draining")
 RESULT_STATUSES = ("ok", "failed", "skipped", "deadline", "interrupted")
 
@@ -98,6 +109,7 @@ class SceneRequest:
     deadline_s: float = 0.0
     resume: bool = False
     tag: str = ""
+    tenant: str = ""  # optional accounting identity ("" = untenanted)
     admitted_at: float = 0.0       # time.monotonic() at admission
     deadline_at: float = math.inf  # monotonic deadline (inf = none)
     # how many device workers this request has crashed (the isolated
@@ -160,6 +172,16 @@ def parse_line(line: str) -> Dict:
             raise ProtocolError("'deadline_s' must be a number >= 0")
         if not isinstance(doc.get("resume", False), bool):
             raise ProtocolError("'resume' must be a boolean")
+        if "tenant" in doc:
+            tenant = doc["tenant"]
+            if not isinstance(tenant, str) or not tenant:
+                raise ProtocolError("'tenant' must be a non-empty string")
+            if len(tenant) > TENANT_MAX_LEN:
+                raise ProtocolError(f"'tenant' longer than {TENANT_MAX_LEN} "
+                                    "chars")
+            if os_sep_like(tenant):
+                raise ProtocolError(f"tenant {tenant!r} must not contain "
+                                    "path separators")
         if "crashes" in doc:
             # supervisor-internal (the pipe carries it via forward_request,
             # which bypasses parse_line): a client must not pre-degrade its
@@ -186,6 +208,7 @@ def build_request(doc: Dict, request_id: str) -> SceneRequest:
         deadline_s=deadline,
         resume=bool(doc.get("resume", False)),
         tag=str(doc.get("tag", "")),
+        tenant=str(doc.get("tenant", "")),
         admitted_at=now,
         deadline_at=(now + deadline) if deadline > 0 else math.inf,
         crashes=int(doc.get("crashes", 0) or 0),
@@ -212,6 +235,8 @@ def forward_request(req: SceneRequest) -> Dict:
         doc["resume"] = True
     if req.tag:
         doc["tag"] = req.tag
+    if req.tenant:
+        doc["tenant"] = req.tenant
     if req.crashes:
         doc["crashes"] = req.crashes
     return doc

@@ -53,6 +53,7 @@ from typing import Deque, Dict, Optional, Tuple
 
 from maskclustering_tpu import obs
 from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
+from maskclustering_tpu.obs import flight as _flight
 from maskclustering_tpu.obs import telemetry
 from maskclustering_tpu.serve import protocol
 from maskclustering_tpu.serve.admission import AdmissionQueue
@@ -133,6 +134,13 @@ class WorkerSupervisor:
         self.spawns = 0
         self.last_ready: Dict = {}
         self.last_bye: Dict = {}
+        # the child's black-box delta: worker_main ships its flight-ring
+        # events on the heartbeat cadence (kind "flight" pipe lines), so
+        # when heartbeat silence forces a SIGKILL the parent still holds
+        # the victim's final spans — the rows the result-driven telem
+        # relay never got to ship. Bounded; _on_crash dumps them.
+        self._child_flight: Deque[Dict] = deque(maxlen=1024)
+        self._last_child_telem: Optional[Dict] = None
         self._cfg_path = self._write_cfg()
 
     # -- child plumbing ------------------------------------------------------
@@ -240,6 +248,17 @@ class WorkerSupervisor:
                     telemetry.fold_telem(doc, child_pid=child.pid)
                 except Exception:  # noqa: BLE001 — telemetry never faults
                     log.exception("worker supervisor: telem fold failed")
+                with self._lock:
+                    self._last_child_telem = doc
+                continue
+            if kind == _flight.KIND_DELTA:
+                # child flight-ring delta (heartbeat cadence): retain, so
+                # a SIGKILL postmortem still shows the victim's last spans
+                with self._lock:
+                    for row in doc.get("rows") or ():
+                        if isinstance(row, dict):
+                            row.setdefault("pid", child.pid)
+                            self._child_flight.append(row)
                 continue
             if kind == "ready":
                 with self._lock:
@@ -366,6 +385,9 @@ class WorkerSupervisor:
         if child.poll() is not None:
             return f"worker process died (rc {child.returncode})"
         if self._heartbeat.expired():
+            _flight.record(_flight.KIND_HB, what="heartbeat_silent",
+                           age_s=round(self._heartbeat.age_s(), 3),
+                           budget_s=self._heartbeat.budget_s)
             return (f"worker heartbeat silent past "
                     f"{self._heartbeat.budget_s:.3g}s (wedged); SIGKILL")
         return None
@@ -439,6 +461,7 @@ class WorkerSupervisor:
         if req.expired():
             obs.count("serve.requests")
             obs.count("serve.rejects.deadline")
+            telemetry.record_reject(req.tenant)
             with self._lock:
                 self._counts["deadline"] += 1
             _send(req, protocol.reject(
@@ -518,7 +541,8 @@ class WorkerSupervisor:
         if terminal.get("kind") == "result" and "seconds" in terminal:
             telemetry.record_request(
                 tuple(bucket) if bucket is not None
-                else self.router.bucket_for(req.scene), latency)
+                else self.router.bucket_for(req.scene), latency,
+                tenant=req.tenant, status=key)
 
     def _crash_inflight(self, req: protocol.SceneRequest, entry: Dict,
                         detail: str) -> bool:
@@ -541,13 +565,21 @@ class WorkerSupervisor:
         self.crashes += 1
         obs.count("serve.worker_crashes")
         log.error("worker supervisor: %s", detail)
+        child = self._child
+        child_pid = child.pid if child is not None else None
         self._kill_child()
+        _flight.record(_flight.KIND_CRASH, detail=detail,
+                       request=req.id if req else None,
+                       scene=req.scene if req else None,
+                       child_pid=child_pid, crashes=self.crashes)
+        self._dump_blackbox(child_pid)
         if req is None:
             return
         # zero-width trace marker: obs.trace renders the crash between the
         # dead attempt and the requeue's second queue-wait segment
         obs.record_span("serve.worker_crash", 0.0, request=req.id,
                         scene=req.scene, detail=detail, end_ts=time.time())
+        telemetry.record_crash(req.tenant)
         req.crashes += 1
         err = faults.WorkerCrashError(req.scene, detail)
         self._journal_crash(req, err)
@@ -568,6 +600,21 @@ class WorkerSupervisor:
         _send(req, protocol.result(req, "failed", error=str(err),
                                    error_class="device",
                                    worker_crashes=req.crashes))
+
+    def _dump_blackbox(self, child_pid: Optional[int]) -> None:
+        """The SIGKILL postmortem: the parent's own ring plus the child's
+        last relayed flight delta and telemetry doc — the only record of
+        what the dead worker was doing when the live relay went silent."""
+        with self._lock:
+            extra = [dict(row) for row in self._child_flight]
+            telem = self._last_child_telem
+        # racing child shippers (hb thread vs receive-time flush) may land
+        # deltas out of ring order; the per-pid seq restores it
+        extra.sort(key=lambda r: (r.get("pid") or 0, r.get("seq") or 0))
+        if telem is not None:
+            extra.append({"kind": _flight.KIND_CHILD_TELEM,
+                          "pid": child_pid, "doc": telem})
+        _flight.dump("worker_crash", extra_rows=extra)
 
     def _journal_crash(self, req: protocol.SceneRequest,
                        err: Exception) -> None:

@@ -247,7 +247,8 @@ class ServeWorker:
             self._counts[status_] = self._counts.get(status_, 0) + 1
         telemetry.record_request(
             telemetry_bucket if telemetry_bucket is not None
-            else self.router.bucket_for(req.scene), latency)
+            else self.router.bucket_for(req.scene), latency,
+            tenant=req.tenant, status=status_)
         _send(req, protocol.result(req, status_,
                                    seconds=round(latency, 4), **fields))
 
@@ -267,6 +268,7 @@ class ServeWorker:
             # admitted in time, dequeued too late: a typed answer beats
             # burning device time on a result nobody is waiting for
             obs.count("serve.rejects.deadline")
+            telemetry.record_reject(req.tenant)
             with self._lock:
                 self._counts["deadline"] += 1
             _send(req, protocol.reject(

@@ -119,6 +119,7 @@ def main(argv=None) -> int:
     # up the pipe so the parent's Serving report / windows / status op are
     # topology-invariant — nothing stays stranded in this process
     from maskclustering_tpu import obs
+    from maskclustering_tpu.obs import flight
     from maskclustering_tpu.obs import telemetry
 
     relay = telemetry.ChildRelay() if args.telem_interval > 0 else None
@@ -160,10 +161,31 @@ def main(argv=None) -> int:
     # both, which is exactly the signal the parent watches for.
     hb_stop = threading.Event()
 
+    # the flight-ring delta relay: if this process wedges and eats a
+    # SIGKILL, the parent's retained copy of these rows is the only black
+    # box left — the victim request's final spans included. The hb thread
+    # ships on its cadence; the stdin loop also ships right after marking
+    # a request received, so the victim's identity reaches the parent
+    # BEFORE any crash that request can cause (a sub-interval crash must
+    # not outrun the relay). The lock covers only the snapshot cursor
+    # (never the pipe write — no blocking under a held lock); two racing
+    # shippers may emit out of ring order, which the supervisor undoes by
+    # sorting retained rows on their ``seq`` at dump time.
+    flight_lock = threading.Lock()
+    flight_seq = [0]
+
+    def ship_flight() -> None:
+        with flight_lock:
+            rows, flight_seq[0] = flight.recorder().snapshot(flight_seq[0])
+        if rows:
+            emit_raw({"kind": flight.KIND_DELTA, "pid": os.getpid(),
+                      "rows": rows})
+
     def hb_loop() -> None:
         last_telem = time.monotonic()
         while not hb_stop.wait(max(args.hb_interval, 0.05)):
             emit_raw({"kind": "hb"})
+            ship_flight()
             if relay is not None and \
                     time.monotonic() - last_telem >= args.telem_interval:
                 last_telem = time.monotonic()
@@ -246,6 +268,10 @@ def main(argv=None) -> int:
             continue
         req = protocol.build_request(doc, str(doc.get("id") or "r-local"))
         req.send = emit
+        flight.record(flight.KIND_REQUEST, event="received", request=req.id,
+                      scene=req.scene, op=req.op,
+                      **({"tenant": req.tenant} if req.tenant else {}))
+        ship_flight()  # victim identity must reach the parent pre-crash
         try:
             queue.submit(req)
         except Exception as e:  # noqa: BLE001 — answer, never die silently
@@ -264,8 +290,15 @@ def main(argv=None) -> int:
         # "compiles post-warm-up" off the same counters in both topologies
         retrace_sanitizer.emit_counters()
     flush_telem()
+    ship_flight()  # final ring delta: the parent's copy ends complete
     emit_raw({"kind": "bye", "retrace": _retrace_digest(),
               "counts": worker.stats()["counts"]})
+    if faults.stop_requested():
+        # cooperative drain path, NOT the signal handler (CONC.SIGNAL):
+        # the black box of a SIGTERM'd worker survives its own exit
+        flight.dump("sigterm")
+    elif rc:
+        flight.dump("drain_timeout")
     return 143 if faults.stop_requested() else rc
 
 

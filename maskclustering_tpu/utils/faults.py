@@ -81,6 +81,28 @@ def _count(name: str, delta: float = 1.0) -> None:
         pass
 
 
+def _flight_record(kind: str, **fields) -> None:
+    """Flight-ring mark (obs/flight.py); lazy + never the failure source."""
+    try:
+        from maskclustering_tpu.obs import flight
+
+        flight.record(kind, **fields)
+    except Exception:  # noqa: BLE001 — the black box must never fault the fault layer
+        pass
+
+
+def _flight_dump(reason: str) -> None:
+    """Crash-safe black-box dump (no-op unless $MCT_FLIGHT_DIR / an armed
+    dir exists). Called on the watchdog-fire and cooperative-drain paths —
+    NEVER from a signal handler (CONC.SIGNAL: handlers are flag-only)."""
+    try:
+        from maskclustering_tpu.obs import flight
+
+        flight.dump(reason)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 # ---------------------------------------------------------------------------
 # typed errors + classification
 # ---------------------------------------------------------------------------
@@ -209,6 +231,11 @@ def call_with_deadline(fn: Callable, budget_s: float, *, seam: str = "device",
     if not done.wait(budget_s):
         abandoned.set()
         _count("run.device_stalls")
+        # the wedge evidence goes to disk BEFORE the error unwinds into
+        # retry/degradation machinery that may not survive it
+        _flight_record("flight.fault", what="watchdog_expired", seam=seam,
+                       scene=scene, budget_s=budget_s)
+        _flight_dump("watchdog")
         raise DeviceStallError(seam, scene, budget_s)
     if "error" in box:
         raise box["error"]  # type: ignore[misc]
@@ -262,6 +289,10 @@ class Heartbeat:
     def check(self) -> None:
         if self.expired():
             _count("run.device_stalls")
+            _flight_record("flight.fault", what="heartbeat_expired",
+                           seam=self.seam, scene=self.scene,
+                           budget_s=self.budget_s)
+            _flight_dump("watchdog")
             raise DeviceStallError(self.seam, self.scene, self.budget_s)
 
 
@@ -512,6 +543,8 @@ class FaultPlan:
             if e.seam != seam or e.scene != scene or not e.take():
                 continue
             _count(f"faults.injected.{seam}")
+            _flight_record("flight.fault", what="injected",
+                           fault_kind=e.kind, seam=seam, scene=scene)
             log.warning("fault injection: %s at %s seam of scene %s",
                         e.kind, seam, scene)
             if e.kind == "stall":
@@ -633,6 +666,10 @@ def _announce_stop() -> None:
     accepted for a lock-free poll path."""
     if not _STOP_ANNOUNCED.is_set():
         _STOP_ANNOUNCED.set()
+        # first safe-thread poll after the (flag-only) handler: the ring
+        # mark for the stop transition happens HERE, never in the handler
+        _flight_record("flight.signal", what="stop_requested",
+                       reason=_STOP_REASON)
         log.warning("stop requested%s: finishing in-flight scenes, "
                     "journaling the rest",
                     f" ({_STOP_REASON})" if _STOP_REASON else "")

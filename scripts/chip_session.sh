@@ -84,6 +84,15 @@ if [ -n "${MCT_NO_OBS:-}" ]; then
   OBS_INT8=(--no-obs)
   OBS_FB8=(--no-obs)
 fi
+# flight recorder armed for the whole session (obs/flight.py reads
+# $MCT_FLIGHT_DIR): a watchdog fire, capacity error or SIGTERM in ANY
+# step leaves a postmortem ring under $OUT/flight — render it with
+#   python -m maskclustering_tpu.obs.flight "$OUT/flight"
+# A wedged round-4-style window then costs a dump, not the whole story.
+if [ -z "${MCT_NO_OBS:-}" ]; then
+  export MCT_FLIGHT_DIR="$OUT/flight"
+  mkdir -p "$MCT_FLIGHT_DIR"
+fi
 
 preflight() { # wait-for-healthy: bounded probe-retry before the first bench
   local budget=${MCT_PREFLIGHT_BUDGET:-900} t0 attempt=1 elapsed pause

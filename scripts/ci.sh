@@ -67,7 +67,15 @@
 #    additionally asserts the cross-process relay delivered the child's
 #    counters (worker.telem_messages / serve.requests_ok /
 #    pipeline.host_sync present in the parent's cumulative snapshot) —
-#    an isolated worker with a dark relay fails here.
+#    an isolated worker with a dark relay fails here. The drill also
+#    asserts the FLIGHT-RECORDER postmortem (obs/flight.py): the
+#    supervisor must dump a black box at SIGKILL time (parent ring +
+#    the child's relayed flight deltas), the dump must name the victim
+#    request with its child-side lifecycle rows, and
+#    `obs.trace --blackbox` must fold it into a causal timeline that
+#    reaches crash -> requeue -> respawn. These assertions live INSIDE
+#    scripts/load_gen.py's crash-drill path — same gate, same exit
+#    code 8, first-failing-gate-wins unchanged.
 #
 # 3f. runs the streaming smoke (distinct exit code 9): a 2-scene CPU run
 #    at chunk 8 through the chunked streaming accumulator
@@ -176,9 +184,11 @@ if [ "${MCT_SERVE_SMOKE:-1}" != "0" ]; then
     echo "== ci: serve daemon smoke (spawn daemon + load_gen burst, SIGTERM drain, <300s) =="
     # bounded end-to-end gate on the serving layer: a sanitizer-armed
     # daemon warms two tiny buckets, serves a mixed-bucket burst through
-    # scripts/load_gen.py, and must drain SIGTERM-clean with ZERO
-    # post-warm compiles (the serve-many contract) — the full soak lives
-    # slow-marked in tests/test_serve.py
+    # scripts/load_gen.py (smoke default tenant mix A:3,B:1 — per-tenant
+    # accounting must sum back to the global window, and the healthy
+    # soak must pass the default SLO spec), and must drain SIGTERM-clean
+    # with ZERO post-warm compiles (the serve-many contract) — the full
+    # soak lives slow-marked in tests/test_serve.py
     if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
             python scripts/load_gen.py --smoke --requests 6 \
             --concurrency 3 --no-ledger; then

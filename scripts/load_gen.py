@@ -29,6 +29,15 @@ Modes::
 Requests repeat over the bucket scene set with ``resume=false`` so every
 request executes (artifact resume would turn repeats into no-ops and the
 throughput number into fiction).
+
+``--tenant-mix A:3,B:1`` stamps a weighted tenant identity on every
+request (``obs/telemetry.py`` attributes latency, device-seconds and d2h
+bytes per tenant); the smoke asserts the per-tenant accounting sums back
+to the global window and copies the tenant rows into the verdict. The
+smoke also arms the flight recorder (``--flight-dir``) — the crash drill
+asserts the supervisor's black-box dump reconstructs the victim request
+through crash -> requeue -> respawn — and holds the healthy soak to the
+default SLO spec (obs/slo.py).
 """
 
 from __future__ import annotations
@@ -65,6 +74,28 @@ def log(msg: str) -> None:
     print(f"load_gen: {msg}", file=sys.stderr, flush=True)
 
 
+def parse_tenant_mix(spec: Optional[str]) -> List[str]:
+    """``"A:3,B:1"`` -> a weighted assignment cycle ``[A,A,A,B]``; request
+    i gets ``cycle[i % len]``, so any request count splits 3:1. Empty/None
+    means untenanted (the pre-tenant wire shape, byte-for-byte)."""
+    if not spec:
+        return []
+    cycle: List[str] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, weight = part.partition(":")
+        name = name.strip()
+        if not name:
+            raise ValueError(f"tenant mix entry {part!r} has no tenant name")
+        n = int(weight) if weight.strip() else 1
+        if n < 1:
+            raise ValueError(f"tenant mix weight for {name!r} must be >= 1")
+        cycle.extend([name] * n)
+    return cycle
+
+
 def _address(args) -> object:
     if args.socket:
         return args.socket
@@ -72,15 +103,21 @@ def _address(args) -> object:
 
 
 def run_load(address, *, requests: int, concurrency: int, buckets: int,
-             deadline_s: float, resume: bool) -> Dict:
+             deadline_s: float, resume: bool,
+             tenant_mix: Optional[List[str]] = None) -> Dict:
     """Fire the burst; returns the aggregate verdict fields."""
     from maskclustering_tpu.serve.client import ServeClient
 
     specs = list(BUCKET_SPECS[:max(1, min(buckets, len(BUCKET_SPECS)))])
-    work: "queue.Queue[Tuple[int, str, Dict]]" = queue.Queue()
+    cycle = list(tenant_mix or [])
+    sent_tenants: Dict[str, int] = {}
+    work: "queue.Queue[Tuple[int, str, Dict, str]]" = queue.Queue()
     for i in range(requests):
         name, params = specs[i % len(specs)]
-        work.put((i, name, params))
+        tenant = cycle[i % len(cycle)] if cycle else ""
+        if tenant:
+            sent_tenants[tenant] = sent_tenants.get(tenant, 0) + 1
+        work.put((i, name, params, tenant))
     results: List[Dict] = []
     latencies: List[float] = []
     rejects: Dict[str, int] = {}
@@ -91,14 +128,14 @@ def run_load(address, *, requests: int, concurrency: int, buckets: int,
         with ServeClient(address, timeout_s=600.0) as client:
             while True:
                 try:
-                    i, name, params = work.get_nowait()
+                    i, name, params, tenant = work.get_nowait()
                 except queue.Empty:
                     return
                 attempts = 0
                 while True:
                     terminal, _statuses, latency = client.run_scene(
                         name, synthetic=params, deadline_s=deadline_s,
-                        resume=resume, tag=f"lg-{i:04d}")
+                        resume=resume, tag=f"lg-{i:04d}", tenant=tenant)
                     ncrash = sum(1 for s in _statuses
                                  if s.get("state") == "worker_crash")
                     if ncrash:
@@ -162,6 +199,7 @@ def run_load(address, *, requests: int, concurrency: int, buckets: int,
                             default=0),
         "max_rung": max((r.get("rung", 0) for r in results), default=0),
         "worker_crash_events": crash_events[0],
+        "tenant_mix_sent": sent_tenants or None,
     }
 
 
@@ -170,6 +208,123 @@ def append_ledger_row(verdict: Dict, path: Optional[str]) -> None:
 
     row = led.serve_row(verdict)
     led.append_row(path or led.default_ledger_path(), row)
+
+
+def check_tenant_accounting(tel: Dict, sent: Dict[str, int],
+                            failures: List[str]) -> Optional[Dict]:
+    """Per-tenant accounting must sum back to the global window: every
+    completion books globally AND under exactly one tenant, so any drift
+    means attribution was lost or double-booked. Returns the cumulative
+    tenant rows (for the verdict) when present.
+
+    The cumulative equality is asserted exactly (the caller runs this on
+    a quiesced post-burst snapshot); closed-window rows only need to
+    SHOW attribution — a completion racing the roll tick may book its
+    counter and its tenant slot across a window boundary, so strict
+    per-window parity is pinned at the aggregator unit level instead.
+    """
+    cum = (tel or {}).get("cumulative") or {}
+    cum_tenants = cum.get("tenants") or {}
+    counters = cum.get("counters") or {}
+    total = sum(int((t or {}).get("requests", 0))
+                for t in cum_tenants.values())
+    global_reqs = int(counters.get("serve.requests", 0))
+    if total != global_reqs:
+        failures.append(
+            f"tenant accounting: per-tenant requests sum to {total} but "
+            f"the global serve.requests counter says {global_reqs}")
+    missing = sorted(t for t in sent if t not in cum_tenants)
+    if missing:
+        failures.append(f"tenant accounting: tenant(s) {missing} sent "
+                        f"requests but never appeared in the snapshot")
+    windows = (tel or {}).get("windows") or []
+    tenanted = [w for w in windows if w.get("tenants")]
+    if windows and global_reqs and not tenanted:
+        failures.append("tenant accounting: no closed window carries a "
+                        "tenants sub-row — window attribution is dark")
+    return cum_tenants or None
+
+
+def check_healthy_slo(tel: Dict, verdict: Dict,
+                      failures: List[str]) -> None:
+    """The healthy-soak SLO gate: the canned default spec (obs/slo.py)
+    evaluated over the burst's closed windows must pass — a healthy
+    8-request soak that burns error budget means the spec or the
+    accounting broke, and CI should say which objective."""
+    from maskclustering_tpu.obs import slo as _slo
+
+    result = _slo.evaluate(_slo.load_spec(None), tel or {})
+    verdict["slo_ok"] = bool(result.get("ok"))
+    violated = [o.get("name") for o in result.get("objectives") or ()
+                if o.get("state") == "violated"]
+    if violated:
+        failures.append(f"healthy soak violated the default SLO spec: "
+                        f"{', '.join(map(str, violated))}")
+
+
+def check_blackbox(flight_dir: str, events: str, journal_dir: str,
+                   verdict: Dict, failures: List[str]) -> None:
+    """The crash-drill postmortem contract, end to end: the supervisor
+    dumped a black box at SIGKILL time, the dump names the victim request
+    and holds child-side rows the live relay shipped pre-crash, the
+    ``obs.flight`` renderer reads it, and ``obs.trace --blackbox`` folds
+    it into a causal timeline that reaches crash -> requeue -> respawn
+    (a post-crash execution attempt for the same request)."""
+    from maskclustering_tpu.obs import flight as _flight
+    from maskclustering_tpu.obs import trace as _trace
+
+    dumps = sorted(os.listdir(flight_dir)) if os.path.isdir(flight_dir) \
+        else []
+    crash_dumps = [n for n in dumps if "worker_crash" in n]
+    verdict["blackbox_dumps"] = len(dumps)
+    if not crash_dumps:
+        failures.append(f"crash drill: no worker_crash flight dump under "
+                        f"{flight_dir} (found: {dumps or 'nothing'})")
+        return
+    path = os.path.join(flight_dir, crash_dumps[-1])
+    meta, rows = _flight.read_dump(path)
+    crash_rows = [r for r in rows if r.get("kind") == _flight.KIND_CRASH]
+    victim = next((r.get("request") for r in crash_rows
+                   if r.get("request")), None)
+    if not crash_rows:
+        failures.append(f"crash drill: {path} holds no {_flight.KIND_CRASH} "
+                        f"row")
+    if victim is None:
+        failures.append("crash drill: the crash row names no victim "
+                        "request")
+        return
+    child_rows = [r for r in rows
+                  if r.get("kind") == _flight.KIND_REQUEST
+                  and r.get("request") == victim]
+    if not child_rows:
+        failures.append(f"crash drill: the dump holds no child-side "
+                        f"lifecycle row for {victim} — the flight-delta "
+                        f"relay never delivered the victim's ring")
+    rendered = _flight.render_dump(meta, rows, request=victim)
+    for needle in (victim, "worker_crash"):
+        if needle not in rendered:
+            failures.append(f"crash drill: obs.flight rendering of {path} "
+                            f"never mentions {needle!r}")
+    trace = _trace.assemble_trace(victim, events, journal_dir=journal_dir,
+                                  blackbox=flight_dir)
+    segs = trace.get("segments") or []
+    crash_at = next((s["t0"] for s in segs if s.get("kind") == "crash"),
+                    None)
+    attempts_after = [s for s in segs if s.get("kind") == "attempt"
+                      and crash_at is not None and s["t1"] > crash_at]
+    verdict["blackbox_trace_segments"] = len(segs)
+    if crash_at is None:
+        failures.append(f"crash drill: obs.trace --blackbox timeline for "
+                        f"{victim} shows no crash segment")
+    elif not attempts_after:
+        failures.append(f"crash drill: obs.trace --blackbox timeline for "
+                        f"{victim} never reaches a post-crash execution "
+                        f"attempt (requeue/respawn invisible)")
+    blackbox_marks = [s for s in segs if s.get("kind") == "blackbox"]
+    if not blackbox_marks:
+        failures.append(f"crash drill: the merged timeline for {victim} "
+                        f"carries no black-box marks — the dump "
+                        f"contributed nothing the live events lacked")
 
 
 def worst_window_p95(windows) -> Optional[float]:
@@ -272,6 +427,8 @@ def run_smoke(args) -> int:
     tmp = tempfile.mkdtemp(prefix="mct_serve_smoke_")
     sock = os.path.join(tmp, "mct.sock")
     events = os.path.join(tmp, "serve_events.jsonl")
+    flight_dir = os.path.join(tmp, "flight")
+    journal_dir = os.path.join(tmp, "journals")
     warm_names = []
     for name, params in BUCKET_SPECS:
         kw = dict(params)
@@ -289,7 +446,10 @@ def run_smoke(args) -> int:
            "--aot-cache", os.path.join(tmp, "aot"),
            "--obs_events", events, "--warm", "+".join(warm_names),
            "--telemetry-window", "1.0",
-           "--journal-dir", os.path.join(tmp, "journals")]
+           # the always-on flight recorder: every smoke arms it, the
+           # crash drill asserts the postmortem reconstructs
+           "--flight-dir", flight_dir,
+           "--journal-dir", journal_dir]
     for kv in SMOKE_CONFIG_SETS:
         cmd += ["--set", kv]
     fault_plan = args.fault_plan
@@ -314,10 +474,15 @@ def run_smoke(args) -> int:
         # torn snapshot mid-load is a gate failure (obs/telemetry.py)
         poller = TelemetryPoller(sock)
         poller.start()
+        # the smoke always drives a weighted tenant mix (unless the
+        # caller names one): the accounting-sums-to-global assertion
+        # below rides every gate run, both topologies
+        tenant_mix = parse_tenant_mix(args.tenant_mix or "A:3,B:1")
         try:
             verdict = run_load(sock, requests=args.requests,
                                concurrency=args.concurrency, buckets=2,
-                               deadline_s=args.deadline, resume=False)
+                               deadline_s=args.deadline, resume=False,
+                               tenant_mix=tenant_mix)
         finally:
             poller.stop()
         proc.send_signal(signal.SIGTERM)
@@ -424,6 +589,20 @@ def run_smoke(args) -> int:
                 failures.append(
                     f"isolated worker relayed no {missing} counter(s) — "
                     f"the cross-process telemetry relay is dark")
+    # tenant accounting: the per-tenant rows must sum back to the global
+    # counters in the final (quiesced) snapshot, identically in-process
+    # and under the isolated worker
+    if tenant_mix:
+        tenants = check_tenant_accounting(
+            tel, verdict.get("tenant_mix_sent") or {}, failures)
+        if tenants:
+            verdict["tenants"] = tenants
+    if args.crash_drill:
+        check_blackbox(flight_dir, events, journal_dir, verdict, failures)
+    elif not fault_plan:
+        # healthy soak: the canned default SLO spec must hold (drills
+        # are allowed to burn budget; that path is pinned in tests)
+        check_healthy_slo(tel, verdict, failures)
     if verdict["ok"] != args.requests:
         failures.append(f"only {verdict['ok']}/{args.requests} requests "
                         f"answered ok")
@@ -465,6 +644,11 @@ def main(argv=None) -> int:
                              "(1..2, default 2)")
     parser.add_argument("--deadline", type=float, default=0.0,
                         help="per-request deadline_s (0 = none)")
+    parser.add_argument("--tenant-mix", default=None, metavar="A:3,B:1",
+                        help="weighted tenant identities stamped on the "
+                             "burst (name:weight, comma-joined); arms the "
+                             "per-tenant accounting assertions (smoke "
+                             "default: A:3,B:1)")
     parser.add_argument("--resume", action="store_true",
                         help="send resume=true (repeats become artifact "
                              "skips — throughput numbers then measure "
@@ -501,17 +685,26 @@ def main(argv=None) -> int:
         return run_smoke(args)
     if not args.socket and not args.host:
         parser.error("need --socket or --host/--port (or --smoke)")
+    tenant_mix = parse_tenant_mix(args.tenant_mix)
     verdict = run_load(_address(args), requests=args.requests,
                        concurrency=args.concurrency, buckets=args.buckets,
-                       deadline_s=args.deadline, resume=args.resume)
+                       deadline_s=args.deadline, resume=args.resume,
+                       tenant_mix=tenant_mix)
     from maskclustering_tpu.serve.client import ServeClient
 
+    tenant_failures: List[str] = []
     with ServeClient(_address(args), timeout_s=30.0) as client:
         stats = client.telemetry()
         tel = stats.get("telemetry") or {}
         if tel:
             verdict["telemetry_windows"] = len(tel.get("windows") or [])
             verdict["window_p95"] = worst_window_p95(tel.get("windows"))
+            if tenant_mix:
+                tenants = check_tenant_accounting(
+                    tel, verdict.get("tenant_mix_sent") or {},
+                    tenant_failures)
+                if tenants:
+                    verdict["tenants"] = tenants
         retrace = stats.get("retrace") or {}
         if retrace:
             verdict["retrace_compiles"] = retrace.get("compiles")
@@ -519,6 +712,11 @@ def main(argv=None) -> int:
             verdict["retrace_post_freeze"] = retrace.get("post_freeze")
         if args.shutdown:
             client.shutdown()
+    for f in tenant_failures:
+        # against a long-lived daemon prior (possibly untenanted) traffic
+        # legitimately skews the cumulative sums — warn, don't gate (the
+        # smoke runs the same check against a fresh daemon and gates)
+        log(f"WARNING — {f}")
     print(json.dumps(verdict, sort_keys=True), flush=True)
     if not args.no_ledger:
         append_ledger_row(verdict, args.ledger)

@@ -1,0 +1,416 @@
+"""mct-blackbox contract tests (obs/flight.py + obs/slo.py + tenant plane).
+
+Unit tier, all CPU-cheap: the flight recorder's ring bounds and
+snapshot-delta shape, crash-safe dump round-trips (render, request
+filter, resolve-newest, CLI exit codes, unarmed no-op), SLO spec
+validation naming the bad field, the two-window burn-rate rule
+(one bad window must NOT page), tenant-scoped objectives, per-tenant
+window/cumulative accounting parity plus the overflow cap, the
+empty-window render guards (obs.top / report Serving+SLO clean on zero
+requests), the --regress tenant-dimension fence both ways, the
+obs.trace --blackbox merge (dedup + zero-width marks), and the
+disarmed-path AST pin: no device-path module may import the recorder.
+"""
+
+import json
+import types
+
+from maskclustering_tpu.analysis import ast_checks
+from maskclustering_tpu.obs import flight, ledger as led, slo, telemetry
+from maskclustering_tpu.obs import metrics as obs_metrics
+from maskclustering_tpu.obs.report import (main as report_main,
+                                           render_slo, render_tenants)
+from maskclustering_tpu.obs.top import render_top
+from maskclustering_tpu.obs.trace import assemble_trace
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, snapshot deltas, dumps
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounds_and_snapshot_delta():
+    rec = flight.FlightRecorder(capacity=16)
+    for i in range(40):
+        rec.record(flight.KIND_ADMIT, event="admit", request=f"r-{i}")
+    assert len(rec) == 16  # bounded: old events evicted, never grown
+    rows, seq = rec.snapshot()
+    assert seq == 40
+    assert [r["request"] for r in rows] == [f"r-{i}" for i in range(24, 40)]
+    assert all(r["seq"] == 25 + i for i, r in enumerate(rows))
+    # delta semantics: the child heartbeat ships only what is new
+    delta, seq2 = rec.snapshot(seq)
+    assert delta == [] and seq2 == seq
+    rec.record_span("serve.request", 1.25, 0.5, {"request": "r-40"})
+    delta, seq3 = rec.snapshot(seq)
+    assert seq3 == 41 and len(delta) == 1
+    sp = delta[0]
+    assert sp["kind"] == "span" and sp["name"] == "serve.request"
+    assert sp["dur_s"] == 1.25 and sp["sync_s"] == 0.5
+    assert sp["attrs"] == {"request": "r-40"}
+
+
+def test_flight_dump_round_trip_render_and_filter(tmp_path, monkeypatch):
+    monkeypatch.delenv(flight.ENV_DIR, raising=False)
+    rec = flight.FlightRecorder(capacity=32)
+    rec.record(flight.KIND_REQUEST, event="received", request="r-1",
+               tenant="A")
+    rec.record_span("serve.request", 2.0, 0.1,
+                    {"request": "r-1", "scene": "s0"})
+    rec.record(flight.KIND_CRASH, request="r-2", signal=9)
+    # unarmed (no dir, no env) -> counted no-op, never a failure source
+    assert rec.dump("watchdog") is None
+
+    rec.arm(str(tmp_path))
+    path = rec.dump("worker_crash",
+                    extra_rows=[{"kind": flight.KIND_HB, "pid": 777,
+                                 "age_s": 3.0}])
+    assert path is not None and path.endswith("-worker_crash.jsonl")
+    meta, rows = flight.read_dump(path)
+    assert meta["kind"] == flight.KIND_META
+    assert meta["reason"] == "worker_crash"
+    assert meta["events"] == 4 == len(rows)
+    assert rows[-1]["pid"] == 777  # extra (relayed) rows keep their pid
+    text = flight.render_dump(meta, rows)
+    assert "worker_crash" in text and "serve.request" in text
+    # request filter: only r-1's lifecycle + spans survive
+    only = flight.render_dump(meta, rows, request="r-1")
+    assert "r-1" in only and "r-2" not in only
+    assert "2 event(s) for request r-1" in only
+
+
+def test_flight_resolve_dump_and_cli(tmp_path, capsys):
+    rec = flight.FlightRecorder()
+    rec.record(flight.KIND_SIGNAL, event="stop")
+    old = rec.dump("sigterm", path=str(tmp_path / "flight-1-01-a.jsonl"))
+    new = rec.dump("watchdog", path=str(tmp_path / "flight-1-02-b.jsonl"))
+    # a directory resolves to its newest dump; files resolve to themselves
+    assert flight.resolve_dump(str(tmp_path)) == new
+    assert flight.resolve_dump(old) == old
+    assert flight.resolve_dump(str(tmp_path / "nope.jsonl")) is None
+
+    assert flight.main([str(tmp_path)]) == 0
+    assert "watchdog" in capsys.readouterr().out
+    assert flight.main([str(tmp_path / "nope.jsonl")]) == 1
+    capsys.readouterr()
+    assert flight.main([new, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["meta"]["reason"] == "watchdog"
+    assert doc["events"][0]["kind"] == flight.KIND_SIGNAL
+
+
+# ---------------------------------------------------------------------------
+# slo: spec validation + two-window burn rates
+# ---------------------------------------------------------------------------
+
+
+def _win(requests, *, status="ok", p95=1.0, tenants=None, **extra):
+    row = {"t0": 0.0, "dur_s": 5.0, "requests": requests,
+           "by_status": {status: requests}, "rejects": {}, "crashes": 0,
+           "respawns": 0, "requeued": 0, "aot_hits": 0,
+           "post_warm_compiles": 0, "queue_depth": 0,
+           "latency": {"b": {"count": requests, "p50_s": p95 / 2,
+                             "p95_s": p95, "max_s": p95}}}
+    if tenants:
+        row["tenants"] = tenants
+    row.update(extra)
+    return row
+
+
+def test_slo_validate_spec_names_bad_field():
+    import pytest
+
+    base = {"name": "s", "windows": {"short": 1, "long": 5},
+            "objectives": [{"name": "o", "kind": "error_rate",
+                            "threshold": 0.1}]}
+    assert slo.validate_spec(base)["objectives"][0]["threshold"] == 0.1
+    cases = [
+        (dict(base, windows={"short": 3, "long": 2}), "windows"),
+        (dict(base, objectives=[]), "objectives"),
+        (dict(base, objectives=[{"name": "o", "kind": "bogus",
+                                 "threshold": 1}]), "unknown kind"),
+        (dict(base, objectives=base["objectives"] * 2), "duplicate"),
+        (dict(base, objectives=[{"name": "o", "kind": "error_rate",
+                                 "threshold": -1}]), "threshold"),
+        (dict(base, objectives=[{"name": "o", "kind": "error_rate",
+                                 "threshold": 1, "tenant": ""}]), "tenant"),
+    ]
+    for spec, needle in cases:
+        with pytest.raises(ValueError, match=needle):
+            slo.validate_spec(spec)
+    # the canned default is itself valid and loads without a file
+    spec = slo.load_spec(None)
+    assert spec["name"] == "serve-default"
+    assert {o["kind"] for o in spec["objectives"]} <= set(slo.KINDS)
+
+
+def test_slo_two_window_rule_and_violation_naming():
+    spec = slo.validate_spec({
+        "name": "t", "windows": {"short": 1, "long": 5},
+        "objectives": [{"name": "errors", "kind": "error_rate",
+                        "threshold": 0.05},
+                       {"name": "lat", "kind": "latency_p95",
+                        "threshold": 10.0}]})
+    healthy = {"windows": [_win(10) for _ in range(5)]}
+    res = slo.evaluate(spec, healthy)
+    assert res["ok"] and slo.violated(res) == []
+    assert all(o["state"] == "ok" for o in res["objectives"])
+
+    # ONE bad window (the short one) must not page: the long window's
+    # error rate 2/42 stays inside the 5% budget
+    spike = {"windows": [_win(10) for _ in range(4)]
+             + [_win(2, status="deadline")]}
+    res = slo.evaluate(spec, spike)
+    errors = [o for o in res["objectives"] if o["name"] == "errors"][0]
+    assert errors["state"] == "ok" and errors["burn_short"] > 1.0
+    assert res["ok"]
+
+    # sustained burn: every window bad -> both windows past budget
+    burn = {"windows": [_win(2, status="deadline") for _ in range(5)]}
+    res = slo.evaluate(spec, burn)
+    assert not res["ok"] and slo.violated(res) == ["errors"]
+    # crashes count against the same budget as error statuses
+    crashy = {"windows": [_win(2, crashes=2) for _ in range(5)]}
+    assert slo.violated(slo.evaluate(spec, crashy)) == ["errors"]
+
+
+def test_slo_tenant_scope_zero_threshold_and_no_data():
+    spec = slo.validate_spec({
+        "name": "t", "windows": {"short": 1, "long": 2},
+        "objectives": [
+            {"name": "a-errors", "kind": "error_rate", "threshold": 0.05,
+             "tenant": "A"},
+            {"name": "no-compiles", "kind": "post_warm_compiles",
+             "threshold": 0}]})
+    # tenant A burns while the global window (and tenant B) stay healthy
+    rows = [_win(10, tenants={"A": {"requests": 1,
+                                    "by_status": {"failed": 1}},
+                              "B": {"requests": 9}})
+            for _ in range(2)]
+    res = slo.evaluate(spec, {"windows": rows})
+    a = [o for o in res["objectives"] if o["name"] == "a-errors"][0]
+    assert a["tenant"] == "A" and a["state"] == "violated"
+    # zero-threshold count objective: the burn IS the count, so repeated
+    # occurrences in both windows page (a single one burns at exactly 1.0
+    # and stays on the right side of the strict > threshold)
+    rows2 = [_win(5, post_warm_compiles=2) for _ in range(2)]
+    res2 = slo.evaluate(spec, {"windows": rows2})
+    assert "no-compiles" in slo.violated(res2)
+    one = [_win(5, post_warm_compiles=1), _win(5)]
+    assert slo.violated(slo.evaluate(spec, {"windows": one})) == []
+    # no traffic -> no_data verdicts, never a fake pass/fail number
+    res3 = slo.evaluate(spec, {"windows": []})
+    assert res3["ok"] and all(o["state"] == "no_data"
+                              for o in res3["objectives"])
+    assert "no evaluation" in slo.render_result(None)[0]
+    assert any("--" in ln for ln in slo.render_result(res3))
+
+
+# ---------------------------------------------------------------------------
+# telemetry: per-tenant window + cumulative accounting
+# ---------------------------------------------------------------------------
+
+
+def test_aggregator_tenant_accounting_parity():
+    agg = telemetry.WindowAggregator(window_s=60.0)
+    reg = obs_metrics.registry()
+    reg.count("device.seconds", 2.0)  # consumed before A's completion
+    agg.record_request("b6", 1.0, tenant="A")
+    agg.record_request("b6", 2.0, tenant="A", status="failed")
+    agg.record_request("b6", 3.0, tenant="B")
+    reg.count("device.seconds", 1.5)
+    agg.record_request("b6", 4.0)  # untenanted: books globally only,
+    agg.record_queue_wait(0.5, tenant="A")  # and advances the baseline
+    agg.record_request("b6", 5.0, tenant="B")
+
+    row = agg.roll()
+    t = row["tenants"]
+    # sums-to-global: every tenanted completion appears exactly once
+    assert sum(s["requests"] for s in t.values()) == 4
+    assert t["A"]["requests"] == 2 and t["B"]["requests"] == 2
+    assert t["A"]["by_status"] == {"ok": 1, "failed": 1}
+    assert t["A"]["latency"]["b6"]["count"] == 2
+    assert t["A"]["queue_wait"]["count"] == 1
+    # attribution: the device-seconds delta since the previous completion
+    # lands on the finishing tenant; the untenanted request's 1.5s is
+    # charged to no one (the baseline still advances past it)
+    assert t["A"]["device_s"] == 2.0
+    assert "device_s" not in t["B"]  # zero elided from the wire row
+
+    # the window slot clears at roll; cumulative accounting persists
+    row2 = agg.roll()
+    assert "tenants" not in row2
+    cum = agg.snapshot()["cumulative"]["tenants"]
+    assert cum["A"]["requests"] == 2 and cum["B"]["requests"] == 2
+    assert cum["A"]["latency"]["all"]["count"] == 2
+    assert cum["A"]["device_s"] == 2.0
+
+
+def test_aggregator_tenant_overflow_attribution_and_rebase():
+    agg = telemetry.WindowAggregator(window_s=60.0)
+    for i in range(telemetry._TENANT_CAP + 8):
+        agg.record_request("b", 1.0, tenant=f"t{i:03d}")
+    agg.record_reject("t000")
+    agg.record_crash("t001")
+    agg.record_reject("")  # empty tenant: a no-op, never a slot
+    agg.record_crash("")
+    row = agg.roll()
+    t = row["tenants"]
+    # bounded store: _TENANT_CAP named slots + the shared overflow bucket
+    assert len(t) == telemetry._TENANT_CAP + 1
+    assert t[telemetry._TENANT_OVERFLOW]["requests"] == 8
+    assert sum(s["requests"] for s in t.values()) == telemetry._TENANT_CAP + 8
+    assert t["t000"]["rejects"] == 1 and t["t001"]["crashes"] == 1
+
+    # rebase re-anchors the window clock and drops current-window slots
+    # (warm-up charges no tenant) without touching the cumulative store
+    agg.record_request("b", 1.0, tenant="t000")
+    agg.rebase()
+    assert "tenants" not in agg.roll()
+    cum = agg.snapshot()["cumulative"]["tenants"]
+    assert cum["t000"]["requests"] == 2
+
+
+# ---------------------------------------------------------------------------
+# empty-window render guards
+# ---------------------------------------------------------------------------
+
+
+def test_empty_window_renders_are_clean():
+    # a daemon polled before its first request: no windows, no tenants,
+    # no percentiles of nothing — every panel renders, nothing divides
+    frame = render_top({}, now=0.0)
+    assert "mct-serve top" in frame and "requests: none yet" in frame
+    frame = render_top({"telemetry": {"windows": [], "current": {},
+                                      "cumulative": {}},
+                        "slo": slo.evaluate(slo.load_spec(None),
+                                            {"windows": []})}, now=0.0)
+    assert "slo [serve-default]" in frame and "Traceback" not in frame
+    assert render_tenants([]) == []
+    assert render_tenants([{"requests": 5}]) == []  # untenanted windows
+    # report SLO section: absent (not crashing) without telemetry rows
+    assert render_slo(types.SimpleNamespace(telemetry_rows=[])) is None
+
+
+# ---------------------------------------------------------------------------
+# --regress: the tenant-dimension fence, both ways
+# ---------------------------------------------------------------------------
+
+
+def _serve_verdict(value, tenants=None):
+    v = {"metric": "serve s/request (p50)", "value": value,
+         "unit": "s/request", "tool": "serve"}
+    if tenants:
+        v["tenants"] = tenants
+    return v
+
+
+def test_regress_tenant_dimension_fences_both_ways(tmp_path, capsys):
+    assert not led.tenant_dimension(None)
+    assert not led.tenant_dimension({"value": 1.0})
+    tenants = {"A": {"requests": 3}, "B": {"requests": 1}}
+    assert led.tenant_dimension(led.serve_row(_serve_verdict(1.0, tenants)))
+
+    # untenanted baseline: a newer tenant-mix row (its latency is the
+    # mix's) must not gate — the fence picks the comparable row instead
+    baseline = str(tmp_path / "base.json")
+    with open(baseline, "w") as f:
+        json.dump(_serve_verdict(1.0), f)
+    ledger = str(tmp_path / "ledger.jsonl")
+    led.append_row(ledger, led.serve_row(_serve_verdict(1.05)))
+    led.append_row(ledger, led.serve_row(_serve_verdict(9.0, tenants)))
+    assert report_main(["--ledger", ledger, "--regress", baseline]) == 0
+    assert "1.050" in capsys.readouterr().out
+
+    # the other way: a tenanted baseline never gates untenanted rows
+    base2 = str(tmp_path / "base2.json")
+    with open(base2, "w") as f:
+        json.dump(_serve_verdict(1.0, tenants), f)
+    ledger2 = str(tmp_path / "ledger2.jsonl")
+    led.append_row(ledger2, led.serve_row(_serve_verdict(1.05, tenants)))
+    led.append_row(ledger2, led.serve_row(_serve_verdict(9.0)))
+    assert report_main(["--ledger", ledger2, "--regress", base2]) == 0
+    capsys.readouterr()
+
+    # same dimension still gates: an in-fence regression exits non-zero
+    ledger3 = str(tmp_path / "ledger3.jsonl")
+    led.append_row(ledger3, led.serve_row(_serve_verdict(9.0, tenants)))
+    assert report_main(["--ledger", ledger3, "--regress", base2]) == 2
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# obs.trace --blackbox: merge, dedup, zero-width marks
+# ---------------------------------------------------------------------------
+
+
+def test_trace_blackbox_merge_dedups_and_marks(tmp_path):
+    t0 = 1000.0
+    events = str(tmp_path / "events.jsonl")
+    wait = {"v": 1, "kind": "span", "ts": t0 + 1.0, "pid": 1,
+            "name": "serve.queue_wait", "dur_s": 1.0,
+            "attrs": {"request": "r-1", "scene": "s0"}}
+    with open(events, "w") as f:
+        f.write(json.dumps(wait) + "\n")
+
+    # the postmortem ring: the victim's child-side execution span the
+    # relay never shipped, its lifecycle mark, the parent-side crash row,
+    # a duplicate of the live wait span (must dedup), another request's
+    # mark (must filter)
+    rec = flight.FlightRecorder()
+    dump_dir = tmp_path / "flight"
+    path = str(dump_dir / "flight-9-01-worker_crash.jsonl")
+    rec.dump("worker_crash", path=path)  # empty decoy: newest wins below
+    rows = [
+        dict(wait, seq=1),
+        {"kind": flight.KIND_REQUEST, "ts": t0 + 2.0, "seq": 2,
+         "event": "received", "request": "r-1", "tenant": "A", "pid": 9},
+        {"kind": "span", "ts": t0 + 3.0, "seq": 3, "name": "serve.request",
+         "dur_s": 1.0, "attrs": {"request": "r-1", "end_ts": t0 + 3.0,
+                                 "worker_pid": 9}},
+        {"kind": flight.KIND_CRASH, "ts": t0 + 3.5, "seq": 4,
+         "request": "r-2", "signal": 9},
+        {"kind": flight.KIND_CRASH, "ts": t0 + 3.6, "seq": 5,
+         "request": "r-1", "signal": 9},
+    ]
+    rec.dump("worker_crash", extra_rows=rows,
+             path=str(dump_dir / "flight-9-02-worker_crash.jsonl"))
+
+    tr = assemble_trace("r-1", events, blackbox=str(dump_dir))
+    kinds = [s["kind"] for s in tr["segments"]]
+    assert kinds == ["queue_wait", "blackbox", "attempt", "blackbox"]
+    marks = [s for s in tr["segments"] if s["kind"] == "blackbox"]
+    assert marks[0]["label"] == "blackbox received (pid 9)"
+    assert "tenant=A" in marks[0]["detail"]
+    assert marks[1]["label"] == "blackbox WORKER CRASH"
+    attempt = [s for s in tr["segments"] if s["kind"] == "attempt"][0]
+    assert "worker pid 9" in attempt["detail"]
+    # r-2's crash never leaks into r-1's timeline; the duplicated wait
+    # span stays a single segment
+    assert len([s for s in tr["segments"]
+                if s["kind"] == "queue_wait"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# analysis hygiene: the recorder stays off the device path
+# ---------------------------------------------------------------------------
+
+
+def test_flight_stays_off_device_path_and_in_scan_roots():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # disarmed-path pin: no device-path module may touch the recorder or
+    # SLO plane — a ring append is host work the fused lattice must never
+    # pay for, and the analyzer only host-sync-audits these modules
+    for rel in ast_checks.DEVICE_PATH_MODULES:
+        with open(os.path.join(repo, rel), encoding="utf-8") as f:
+            src = f.read()
+        assert "obs.flight" not in src and "obs.slo" not in src, rel
+        assert "import flight" not in src and "import slo" not in src, rel
+    # the new planes are inside the analyzer's jurisdiction, not beside it
+    scanned = {os.path.relpath(p, repo).replace(os.sep, "/")
+               for p in ast_checks._iter_py_files(repo)}
+    assert "maskclustering_tpu/obs/flight.py" in scanned
+    assert "maskclustering_tpu/obs/slo.py" in scanned
+    assert "scripts/load_gen.py" in scanned
