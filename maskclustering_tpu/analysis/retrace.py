@@ -42,6 +42,13 @@ checks, all source-level (pure stdlib AST) except the census:
   (donation-off, host-postprocess) are enumerated per rung, which is the
   vocabulary the runtime sanitizer's context tags check against.
 
+- **RETRACE.GOLDENS** — the mct-sentinel ratchet: the committed
+  ``canary_goldens.json`` (obs/canary.py) must cover EXACTLY the digest
+  coordinates the canonical workload produces under the census cfg —
+  growth and shrinkage both fail, and version skew demands an audited
+  ``--write-goldens`` regeneration, same discipline as the surface
+  baseline.
+
 The dynamic half (``retrace_sanitizer``) hooks actual compile events and
 asserts the serve-many contract at run time; fn names here and compile
 log names there are ONE vocabulary.
@@ -76,6 +83,9 @@ RETRACE_SCAN_ROOTS = (
     "maskclustering_tpu/parallel",
     "maskclustering_tpu/ops",
     "maskclustering_tpu/io/feed.py",
+    # the sentinel digest programs ride every scene/chunk host phase —
+    # they are serving surface like any post-process kernel
+    "maskclustering_tpu/obs/digest.py",
 )
 
 # names a traced closure / jit-partial may bind: the compile-stable
@@ -134,6 +144,13 @@ SERVING_PROGRAMS: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
     ("_group_structs_kernel", "post", ()),
     ("_survivor_gather_kernel", "post", ("dtype",)),
     ("_mask_group_counts_impl", "post", ("dtype", "donate")),
+    # mct-sentinel invariant digests (obs/digest.py): fixed int32/uint32
+    # internally, so NO dtype/donate key axes — one executable per scene
+    # bucket x m_pad (keyed like the masks-bucket programs) and one per
+    # stream bucket; both compile during prewarm because they ride every
+    # warm-up scene's host phase
+    ("_digest_scene_impl", "masks", ()),
+    ("_digest_stream_impl", "stream", ()),
 )
 
 # jit sites that are NOT per-scene serving executables, with the reason
@@ -848,6 +865,97 @@ def check_surface(census: Dict, baseline: Dict,
     return findings
 
 
+def expected_goldens_coords(cfg=None) -> Set[str]:
+    """The coordinate set canary_goldens.json MUST cover: one full-scene
+    digest coordinate per DISTINCT canonical-workload bucket, under the
+    census cfg (``obs/canary.goldens_config`` — the same knobs
+    ``compile_surface`` pins). Derived, never read from the file."""
+    from maskclustering_tpu.utils.compile_cache import scene_bucket
+
+    if cfg is None:
+        from maskclustering_tpu.obs.canary import goldens_config
+
+        cfg = goldens_config()
+    coords: Set[str] = set()
+    for scene in CANONICAL_WORKLOAD:
+        k, f, n = scene_bucket(cfg, scene["frames"], scene["points"],
+                               scene["max_id"])
+        coords.add(f"k{k}:f{f}:n{n}|{cfg.count_dtype}|single|r0|c0")
+    return coords
+
+
+def check_goldens(repo_root: str,
+                  goldens_path: Optional[str] = None) -> List[Finding]:
+    """The sentinel-goldens ratchet: the committed canary goldens must
+    cover EXACTLY the canonical workload's digest coordinates.
+
+    Growth and shrinkage both fail loudly — an uncovered coordinate means
+    the canary plane silently stopped guarding a bucket; a stale
+    coordinate means the file describes executables the workload no
+    longer produces (false "uncovered" probes at serve time). Version
+    skew and unreadability are their own findings, same as the
+    compile-surface baseline.
+    """
+    from maskclustering_tpu.obs import digest as digest_mod
+    from maskclustering_tpu.obs.canary import (DEFAULT_GOLDENS_PATH,
+                                               GOLDENS_VERSION)
+
+    path = goldens_path or os.path.join(repo_root, DEFAULT_GOLDENS_PATH)
+    findings: List[Finding] = []
+    if not os.path.exists(path):
+        findings.append(Finding(
+            id=make_id("RETRACE.GOLDENS", "missing"),
+            check="RETRACE.GOLDENS", family="retrace",
+            message=f"no {DEFAULT_GOLDENS_PATH} at the repo root — the "
+                    f"canary sentinel is un-gated; generate one with "
+                    f"scripts/load_gen.py --write-goldens and commit it"))
+        return findings
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or not isinstance(
+                doc.get("goldens"), dict):
+            raise ValueError("not a goldens doc (missing 'goldens' map)")
+    except (OSError, ValueError) as e:
+        findings.append(Finding(
+            id=make_id("RETRACE.GOLDENS", "unreadable"),
+            check="RETRACE.GOLDENS", family="retrace",
+            message=f"canary goldens unreadable: {e}"))
+        return findings
+    if doc.get("version") != GOLDENS_VERSION \
+            or doc.get("digest_version") != digest_mod.DIGEST_VERSION:
+        findings.append(Finding(
+            id=make_id("RETRACE.GOLDENS", "version"),
+            check="RETRACE.GOLDENS", family="retrace",
+            message=f"canary goldens carry version "
+                    f"{doc.get('version')}/digest "
+                    f"{doc.get('digest_version')} but the code wants "
+                    f"{GOLDENS_VERSION}/{digest_mod.DIGEST_VERSION} — a "
+                    f"schema change without regeneration; rerun "
+                    f"--write-goldens and audit the diff"))
+        return findings
+    expected = expected_goldens_coords()
+    committed = set(doc["goldens"])
+    for coord in sorted(expected - committed):
+        findings.append(Finding(
+            id=make_id("RETRACE.GOLDENS", "uncovered", coord),
+            check="RETRACE.GOLDENS", family="retrace",
+            message=f"canary goldens shrank: canonical-workload "
+                    f"coordinate {coord} has no committed golden — the "
+                    f"sentinel cannot verify that bucket; regenerate "
+                    f"with --write-goldens"))
+    for coord in sorted(committed - expected):
+        findings.append(Finding(
+            id=make_id("RETRACE.GOLDENS", "stale", coord),
+            check="RETRACE.GOLDENS", family="retrace",
+            message=f"canary goldens grew: committed coordinate {coord} "
+                    f"is not produced by the canonical workload under "
+                    f"the census cfg — stale entry (knob or workload "
+                    f"change); audit it, then regenerate with "
+                    f"--write-goldens"))
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # the driver
 # ---------------------------------------------------------------------------
@@ -920,6 +1028,10 @@ def analyze_retrace(
     if not os.path.exists(marker):
         return findings
     findings += check_registry_stale(roots)
+    # the sentinel-goldens ratchet rides the same real-repo gate (it runs
+    # even when the surface baseline is missing — the two files ratchet
+    # independently)
+    findings += check_goldens(repo_root)
     baseline_path = surface_baseline or os.path.join(
         repo_root, DEFAULT_SURFACE_BASELINE)
     try:

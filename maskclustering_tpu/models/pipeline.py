@@ -61,6 +61,9 @@ class SceneResult(NamedTuple):
     table: MaskTable
     assignment: np.ndarray
     timings: Dict[str, float]
+    # mct-sentinel invariant digest (obs/digest.py) — None on paths that
+    # opt out; trailing default keeps historical 4-tuple constructors valid
+    digest: Optional[Dict] = None
 
 
 class DeviceHandoff(NamedTuple):
@@ -325,6 +328,12 @@ def run_scene_host(handoff: DeviceHandoff, cfg: PipelineConfig, *,
     with tracer.span("postprocess", scene=seq_name) as sp:
         post_timings: Dict[str, float] = {}
         from maskclustering_tpu.models.postprocess_device import run_postprocess
+        from maskclustering_tpu.obs import digest as sentinel
+
+        # sentinel: dispatch the invariant-digest program FIRST — it reads
+        # the handoff planes before any post-process kernel could donate
+        # them; its tiny uint32 output is pulled at the drain tail below
+        digest_dev = sentinel.digest_scene_device(handoff)
 
         objects = run_postprocess(
             cfg, handoff.scene_points, handoff.first_id, handoff.last_id,
@@ -338,6 +347,11 @@ def run_scene_host(handoff: DeviceHandoff, cfg: PipelineConfig, *,
         with obs.span("post.assignment.pull"):
             assignment = np.asarray(handoff.assignment)
         obs.count_transfer("d2h", assignment.nbytes, "post.drain")
+        # sentinel: the digest vector rides the same retired drain — one
+        # more O(1) DMA on the emit-only tail, zero new pipeline.host_sync
+        with obs.span("post.digest.pull"):
+            digest_vec = np.asarray(digest_dev)
+        obs.count_transfer("d2h", digest_vec.nbytes, "post.drain")
     timings["postprocess"] = sp.duration
     for k, v in post_timings.items():
         # phase wall times measured by the postprocess _PhaseTimer become
@@ -355,10 +369,22 @@ def run_scene_host(handoff: DeviceHandoff, cfg: PipelineConfig, *,
                          prediction_root=prediction_root,
                          top_k_repre=cfg.num_representative_masks)
 
+    # fault seam: "corrupt" silently bit-flips the pulled graph stat — it
+    # deliberately does NOT raise, so the retry/degradation ladder never
+    # heals it; only the digest comparison downstream can catch it
+    if faults.take_corruption("host", seq_name):
+        assignment = assignment.copy()
+        assignment[0] ^= 0x1
+
+    digest = sentinel.compose_scene_digest(
+        digest_vec, handoff, assignment, objects,
+        count_dtype=cfg.count_dtype)
+
     log.info("scene %s: %d objects, timings %s", seq_name, len(objects.point_ids_list),
              {k: round(v, 3) for k, v in timings.items()})
     return SceneResult(objects=objects, table=handoff.table,
-                       assignment=assignment, timings=timings)
+                       assignment=assignment, timings=timings,
+                       digest=digest)
 
 
 def run_scene(tensors: SceneTensors, cfg: PipelineConfig, *, k_max: Optional[int] = None,

@@ -623,11 +623,24 @@ class StreamAccumulator:
                 assignment,
                 chunk_frames=self.chunk_frames,
                 min_points=max(cfg.dbscan_split_min_points, 1))
+            # sentinel: per-chunk accumulator digest (obs/digest.py) —
+            # dispatched here, pulled inside the SAME sanctioned window as
+            # the partial count, so the per-chunk host-sync contract
+            # (two booked syncs) is unchanged
+            from maskclustering_tpu.obs import digest as sentinel
+            chunk_vec_dev = sentinel.digest_stream_device(
+                assignment, active, rep_plane)
             # the anytime scalar: live partial-instance count, one 4-byte
             # pull (drains the chunk's dispatch chain)
             with sanctioned_pull("stream.partials"):
                 partial = int(partial)
+                chunk_vec = np.asarray(chunk_vec_dev)
             obs.count("stream.host_sync")
+            # fault seam: scripted silent corruption of the pulled chunk
+            # digest — surfaces only as drift, never as a retryable error
+            if faults.take_corruption("chunk", self.seq_name):
+                chunk_vec = chunk_vec.copy()
+                chunk_vec[0] ^= 0x1
 
             # ---- transaction point: every program dispatched — bind ----
             with self._bind_lock:
@@ -672,6 +685,7 @@ class StreamAccumulator:
                 "partial_instances": partial,
                 "plane_bytes": int(plane_bytes),
                 "seconds": round(seconds, 4),
+                "digest": sentinel.chunk_digest_hex(chunk_vec),
                 "done": self.frames_done >= self.total_frames}
 
     # -- global table / export ----------------------------------------------
@@ -786,9 +800,15 @@ class StreamAccumulator:
                                  self.cfg.config_name, object_dict_dir,
                                  prediction_root=prediction_root,
                                  top_k_repre=self.cfg.num_representative_masks)
+        from maskclustering_tpu.obs import digest as sentinel
+        digest = sentinel.artifact_only_digest(
+            objects,
+            bucket=sentinel.bucket_label(self.k_max, self.f_chunk_pad,
+                                         self.n_pad),
+            count_dtype=self.cfg.count_dtype)
         return SceneResult(objects=objects, table=self.global_table(),
                            assignment=assignment,
-                           timings=dict(self.timings))
+                           timings=dict(self.timings), digest=digest)
 
     # -- accumulator journal (crash resume) ---------------------------------
 
@@ -969,4 +989,5 @@ def stream_scene(tensors: SceneTensors, cfg: PipelineConfig, *,
     timings["stream.total"] = round(time.perf_counter() - t0, 4)
     timings["stream.num_chunks"] = float(acc.n_chunks)
     return SceneResult(objects=result.objects, table=result.table,
-                       assignment=result.assignment, timings=timings)
+                       assignment=result.assignment, timings=timings,
+                       digest=result.digest)

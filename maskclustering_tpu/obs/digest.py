@@ -1,0 +1,258 @@
+"""mct-sentinel: device-side invariant digests for correctness observability.
+
+The pipeline's whole contract is that every coordinate — count_dtype
+encodings, mesh shards, degradation rungs, streaming chunks, crash
+respawns — produces BYTE-IDENTICAL instances (PAPER.md §1: exact integer
+view-consensus). This module turns that contract into a runtime signal: a
+jitted exact-integer reduction over the device-resident claim planes and
+graph/cluster state collapses a scene's intermediate state into a tiny
+uint32 vector, and a host composition folds in the mask table, the pulled
+assignment, NaN/Inf counts over the f32 geometry, and a canonical hash of
+the exported instances.
+
+Everything is modular uint32 arithmetic (associative + commutative, mod
+2**32 exact) so the digest is reduction-order invariant and therefore
+byte-stable across executors, shard layouts, and XLA scheduling — any two
+coordinates that claim identity MUST produce the same digest, and any
+silent corruption flips it.
+
+The device program's output rides the existing emit-only post-process
+drain in ``run_scene_host`` (one extra O(1) DMA after every kernel has
+retired); ``pipeline.host_sync`` stays exactly 1. Internally everything is
+cast to fixed int32/uint32, so the program has no count_dtype or donation
+key axes and compiles once per scene bucket — it joins SERVING_PROGRAMS
+and the compile-surface census like every other serving program.
+
+Digest schema (``version`` bumps invalidate committed goldens)::
+
+    {"v": 1, "bucket": "k63:f32:n16384", "count_dtype": "u32",
+     "plane": "<crc32 hex8>", "artifact": "<crc32 hex8>", "nan_inf": 0}
+
+``plane`` fingerprints the device-side invariants (claim planes, graph
+stats, assignment, mask table) — present on every DeviceHandoff path.
+``artifact`` fingerprints the final SceneObjects — universal, including
+the fused mesh path and the multi-chunk streaming finalize which never
+materialize a handoff.
+"""
+from __future__ import annotations
+
+import functools
+import zlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DIGEST_VERSION = 1
+
+# Knuth multiplicative hash constants — position weights for the wrapped
+# uint32 checksums (weight(i) = i * MULT + OFFS mod 2**32)
+_W_MULT = 2654435761
+_W_OFFS = 0x9E3779B9
+
+
+def _wsum(x: jnp.ndarray) -> jnp.ndarray:
+    """Position-weighted uint32 checksum (exact, order-invariant)."""
+    v = x.reshape(-1).astype(jnp.uint32)
+    w = (jnp.arange(v.shape[0], dtype=jnp.uint32) * jnp.uint32(_W_MULT)
+         + jnp.uint32(_W_OFFS))
+    return jnp.sum(v * w, dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit)
+def _digest_scene_impl(
+    first_id: jnp.ndarray,      # (F, N) int16 claim plane
+    last_id: jnp.ndarray,       # (F, N) int16 claim plane
+    assignment: jnp.ndarray,    # (M_pad,) int32 mask -> cluster rep
+    active: jnp.ndarray,        # (M_pad,) bool
+    node_visible: jnp.ndarray,  # (M_pad, F) bool graph stat
+) -> jnp.ndarray:
+    """Scene invariant digest: (8,) uint32, exact-integer reductions only.
+
+    Components: claim-plane popcounts + position checksums (first/last),
+    assignment histogram checksum, active popcount, node-visible row-sum
+    checksum, active-masked assignment checksum. No f32 enters the
+    reduction, so the vector is bit-exact on any backend.
+    """
+    m = assignment.shape[0]
+    hist = jnp.zeros((m + 1,), jnp.uint32).at[
+        jnp.clip(assignment, 0, m)].add(jnp.uint32(1))
+    row_sums = jnp.sum(node_visible.astype(jnp.uint32), axis=1,
+                       dtype=jnp.uint32)
+    return jnp.stack([
+        jnp.count_nonzero(first_id).astype(jnp.uint32),
+        _wsum(first_id),
+        jnp.count_nonzero(last_id).astype(jnp.uint32),
+        _wsum(last_id),
+        _wsum(hist),
+        jnp.count_nonzero(active).astype(jnp.uint32),
+        _wsum(row_sums),
+        _wsum(jnp.where(active, assignment + 1, 0)),
+    ])
+
+
+@functools.partial(jax.jit)
+def _digest_stream_impl(
+    assignment: jnp.ndarray,  # (M_pad,) int32 global accumulator state
+    active: jnp.ndarray,      # (M_pad,) bool
+    rep_plane: jnp.ndarray,   # (N_pad,) int32 point -> rep slot + 1
+) -> jnp.ndarray:
+    """Streaming-accumulator digest: (4,) uint32 over the post-bind state
+    of one chunk (assignment, active set, point->rep plane)."""
+    return jnp.stack([
+        jnp.count_nonzero(active).astype(jnp.uint32),
+        _wsum(jnp.where(active, assignment + 1, 0)),
+        jnp.count_nonzero(rep_plane).astype(jnp.uint32),
+        _wsum(rep_plane),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# dispatch helpers (device side — no sync)
+# ---------------------------------------------------------------------------
+
+
+def digest_scene_device(handoff) -> jnp.ndarray:
+    """Dispatch the scene digest program over a DeviceHandoff's arrays.
+
+    Returns the DEVICE vector (no pull) — dispatch this before the
+    post-process kernels so a donating kernel can't invalidate an input,
+    and pull it at the drain tail where every kernel has retired.
+    """
+    return _digest_scene_impl(handoff.first_id, handoff.last_id,
+                              handoff.assignment, handoff.active,
+                              handoff.node_visible)
+
+
+def digest_stream_device(assignment, active, rep_plane) -> jnp.ndarray:
+    """Dispatch the streaming-accumulator digest (device vector, no pull)."""
+    return _digest_stream_impl(assignment, active, rep_plane)
+
+
+# ---------------------------------------------------------------------------
+# host composition
+# ---------------------------------------------------------------------------
+
+
+def _crc(data: bytes, seed: int = 0) -> int:
+    return zlib.crc32(data, seed) & 0xFFFFFFFF
+
+
+def table_hash(table) -> int:
+    """Exact uint32 hash of a MaskTable's identifying rows."""
+    seed = _crc(np.asarray(table.frame, np.int32).tobytes())
+    seed = _crc(np.asarray(table.mask_id, np.int32).tobytes(), seed)
+    seed = _crc(np.asarray(table.valid, np.uint8).tobytes(), seed)
+    return _crc(np.asarray([table.num_masks, table.k_max],
+                           np.int32).tobytes(), seed)
+
+
+def nan_inf_count(scene_points: np.ndarray) -> int:
+    """Non-finite count over the f32 geometry (host numpy, no device op)."""
+    return int(np.count_nonzero(~np.isfinite(scene_points)))
+
+
+def artifact_digest(objects) -> str:
+    """Canonical hex8 fingerprint of a SceneObjects (the exported answer).
+
+    Serializes every instance's point ids and (frame, mask, coverage)
+    support rows in their deterministic export order — byte-identity of
+    this hash IS the repo's cross-coordinate identity claim.
+    """
+    seed = _crc(np.asarray([len(objects.point_ids_list),
+                            int(objects.num_points)], np.int64).tobytes())
+    for pids, masks in zip(objects.point_ids_list, objects.mask_list):
+        seed = _crc(np.asarray(pids, np.int64).tobytes(), seed)
+        for row in masks:
+            frame_id, mask_id, coverage = row[0], row[1], row[2]
+            seed = _crc(str(frame_id).encode(), seed)
+            seed = _crc(np.asarray([int(mask_id)], np.int64).tobytes(), seed)
+            seed = _crc(np.asarray([coverage], np.float64).tobytes(), seed)
+    return f"{seed:08x}"
+
+
+def plane_digest(vec_host: np.ndarray, table, assignment_host: np.ndarray,
+                 nan_inf: int) -> str:
+    """Hex8 of the device invariant vector + mask table + pulled assignment."""
+    seed = _crc(np.asarray(vec_host, np.uint32).tobytes())
+    seed = _crc(np.asarray([table_hash(table)], np.uint32).tobytes(), seed)
+    seed = _crc(np.asarray(assignment_host, np.int32).tobytes(), seed)
+    seed = _crc(np.asarray([nan_inf], np.int64).tobytes(), seed)
+    return f"{seed:08x}"
+
+
+def bucket_label(k_max: int, f_pad: int, n_pad: int) -> str:
+    """The census bucket coordinate string (same grammar as the retrace
+    compile-surface rows): ``k63:f32:n16384``."""
+    return f"k{k_max}:f{f_pad}:n{n_pad}"
+
+
+def compose_scene_digest(vec_host: np.ndarray, handoff, assignment_host:
+                         np.ndarray, objects, *, count_dtype: str) -> Dict:
+    """Fold device vector + host components into the scene digest dict."""
+    f_pad, n_pad = handoff.first_id.shape
+    nan_inf = nan_inf_count(handoff.scene_points)
+    return {
+        "v": DIGEST_VERSION,
+        "bucket": bucket_label(handoff.k_max, f_pad, n_pad),
+        "count_dtype": count_dtype,
+        "plane": plane_digest(vec_host, handoff.table, assignment_host,
+                              nan_inf),
+        "artifact": artifact_digest(objects),
+        "nan_inf": nan_inf,
+    }
+
+
+def artifact_only_digest(objects, *, bucket: str, count_dtype: str) -> Dict:
+    """Digest for paths that never materialize a DeviceHandoff (the fused
+    mesh batch, the multi-chunk streaming finalize): artifact hash only."""
+    return {
+        "v": DIGEST_VERSION,
+        "bucket": bucket,
+        "count_dtype": count_dtype,
+        "plane": "",
+        "artifact": artifact_digest(objects),
+        "nan_inf": 0,
+    }
+
+
+def chunk_digest_hex(vec_host: np.ndarray) -> str:
+    """Hex8 of one streaming chunk's accumulator digest vector."""
+    return f"{_crc(np.asarray(vec_host, np.uint32).tobytes()):08x}"
+
+
+# ---------------------------------------------------------------------------
+# coordinates
+# ---------------------------------------------------------------------------
+
+
+def digest_coord(digest: Optional[Dict], *, mesh: str = "single",
+                 rung: int = 0, chunk: int = 0) -> str:
+    """The full census coordinate a digest was observed at.
+
+    ``<bucket>|<count_dtype>|<mesh>|r<rung>|c<chunk>`` — the key goldens
+    are stored under and drift is attributed to. ``chunk`` is the
+    streaming chunk count (0 = batch).
+    """
+    if not digest:
+        return ""
+    return (f"{digest.get('bucket', '?')}|{digest.get('count_dtype', '?')}"
+            f"|{mesh or 'single'}|r{int(rung)}|c{int(chunk)}")
+
+
+def digests_match(a: Optional[Dict], b: Optional[Dict]) -> bool:
+    """Byte-for-byte digest equality (version-aware: a version skew is a
+    mismatch, not an error — regenerate goldens)."""
+    if not a or not b:
+        return False
+    keys = ("v", "plane", "artifact", "nan_inf")
+    return all(a.get(k) == b.get(k) for k in keys)
+
+
+def diff_digests(a: Optional[Dict], b: Optional[Dict]) -> list:
+    """Field names that differ between two digests (drift attribution)."""
+    if not a or not b:
+        return ["missing"]
+    return [k for k in ("v", "plane", "artifact", "nan_inf")
+            if a.get(k) != b.get(k)]

@@ -41,6 +41,7 @@ KIND_METRICS = "metrics"
 KIND_COST = "cost"  # compile-time cost observatory rows (obs/cost.py)
 KIND_ANALYSIS = "analysis"  # mct-check findings/summary (analysis/__main__.py)
 KIND_TELEMETRY = "telemetry"  # windowed serving snapshots (obs/telemetry.py)
+KIND_DRIFT = "canary.drift"  # mct-sentinel golden-probe drift (obs/canary.py)
 
 
 class ReadStats:

@@ -156,6 +156,22 @@ def run_row(report: Dict, **extra) -> Dict:
         row["degradations"] = sum(faults["degradations"].values())
     if faults.get("interrupted"):
         row["interrupted"] = True
+    # mct-sentinel stamp: the census coordinates the run's digests were
+    # observed at plus one combined artifact fingerprint over all ok
+    # scenes — --regress attributes a digest change to a coordinate/knob
+    # flip before anyone reads it as code drift (and vice versa)
+    coords = sorted({s.get("digest_coord") for s in scenes
+                     if s.get("status") == "ok" and s.get("digest_coord")})
+    if coords:
+        row["digest_coord"] = ",".join(coords)
+        import zlib
+
+        seed = 0
+        for s in sorted(scenes, key=lambda s: s.get("seq_name") or ""):
+            art = ((s.get("digest") or {}).get("artifact") or "")
+            seed = zlib.crc32(
+                f"{s.get('seq_name')}:{art}".encode(), seed) & 0xFFFFFFFF
+        row["digest"] = f"{seed:08x}"
     row.update(extra)
     return row
 
@@ -179,7 +195,11 @@ def serve_row(verdict: Dict, **extra) -> Dict:
               "retrace_compiles", "retrace_repeats", "retrace_post_freeze",
               "retrace_cache_hits", "aot_restored", "worker_crashes",
               "worker_respawns", "telemetry_windows", "window_p95",
-              "tenants", "error"):
+              "tenants", "error",
+              # mct-sentinel: canary probe accounting (fenced from the
+              # latency headline — canaries never enter the latency
+              # window) and the coordinates the probes verified
+              "canary_probes", "canary_drift", "digest_coord"):
         if verdict.get(k) is not None:
             row[k] = verdict[k]
     row.update(extra)
@@ -195,6 +215,18 @@ def tenant_dimension(row: Optional[Dict]) -> bool:
     row never gates against an untenanted baseline, and vice versa.
     """
     return bool((row or {}).get("tenants"))
+
+
+def sentinel_dimension(row: Optional[Dict]) -> bool:
+    """True when a ledger row recorded canary digest drift (mct-sentinel).
+
+    A row measured while the correctness plane was tripping (a corruption
+    drill, a real SDC event) is not a perf datapoint: --regress fences the
+    dimension BOTH ways, like ``tenant_dimension`` — a drifted row never
+    gates against a clean baseline, and a clean row never gates against a
+    drifted one.
+    """
+    return bool((row or {}).get("canary_drift"))
 
 
 def tier1_row(wall_s: float, passed: int, **extra) -> Dict:
@@ -340,6 +372,28 @@ def check_regression(current: Optional[Dict], baseline: Optional[Dict], *,
                 f"{'y' if retries == 1 else 'ies'} and {degr} "
                 f"degradation(s) [fault attribution — the delta may be "
                 f"the fault's, not code drift]")
+    # sentinel attribution: a digest change at an UNCHANGED coordinate is
+    # code drift in the outputs themselves — say so louder than any perf
+    # delta; a coordinate change explains a digest change before anyone
+    # blames code (the knob-flip move, applied to correctness)
+    cur_dc, base_dc = current.get("digest_coord"), baseline.get("digest_coord")
+    cur_dg, base_dg = current.get("digest"), baseline.get("digest")
+    if cur_dc and base_dc and cur_dc != base_dc:
+        lines.append(f"  digest_coord: {base_dc} -> {cur_dc} [coordinate "
+                     f"change — digests are per-coordinate; not comparable]")
+    elif cur_dg and base_dg and cur_dg != base_dg:
+        cause = ("the flipped knob changed the observed coordinate set"
+                 if knob_flips else
+                 "OUTPUTS CHANGED at an unchanged coordinate — code drift "
+                 "in the answers; audit before regenerating canary goldens")
+        lines.append(f"  sentinel: run digest {base_dg} -> {cur_dg} "
+                     f"[{cause}]")
+    for label, r in (("current", current), ("baseline", baseline)):
+        if r.get("canary_drift"):
+            lines.append(
+                f"  {label} row recorded {int(r['canary_drift'])} canary "
+                f"drift event(s) [sentinel fence — correctness was "
+                f"violated while measuring; not a perf datapoint]")
     cur_stages = current.get("stages") or {}
     base_stages = baseline.get("stages") or {}
     for k in sorted(set(cur_stages) & set(base_stages)):

@@ -26,9 +26,9 @@ import sys
 from typing import Dict, List, Optional
 
 from maskclustering_tpu.obs.events import (KIND_ANALYSIS, KIND_COST,
-                                           KIND_METRICS, KIND_SPAN,
-                                           KIND_TELEMETRY, ReadStats,
-                                           read_events)
+                                           KIND_DRIFT, KIND_METRICS,
+                                           KIND_SPAN, KIND_TELEMETRY,
+                                           ReadStats, read_events)
 
 log = logging.getLogger("maskclustering_tpu")
 
@@ -53,6 +53,7 @@ class RunData:
         self.cost_rows: List[Dict] = []  # cost-observatory events, in order
         self.analysis_rows: List[Dict] = []  # mct-check findings/summaries
         self.telemetry_rows: List[Dict] = []  # windowed serving snapshots
+        self.drift_rows: List[Dict] = []  # mct-sentinel canary drift events
         self.hbm_high_water: Optional[float] = None
         self.read_stats = ReadStats()  # torn/unknown lines: counted, warned
         metrics_by_pid: Dict = {}  # counters are monotonic PER PROCESS:
@@ -82,6 +83,8 @@ class RunData:
                 self.analysis_rows.append(ev)
             elif kind == KIND_TELEMETRY:
                 self.telemetry_rows.append(ev)
+            elif kind == KIND_DRIFT:
+                self.drift_rows.append(ev)
             elif kind == KIND_METRICS:
                 metrics_by_pid[ev.get("pid")] = ev.get("metrics") or {}
         if self.read_stats.skipped:
@@ -492,6 +495,62 @@ def render_slo(run: "RunData", spec_path: Optional[str] = None) \
     return "\n".join(["== SLO =="] + slo_mod.render_result(result))
 
 
+def render_correctness(run: "RunData") -> Optional[str]:
+    """The Correctness section (mct-sentinel): canary probe volume, the
+    drift matrix per coordinate, and last-verified recency per bucket.
+
+    Rendered only when the events carry canary evidence (``canary.*``
+    counters or ``canary.drift`` rows) — batch reports are unchanged. A
+    clean section is one line; a drifted one names every coordinate whose
+    outputs stopped matching the committed goldens, which fields moved,
+    and when the coordinate was last verified clean.
+    """
+    c = run._counters
+    probes = int(c.get("canary.probes", 0))
+    drift = int(c.get("canary.drift", 0))
+    if not probes and not drift and not run.drift_rows:
+        return None
+    lines = ["== correctness (mct-sentinel) =="]
+    head = f"canary probes {probes} | drift {drift}"
+    skipped = int(c.get("canary.skipped_busy", 0))
+    if skipped:
+        head += f" | ticks skipped busy {skipped}"
+    head += (" [DRIFT — outputs diverged from committed goldens]"
+             if drift or run.drift_rows
+             else " | every probe byte-identical to goldens")
+    lines.append(head)
+    # the drift matrix: coordinate -> occurrence count + moved fields +
+    # when this run last saw the coordinate clean (ok windows carry no
+    # event, so recency comes from the telemetry ring's clean windows)
+    by_coord: Dict[str, Dict] = {}
+    for ev in run.drift_rows:
+        coord = str(ev.get("coord") or "?")
+        row = by_coord.setdefault(coord, {"n": 0, "fields": set(),
+                                          "scene": ev.get("scene"),
+                                          "first_ts": ev.get("ts")})
+        row["n"] += 1
+        for f in ev.get("fields") or ():
+            row["fields"].add(str(f))
+    last_clean_ts = None
+    for r in run.telemetry_rows:
+        if int(r.get("canary_probes", 0) or 0) \
+                and not int(r.get("drift", 0) or 0):
+            ts = r.get("ts")
+            if ts is not None and (last_clean_ts is None
+                                   or ts > last_clean_ts):
+                last_clean_ts = ts
+    for coord in sorted(by_coord):
+        row = by_coord[coord]
+        line = (f"  DRIFT {coord} (scene {row['scene']}): x{row['n']} | "
+                f"fields {','.join(sorted(row['fields'])) or '?'}")
+        if last_clean_ts is not None and row["first_ts"] is not None:
+            line += (f" | last verified clean "
+                     f"{max(row['first_ts'] - last_clean_ts, 0.0):.1f}s "
+                     f"before first drift")
+        lines.append(line)
+    return "\n".join(lines)
+
+
 def render_telemetry_windows(rows: List[Dict]) -> Optional[str]:
     """One-line digest of the windowed telemetry ring (obs/telemetry.py
     rows the daemon's ticker appended): window count, request volume,
@@ -641,6 +700,9 @@ def render_report(run: RunData, slo_spec: Optional[str] = None) -> str:
     slo_sec = render_slo(run, slo_spec)
     if slo_sec:
         out.append(slo_sec)
+    correctness_sec = render_correctness(run)
+    if correctness_sec:
+        out.append(correctness_sec)
     streaming_sec = render_streaming(run)
     if streaming_sec:
         out.append(streaming_sec)
@@ -871,6 +933,12 @@ def _regress_eval(ledger_path: str, baseline_path: str,
     # untenanted baseline never gates a tenant-dimension row
     tenancy = led.tenant_dimension(baseline or {})
     rows = [r for r in rows if led.tenant_dimension(r) == tenancy]
+    # sentinel fence, both ways (mct-sentinel): a row that recorded canary
+    # digest drift measured a run whose OUTPUTS were wrong — its latency
+    # is a drill's (or an incident's), never a perf baseline, and a clean
+    # row must not gate against a drifted baseline either
+    drifted = led.sentinel_dimension(baseline or {})
+    rows = [r for r in rows if led.sentinel_dimension(r) == drifted]
     # gate comparable rows: a run-row median must not be compared against a
     # bench baseline just because it is the newest numeric row
     current = None

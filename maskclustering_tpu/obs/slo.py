@@ -17,8 +17,10 @@ telemetry ring (obs/telemetry.py snapshots — live over the wire, or the
 Objective kinds: ``latency_p95`` / ``latency_p50`` (worst bucket in the
 window, or one bucket via ``"bucket"``), ``error_rate`` (non-ok
 terminal statuses + crashes over requests), ``queue_wait_p95``,
-``post_warm_compiles`` and ``crash_count`` (absolute counts; threshold
-is the allowed total). An objective may scope to one tenant with
+``post_warm_compiles``, ``crash_count`` and ``drift_count`` (absolute
+counts; threshold is the allowed total — ``drift_count`` reads the
+canary digest-mismatch field the telemetry window folds in, the
+mct-sentinel correctness signal). An objective may scope to one tenant with
 ``"tenant"`` — it then reads the per-tenant sub-windows the aggregator
 maintains.
 
@@ -27,8 +29,12 @@ measured over the SHORT window (the newest ``windows.short`` ring rows)
 and the LONG window (the newest ``windows.long`` rows); ``burn`` =
 observed / threshold, and the objective is **violated only when both
 windows burn past 1.0** — a single bad window does not page, a
-sustained one does. Count-style objectives with threshold 0 violate on
-any occurrence in the long window. Windows with no traffic produce no
+sustained one does. Zero-threshold counts burn at the observed count
+itself, so a lone occurrence (burn exactly 1.0) stays on the right
+side of the strict ``>`` rule — EXCEPT ``drift_count``, which is
+zero-tolerance: any occurrence in the long window violates, because a
+canary digest mismatch is silent corruption, not a budgetable
+degradation. Windows with no traffic produce no
 verdict (``no_data``) rather than a fake pass/fail number — the
 empty-window render path must never divide by zero or take a
 percentile of nothing.
@@ -56,7 +62,7 @@ log = logging.getLogger("maskclustering_tpu")
 SLO_SCHEMA_VERSION = 1
 
 KINDS = ("latency_p95", "latency_p50", "error_rate", "queue_wait_p95",
-         "post_warm_compiles", "crash_count")
+         "post_warm_compiles", "crash_count", "drift_count")
 
 # statuses that count against the error budget (the non-ok terminal
 # classes the aggregator tracks; "skipped" is an artifact no-op, not an
@@ -74,6 +80,9 @@ DEFAULT_SPEC: Dict = {
          "threshold": 120.0},
         {"name": "no-post-warm-compiles", "kind": "post_warm_compiles",
          "threshold": 0},
+        # zero tolerance: any canary digest drift is silent corruption,
+        # not a budgetable degradation (mct-sentinel correctness plane)
+        {"name": "correctness", "kind": "drift_count", "threshold": 0},
     ],
 }
 
@@ -191,6 +200,10 @@ def _observe(obj: Dict, rows: List[Dict]) -> Optional[float]:
                          for s in scoped))
     if kind == "crash_count":
         return float(sum(int(s.get("crashes", 0) or 0) for s in scoped))
+    if kind == "drift_count":
+        # canary digest mismatches folded into the window by the
+        # aggregator (obs/telemetry.py "drift") — correctness, not speed
+        return float(sum(int(s.get("drift", 0) or 0) for s in scoped))
     return None
 
 
@@ -225,9 +238,17 @@ def evaluate(spec: Dict, snapshot: Dict) -> Dict:
         obs_long = _observe(obj, long_rows)
         b_short = _burn(obs_short, obj["threshold"])
         b_long = _burn(obs_long, obj["threshold"])
+        # drift_count at threshold 0 is zero-tolerance: one canary
+        # digest mismatch anywhere in the long window pages — silent
+        # corruption has no burn budget to amortize against
+        zero_tol = obj["kind"] == "drift_count" and obj["threshold"] <= 0
         if b_short is None and b_long is None:
             state = "no_data"
-        elif (b_short is not None and b_short > 1.0
+        elif zero_tol and obs_long is not None and obs_long > 0:
+            state = "violated"
+            ok = False
+        elif (not zero_tol
+              and b_short is not None and b_short > 1.0
               and b_long is not None and b_long > 1.0):
             # the two-window rule: both the fast signal and the
             # sustained one must burn past budget before this pages

@@ -54,7 +54,7 @@ KIND_TELEM = "telem"      # the stdio-pipe message kind (worker -> parent)
 RELAY_SPAN_CAP = 1024
 # counter families worth shipping verbatim in a window's cumulative view
 CUMULATIVE_PREFIXES = ("serve.", "retrace.", "aot_cache.", "worker.",
-                      "pipeline.", "run.", "compile_cache.")
+                      "pipeline.", "run.", "compile_cache.", "canary.")
 
 
 def _bucket_key(bucket) -> str:
@@ -486,6 +486,11 @@ class WindowAggregator:
                 "requeued": int(deltas.get("serve.requests_requeued", 0)),
                 "aot_hits": int(deltas.get("aot_cache.hits", 0)),
                 "post_warm_compiles": int(max(pf_delta, 0)),
+                # mct-sentinel: canary drift occurrences this window (the
+                # SLO ``correctness`` objective reads this field; probes
+                # ride along for the panel's coverage view)
+                "drift": int(deltas.get("canary.drift", 0)),
+                "canary_probes": int(deltas.get("canary.probes", 0)),
                 "queue_depth": int(gauges.get("serve.queue_depth", 0)),
                 "latency": {k: v for k, v in latency.items() if v},
             }

@@ -172,6 +172,33 @@ def render_top(stats: Dict, *, now: Optional[float] = None) -> str:
                 f"| device {float(t.get('device_s', 0.0)):.3f}s "
                 f"| d2h {int(t.get('d2h_bytes', 0))}B")
 
+    # the mct-sentinel panel: canary probe volume + drift, live. The
+    # summary rides every status answer of a sentinel-armed daemon; the
+    # per-coordinate matrix only a ``detail=sentinel`` poll.
+    canary = stats.get("canary")
+    sentinel = stats.get("sentinel") or {}
+    if canary or sentinel.get("rounds") is not None:
+        rounds = int((canary or {}).get("rounds",
+                                        sentinel.get("rounds", 0)) or 0)
+        drift_total = int((canary or {}).get(
+            "drift_total", sentinel.get("drift_total", 0)) or 0)
+        ring_drift = int(sum(w.get("drift", 0) or 0 for w in windows))
+        line = (f"sentinel: canary rounds {rounds} | drift {drift_total}"
+                + (" [DRIFT — outputs diverged from goldens]"
+                   if drift_total or ring_drift else " | goldens hold"))
+        skipped = int(sentinel.get("skipped_busy", 0) or 0)
+        if skipped:
+            line += f" | ticks skipped busy {skipped}"
+        lines.append(line)
+        ages = sentinel.get("last_verified_age_s") or {}
+        drift_coords = sentinel.get("drift_coords") or {}
+        for coord in sorted(set(ages) | set(drift_coords)):
+            mark = (f"DRIFT x{drift_coords[coord]}"
+                    if coord in drift_coords else "ok")
+            age = (f"verified {ages[coord]:.0f}s ago"
+                   if coord in ages else "never verified")
+            lines.append(f"  {coord:<44} {mark:<10} {age}")
+
     # the SLO burn-rate panel (status detail=slo answers only)
     slo = stats.get("slo")
     if slo is not None:
@@ -186,7 +213,11 @@ def _poll(address, timeout_s: float) -> Dict:
 
     with ServeClient(address, timeout_s=timeout_s) as client:
         # detail=slo is telemetry plus the armed spec's burn-rate verdict
-        return client.slo()
+        stats = client.slo()
+        if stats.get("canary") is not None:
+            # sentinel-armed daemon: add the per-coordinate drift matrix
+            stats["sentinel"] = client.sentinel().get("sentinel")
+        return stats
 
 
 def main(argv=None) -> int:

@@ -19,6 +19,9 @@ ordered timeline with per-segment durations:
   wait -> attempt -> CRASH -> wait -> attempt -> result;
 - per-request RunJournal rows (``--journal DIR`` -> ``DIR/<id>.jsonl``):
   attempt starts, ``interrupted`` crash stamps, and the final outcome;
+- ``canary.drift`` event marks (mct-sentinel, obs/canary.py): drift
+  detected around this request's window renders as a zero-width
+  ``CANARY DRIFT`` mark — correctness context next to the latency story;
 - ``--blackbox DUMP`` merges a flight-recorder postmortem
   (obs/flight.py): span rows dedup against the live events, everything
   else becomes zero-width black-box marks — the child-side spans a
@@ -37,7 +40,8 @@ import os
 import sys
 from typing import Dict, List, Optional
 
-from maskclustering_tpu.obs.events import KIND_SPAN, ReadStats, read_events
+from maskclustering_tpu.obs.events import (KIND_DRIFT, KIND_SPAN, ReadStats,
+                                           read_events)
 
 # spans that ARE the request skeleton (matched by attrs.request == id)
 _SKELETON = ("serve.queue_wait", "serve.request", "serve.worker_crash")
@@ -74,7 +78,12 @@ def assemble_trace(request_id: str, events_path: str,
     stats = ReadStats()
     skeleton: List[Dict] = []
     others: List[Dict] = []
-    for ev in read_events(events_path, kinds=[KIND_SPAN], stats=stats):
+    drift_rows: List[Dict] = []
+    for ev in read_events(events_path, kinds=[KIND_SPAN, KIND_DRIFT],
+                          stats=stats):
+        if ev.get("kind") == KIND_DRIFT:
+            drift_rows.append(ev)
+            continue
         name = ev.get("name")
         attrs = ev.get("attrs") or {}
         if name in _SKELETON and attrs.get("request") == request_id:
@@ -114,6 +123,23 @@ def assemble_trace(request_id: str, events_path: str,
     for row in _journal_rows(request_id, journal_dir, warnings):
         segments.append(row)
     segments.extend(marks)
+
+    # mct-sentinel drift marks: canary drift is daemon-wide (probes carry
+    # no request id), so mark any drift detected around this request's
+    # window — an answer computed next to detected corruption deserves
+    # the flag in its own timeline
+    if drift_rows and segments:
+        lo = min(s["t0"] for s in segments)
+        hi = max(s["t1"] for s in segments)
+        for ev in drift_rows:
+            ts = float(ev.get("ts", 0.0))
+            if lo - 1.0 <= ts <= hi + 1.0:
+                fields = ",".join(ev.get("fields") or []) or "?"
+                segments.append({
+                    "t0": ts, "t1": ts, "dur_s": 0.0, "kind": "drift",
+                    "label": "CANARY DRIFT",
+                    "detail": (f"coord {ev.get('coord', '?')} fields "
+                               f"{fields} (daemon-wide)")[:140]})
 
     segments.sort(key=lambda s: (s["t0"], s["t1"]))
     if not segments:

@@ -77,6 +77,12 @@ class SceneStatus:
     attempts: int = 1
     degradation_rung: int = 0
     error_class: str = ""
+    # mct-sentinel (obs/digest.py): the scene's invariant digest and the
+    # census coordinate it was observed at — byte-identical across
+    # executors/dtypes/rungs by contract, so the ledger and --regress can
+    # attribute any digest change to a knob flip vs code drift
+    digest: Optional[Dict] = None
+    digest_coord: str = ""
 
 
 @dataclasses.dataclass
@@ -263,6 +269,21 @@ class _FaultCtx:
         return st
 
 
+def _stamp_digest(st: SceneStatus, result, cfg: PipelineConfig,
+                  mesh_label: str = "single") -> SceneStatus:
+    """Stamp a SceneResult's sentinel digest + full census coordinate onto
+    the (already rung-attributed) SceneStatus."""
+    from maskclustering_tpu.obs import digest as sentinel
+
+    digest = getattr(result, "digest", None)
+    if digest:
+        st.digest = digest
+        st.digest_coord = sentinel.digest_coord(
+            digest, mesh=mesh_label, rung=st.degradation_rung,
+            chunk=cfg.streaming_chunk)
+    return st
+
+
 def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
                   prediction_root: Optional[str] = None,
                   _preloaded=None, _ctx: Optional[_FaultCtx] = None) -> SceneStatus:
@@ -323,10 +344,11 @@ def cluster_scene(cfg: PipelineConfig, seq_name: str, *, resume: bool = True,
                                        prediction_root=prediction_root),
                 cfg.watchdog_host_s, seam="host", scene=seq_name)
         obs.count("run.scenes_ok")
-        return ctx.finish(SceneStatus(
+        return _stamp_digest(ctx.finish(SceneStatus(
             seq_name, "ok", time.perf_counter() - t0,
             num_objects=len(result.objects.point_ids_list),
-            timings={k: round(v, 4) for k, v in result.timings.items()}))
+            timings={k: round(v, 4) for k, v in result.timings.items()})),
+            result, cfg)
     except Exception as e:
         log.exception("scene %s failed", seq_name)
         obs.count("run.scenes_failed")
@@ -473,10 +495,11 @@ def _cluster_scenes_overlapped(cfg: PipelineConfig, seq_names: Sequence[str], *,
                 seq, "failed", t_end - t0, error=err, error_class=err_class))
             return
         obs.count("run.scenes_ok")
-        statuses[seq] = ctx.finish(SceneStatus(
+        statuses[seq] = _stamp_digest(ctx.finish(SceneStatus(
             seq, "ok", t_end - t0,
             num_objects=len(result.objects.point_ids_list),
-            timings={k: round(v, 4) for k, v in result.timings.items()}))
+            timings={k: round(v, 4) for k, v in result.timings.items()})),
+            result, cfg)
 
     with obs.span("exec.scene_loop", scenes=len(seq_names), mode="overlapped"):
         for seq, resolve in _prefetched_loads(cfg, seq_names, resume,
@@ -606,9 +629,21 @@ def cluster_scenes_mesh(cfg: PipelineConfig, seq_names: Sequence[str], *,
                                  prediction_root=prediction_root,
                                  top_k_repre=cfg.num_representative_masks)
                 obs.count("run.scenes_ok")
-                statuses[seq] = ctx.finish(SceneStatus(
+                st = ctx.finish(SceneStatus(
                     seq, "ok", per_scene,
                     num_objects=len(objects.point_ids_list)))
+                # the fused path never materializes a DeviceHandoff, so
+                # only the universal artifact digest fingerprints it —
+                # byte-equal to the single-chip artifact by contract
+                from maskclustering_tpu.obs import digest as sentinel
+                from maskclustering_tpu.parallel.mesh import mesh_label
+
+                st.digest = sentinel.artifact_only_digest(
+                    objects, bucket="fused", count_dtype=cfg.count_dtype)
+                st.digest_coord = sentinel.digest_coord(
+                    st.digest, mesh=mesh_label(cfg.mesh_shape),
+                    rung=st.degradation_rung, chunk=0)
+                statuses[seq] = st
             except Exception as e:
                 log.exception("scene %s export failed", seq)
                 obs.count("run.scenes_failed")

@@ -102,6 +102,16 @@ def main(argv=None) -> int:
                         help="SLO spec JSON for the status op's detail=slo "
                              "answer (obs/slo.py; default: the canned "
                              "serve-default spec)")
+    parser.add_argument("--canary-interval", type=float, default=0.0,
+                        help="arm the mct-sentinel canary scheduler: every "
+                             "N seconds an idle daemon replays its warm "
+                             "scenes and byte-compares the invariant "
+                             "digests against canary_goldens.json "
+                             "(obs/canary.py; 0 = off)")
+    parser.add_argument("--canary-goldens", default=None,
+                        help="committed goldens path for --canary-interval "
+                             "(default: canary_goldens.json; regenerate "
+                             "via scripts/load_gen.py --write-goldens)")
     parser.add_argument("--telemetry-window", type=float, default=5.0,
                         help="telemetry aggregation window seconds "
                              "(obs/telemetry.py ring; the status op's "
@@ -211,6 +221,8 @@ def main(argv=None) -> int:
         telemetry_window_s=args.telemetry_window,
         slo_spec=args.slo_spec,
         flight_dir=args.flight_dir,
+        canary_interval_s=args.canary_interval,
+        canary_goldens=args.canary_goldens,
     )
     daemon.start()
     if args.host is not None:

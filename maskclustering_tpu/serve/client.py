@@ -196,6 +196,11 @@ class ServeClient:
         (obs/slo.py) under the ``slo`` key."""
         return self.stats(detail="slo")
 
+    def sentinel(self) -> Dict:
+        """The stats snapshot plus the canary sentinel's drift-plane
+        matrix (obs/canary.py) under the ``sentinel`` key."""
+        return self.stats(detail="sentinel")
+
     def shutdown(self) -> Dict:
         self.send({"op": "shutdown"})
         return self.recv_event()

@@ -98,7 +98,9 @@ class ServeDaemon:
                  fault_plan_spec: Optional[str] = None,
                  telemetry_window_s: float = 5.0,
                  slo_spec: Optional[str] = None,
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 canary_interval_s: float = 0.0,
+                 canary_goldens: Optional[str] = None):
         if socket_path is None and host is None:
             raise ValueError("need a socket_path (AF_UNIX) or host/port (TCP)")
         self.cfg = cfg
@@ -153,6 +155,11 @@ class ServeDaemon:
         # the SLO plane (obs/slo.py): a bad spec must fail daemon startup
         # loudly, not surface as a broken `status` answer hours later
         self.slo_spec = _slo.load_spec(slo_spec)
+        # the correctness plane (obs/canary.py): goldens load at start()
+        # so a warm worker exists before the first probe
+        self.canary_interval_s = float(canary_interval_s)
+        self.canary_goldens = canary_goldens
+        self.sentinel = None
         if flight_dir:
             # arm this process AND (via env) any worker subprocess it
             # spawns — the child's flight ring needs somewhere to dump too
@@ -198,6 +205,7 @@ class ServeDaemon:
         self.aggregator.rebase()
         telemetry.install(self.aggregator)
         self._ticker.start()
+        self._start_sentinel()
         self._acceptor = threading.Thread(  # mct-thread: abandon(daemon-lifetime thread, bounded-joined in shutdown(); the spawn/join pair spans methods, which the scope-local check cannot see)
             target=self._accept_loop, daemon=True, name="serve-acceptor")
         self._acceptor.start()
@@ -223,6 +231,36 @@ class ServeDaemon:
             self._listener.bind((self.host, self.port))
         self._listener.listen(16)
         self._listener.settimeout(0.25)  # the acceptor's stop-poll cadence
+
+    def _start_sentinel(self) -> None:
+        """Arm the canary sentinel (correctness plane) when requested.
+
+        Missing/stale goldens disable the sentinel with a loud warning
+        rather than failing startup: a daemon that serves real traffic
+        but cannot self-verify beats no daemon, and the drill/CI gate is
+        where an unverifiable daemon must fail.
+        """
+        if self.canary_interval_s <= 0:
+            return
+        from maskclustering_tpu.obs import canary as _canary
+
+        path = self.canary_goldens or _canary.DEFAULT_GOLDENS_PATH
+        goldens = _canary.load_goldens(path)
+        if goldens is None:
+            log.warning("mct-serve: canary sentinel requested but no usable "
+                        "goldens at %s — sentinel disabled; regenerate via "
+                        "load_gen --write-goldens", path)
+            return
+        self.sentinel = _canary.CanarySentinel(
+            run_round=self.worker.run_canary,
+            goldens=goldens, interval_s=self.canary_interval_s,
+            # idle = nothing queued; the worker may still be mid-request,
+            # which run_canary's handshake waits out at the next idle poll
+            is_idle=lambda: self.queue.depth() == 0)
+        self.sentinel.start()
+        log.info("mct-serve: canary sentinel armed (%d golden coordinate(s),"
+                 " every %.1fs)", len(goldens.get("goldens") or {}),
+                 self.canary_interval_s)
 
     def _prewarm(self) -> None:
         """Pay the serving vocabulary's compiles before the first request.
@@ -281,6 +319,8 @@ class ServeDaemon:
         self._stop.set()
         log.info("mct-serve: draining (in-flight request finishes, queued "
                  "requests get typed rejects)")
+        if self.sentinel is not None:
+            self.sentinel.stop()
         drained_clean = self.worker.stop(timeout_s=timeout_s)
         if not drained_clean:
             log.error("mct-serve: in-flight request outlived the %.0fs "
@@ -402,6 +442,10 @@ class ServeDaemon:
                 if detail == "slo":
                     doc_stats["slo"] = _slo.evaluate(
                         self.slo_spec, doc_stats["telemetry"])
+                if detail == "sentinel":
+                    doc_stats["sentinel"] = (
+                        self.sentinel.stats() if self.sentinel is not None
+                        else {"armed": False})
                 send({"v": protocol.PROTOCOL_VERSION, "kind": "stats",
                       **doc_stats})
                 return
@@ -477,6 +521,11 @@ class ServeDaemon:
             "latency": w["latency"],
             "warm_buckets": [list(b) for b in w["warm_buckets"]],
             "retrace": retrace,
+            # drift-plane summary for load_gen verdicts + serve ledger
+            # stamping (full matrix behind the "sentinel" status detail)
+            "canary": ({"rounds": self.sentinel.stats()["rounds"],
+                        "drift_total": self.sentinel.stats()["drift_total"]}
+                       if self.sentinel is not None else None),
             "draining": self._draining.is_set(),
             **({"worker": w["worker"]} if "worker" in w else {}),
         }

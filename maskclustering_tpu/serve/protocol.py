@@ -80,7 +80,8 @@ SCENE_OPS = ("scene", "stream_chunk", "stream_end")
 # status op detail levels: "" (the classic point-in-time snapshot),
 # "telemetry" (adds the windowed aggregator's ring + cumulative digest)
 # or "slo" (telemetry plus the armed spec's burn-rate verdict, obs/slo.py)
-STATUS_DETAILS = ("", "telemetry", "slo")
+# or "sentinel" (the canary sentinel's drift-plane snapshot, obs/canary.py)
+STATUS_DETAILS = ("", "telemetry", "slo", "sentinel")
 REJECT_REASONS = ("queue_full", "deadline", "bad_request", "draining")
 RESULT_STATUSES = ("ok", "failed", "skipped", "deadline", "interrupted")
 

@@ -141,6 +141,15 @@ class WorkerSupervisor:
         # relay never got to ship. Bounded; _on_crash dumps them.
         self._child_flight: Deque[Dict] = deque(maxlen=1024)
         self._last_child_telem: Optional[Dict] = None
+        # mct-sentinel pipe plumbing: the child's stdin keeps its
+        # SINGLE-WRITER invariant — the sentinel never touches the pipe;
+        # run_canary posts _canary_req and the pump thread ships the op
+        # between requests (so no lock ever wraps pipe IO). _canary_busy
+        # (under _lock) admits one round at a time; a second tick skips.
+        self._canary_req = threading.Event()
+        self._canary_done = threading.Event()
+        self._canary_busy = False
+        self._canary_probes: Optional[list] = None
         self._cfg_path = self._write_cfg()
 
     # -- child plumbing ------------------------------------------------------
@@ -268,6 +277,12 @@ class WorkerSupervisor:
             if kind == "bye":
                 with self._lock:
                     self.last_bye = doc
+                continue
+            if kind == "canary":
+                # the canary round's answer (worker_main's canary op)
+                with self._lock:
+                    self._canary_probes = doc.get("probes")
+                self._canary_done.set()
                 continue
             rid = doc.get("id")
             if rid is None:
@@ -426,6 +441,7 @@ class WorkerSupervisor:
                     self._fatal()
                     break
                 continue
+            self._maybe_send_canary()
             req = self.queue.next(timeout_s=self.poll_s)
             if req is None:
                 continue
@@ -642,6 +658,52 @@ class WorkerSupervisor:
                 self.on_fatal()
             except Exception:  # noqa: BLE001
                 log.exception("worker supervisor: on_fatal callback failed")
+
+    def _maybe_send_canary(self) -> None:
+        """Ship a posted canary op — PUMP THREAD ONLY, preserving the
+        child stdin's single-writer invariant (no lock ever wraps the
+        pipe IO). A dead child or broken pipe releases the waiter with
+        no probes — the sentinel books that tick as skipped."""
+        if not self._canary_req.is_set():
+            return
+        self._canary_req.clear()
+        child = self._child
+        if child is None or child.stdin is None:
+            self._canary_done.set()
+            return
+        try:
+            child.stdin.write(json.dumps({"op": "canary"}) + "\n")
+            child.stdin.flush()
+        except (OSError, ValueError, AttributeError):
+            self._canary_done.set()
+
+    def run_canary(self, timeout_s: float = 120.0) -> Optional[list]:
+        """One mct-sentinel probe round over the pipe (ServeWorker
+        surface): post the canary op for the pump thread to ship, wait
+        (bounded, lock-free) for the child's ``canary`` answer. None on
+        a busy round / dead child / broken pipe / timeout — the sentinel
+        books those ticks as skipped, never as drift."""
+        child = self._child
+        if child is None or child.poll() is not None or child.stdin is None:
+            return None
+        with self._lock:
+            if self._canary_busy:
+                return None  # one round at a time; this tick skips
+            self._canary_busy = True
+            self._canary_probes = None
+        self._canary_done.clear()
+        self._canary_req.set()
+        try:
+            if not self._canary_done.wait(timeout_s):
+                self._canary_req.clear()  # never let a stale op fire later
+                log.warning("worker supervisor: canary round timed out "
+                            "after %.0fs", timeout_s)
+                return None
+            with self._lock:
+                return self._canary_probes
+        finally:
+            with self._lock:
+                self._canary_busy = False
 
     # -- introspection (ServeWorker surface) --------------------------------
 

@@ -41,7 +41,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from maskclustering_tpu import obs
 from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
@@ -145,6 +145,14 @@ class ServeWorker:
         # the evicted client's next op reopens from chunk 0)
         self._streams: Dict[str, _StreamSession] = {}
         self.max_stream_sessions = 4
+        # mct-sentinel canary state: warm-up fitted tensors are retained
+        # so canary probes replay the EXACT warm executables (no compile,
+        # no host-side scene regeneration); the pending/done pair hands a
+        # round to the device-owning worker thread at an idle poll
+        self._warm_cache: List[Tuple[str, object]] = []
+        self._canary_pending = threading.Event()
+        self._canary_done = threading.Event()
+        self._canary_probes: Optional[List[Dict]] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -188,6 +196,20 @@ class ServeWorker:
         while not self._stop.is_set():
             req = self.queue.next(timeout_s=self.poll_s)
             if req is None:
+                if self._canary_pending.is_set():
+                    # idle poll: run the requested canary round HERE, on
+                    # the device-owning thread — canaries never race a
+                    # request for the device
+                    self._canary_pending.clear()
+                    self._idle.clear()
+                    try:
+                        self._canary_probes = self._canary_round()
+                    except Exception:  # noqa: BLE001 — a canary must not kill serving
+                        log.exception("serve: canary round failed")
+                        self._canary_probes = None
+                    finally:
+                        self._idle.set()
+                        self._canary_done.set()
                 continue
             if self._stop.is_set():
                 # stop landed while we were blocked in the pop: this
@@ -530,7 +552,59 @@ class ServeWorker:
             return False
         self.router.note_served(bucket)
         obs.count("serve.warmup_scenes")
+        # sentinel: retain the fitted tensors — canary probes replay them
+        # byte-for-byte through the warm executables (never compiling,
+        # never regenerating scenes host-side)
+        if all(n != name for n, _ in self._warm_cache):
+            self._warm_cache.append((name, tensors))
         return True
+
+    # -- mct-sentinel canary probes -----------------------------------------
+
+    def run_canary(self, timeout_s: float = 120.0) -> Optional[List[Dict]]:
+        """Execute one canary round; returns per-scene probe digests.
+
+        On a running worker the round is handed to the device-owning
+        thread (it picks it up at an idle queue poll, so a canary never
+        races a request for the device); without a running thread (goldens
+        generation, tests) it executes inline. Returns None on timeout.
+
+        Canary traffic is fenced BY CONSTRUCTION: it never enters the
+        admission queue, the latency window, ``serve.requests_*`` counts,
+        tenant accounting or the request journal — it books only
+        ``canary.*`` counters and spans.
+        """
+        if self._thread is None or not self._thread.is_alive():
+            return self._canary_round()
+        self._canary_done.clear()
+        self._canary_probes = None
+        self._canary_pending.set()
+        if not self._canary_done.wait(timeout_s):
+            self._canary_pending.clear()
+            log.warning("serve: canary round timed out after %.1fs "
+                        "(worker busy)", timeout_s)
+            return None
+        return self._canary_probes
+
+    def _canary_round(self) -> List[Dict]:
+        from maskclustering_tpu.models.pipeline import (run_scene_device,
+                                                        run_scene_host)
+        from maskclustering_tpu.obs import digest as sentinel
+
+        probes: List[Dict] = []
+        for name, tensors in list(self._warm_cache):
+            t0 = time.monotonic()
+            with obs.span("serve.canary", scene=name):
+                handoff = run_scene_device(tensors, self.cfg, seq_name=name)
+                result = run_scene_host(handoff, self.cfg, export=False)
+            obs.count("canary.probes")
+            probes.append({
+                "scene": name,
+                "coord": sentinel.digest_coord(result.digest),
+                "digest": result.digest,
+                "seconds": round(time.monotonic() - t0, 4),
+            })
+        return probes
 
     # -- introspection ------------------------------------------------------
 

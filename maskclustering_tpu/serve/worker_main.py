@@ -11,8 +11,9 @@ Wire contract (line-delimited JSON over the stdio pipes; stderr carries
 logging only):
 
 - stdin  <- ``{"op": "scene", "id": ..., ...}`` (protocol.forward_request
-  shape: remaining deadline, crash count) and ``{"op": "shutdown"}``;
-  EOF == shutdown.
+  shape: remaining deadline, crash count), ``{"op": "canary"}`` (one
+  mct-sentinel probe round; answers ``{"kind": "canary", "probes": ...}``)
+  and ``{"op": "shutdown"}``; EOF == shutdown.
 - stdout -> ``{"kind": "ready", ...}`` once warm (carries the warm-up
   wall, the AOT-cache restore stats and the retrace digest — the
   supervisor's proof the respawn reached first dispatch with zero
@@ -264,6 +265,17 @@ def main(argv=None) -> int:
         op = doc.get("op")
         if op == "shutdown":
             break
+        if op == "canary":
+            # mct-sentinel probe round (supervisor.run_canary): executes
+            # on the worker thread at its next idle poll; blocking the
+            # stdin loop here is safe — the supervisor serializes canary
+            # rounds against forwarded requests, and queued lines just
+            # buffer in the pipe until the round answers
+            probes = worker.run_canary(
+                timeout_s=max(cfg.watchdog_device_s, 60.0))
+            emit_raw({"kind": "canary", "id": doc.get("id"),
+                      "probes": probes})
+            continue
         if op not in protocol.SCENE_OPS:
             continue
         req = protocol.build_request(doc, str(doc.get("id") or "r-local"))

@@ -463,6 +463,14 @@ _KIND_DEFAULTS = {
     # seam UNBOUNDED, so only the supervisor's SIGKILL ends it
     "crash": ("device", 1),
     "wedge": ("device", 1),
+    # silent-data-corruption drill (obs/digest.py, obs/canary.py):
+    # "corrupt" deterministically bit-flips a pulled claim/graph stat at
+    # the seam INSTEAD of raising — the retry policy and degradation
+    # ladder never see it, so the corruption must surface as sentinel
+    # digest drift, not vanish into a heal. Unlimited by default so every
+    # canary probe of the target scene drifts (the SLO burn-rate rule
+    # needs repeated occurrences to page).
+    "corrupt": ("host", None),
 }
 
 
@@ -481,6 +489,7 @@ class FaultPlan:
         sigterm:scene1.load   # one real SIGTERM to this process at the seam
         crash:scene7.device   # one real SIGKILL to the executing process
         wedge:scene8.device   # heartbeat-silent unbounded hang (SIGKILL cures)
+        corrupt:scene9.host   # silent bit-flip of a pulled stat (digest drift)
 
     ``stall`` sleeps ``stall_s`` at the seam — under an armed watchdog the
     caller sees ``DeviceStallError`` within its budget; without one the
@@ -540,6 +549,11 @@ class FaultPlan:
         if scene is None:
             return
         for e in self.entries:
+            if e.kind == "corrupt":
+                # corruption never fires at an inject() seam — it is
+                # consumed by take_corruption() at the data site, so no
+                # exception ever reaches the retry/ladder machinery
+                continue
             if e.seam != seam or e.scene != scene or not e.take():
                 continue
             _count(f"faults.injected.{seam}")
@@ -583,6 +597,28 @@ class FaultPlan:
             else:  # fail / load / flaky
                 raise InjectedFault(
                     f"injected {e.kind} fault at {seam} seam of {scene}")
+
+    def take_corruption(self, seam: str, scene: Optional[str]) -> bool:
+        """Consume one scripted ``corrupt`` firing for (seam, scene).
+
+        Called from the data sites themselves (the pulled-assignment tail
+        of run_scene_host, the streaming chunk-digest pull) — the caller
+        flips a bit when this returns True. Deliberately classification-
+        free: nothing raises, nothing retries, the ladder stays blind.
+        """
+        if scene is None:
+            return False
+        for e in self.entries:
+            if (e.kind != "corrupt" or e.seam != seam or e.scene != scene
+                    or not e.take()):
+                continue
+            _count(f"faults.injected.{seam}")
+            _flight_record("flight.fault", what="injected",
+                           fault_kind="corrupt", seam=seam, scene=scene)
+            log.warning("fault injection: corrupt at %s seam of scene %s",
+                        seam, scene)
+            return True
+        return False
 
 
 _PLAN: Optional[FaultPlan] = None
@@ -633,6 +669,14 @@ def inject(seam: str, scene: Optional[str]) -> None:
     plan = active_plan()
     if plan is not None:
         plan.fire(seam, scene)
+
+
+def take_corruption(seam: str, scene: Optional[str]) -> bool:
+    """The corruption hook: True when an active plan scripts a ``corrupt``
+    firing at (seam, scene) — the data site then flips one bit. Call
+    sites: models/pipeline.py (host), models/streaming.py (chunk)."""
+    plan = active_plan()
+    return plan.take_corruption(seam, scene) if plan is not None else False
 
 
 # ---------------------------------------------------------------------------

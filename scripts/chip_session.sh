@@ -137,6 +137,39 @@ run fb_identity   600 python scripts/fb_identity.py --frame-batch 8 ${PLAT[@]+"$
 # frame-batch hypothesis (VERDICT Weak #4 — this record settles the knob)
 run bench_int8    700 python bench.py --retry-budget 200 --init-attempts 2 --count-dtype int8 "${OBS_INT8[@]}" ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
 run bench_fb8     700 python bench.py --retry-budget 200 --init-attempts 2 --frame-batch 8 "${OBS_FB8[@]}" ${PLAT[@]+"${PLAT[@]}"} ${TINY[@]+"${TINY[@]}"}
+# mct-sentinel on-chip check (ADVISORY, ISSUE 17): one canary round on
+# the LIVE backend, byte-compared against the committed CPU-generated
+# canary_goldens.json — the digests are exact integer reductions, so a
+# mismatch here is real silent data corruption on the chip or a
+# nondeterministic lowering, the first thing to read after a session.
+# Advisory by design (the `run` helper never aborts the window);
+# scripts/ci.sh's canary drill (exit 10) is the fatal CPU half.
+cat > "$OUT/sentinel_check.py" <<'PYEOF'
+import json, sys
+from maskclustering_tpu.obs import canary
+from maskclustering_tpu.run import init_backend_or_die
+doc = canary.load_goldens()
+if doc is None:
+    print(json.dumps({"sentinel": "skipped", "reason":
+                      "no usable canary_goldens.json at the repo root — "
+                      "regenerate via scripts/load_gen.py --write-goldens"}))
+    sys.exit(0)
+init_backend_or_die(120.0, platform=sys.argv[1] if len(sys.argv) > 1 else None)
+observed = canary.generate_goldens(canary.goldens_config())
+drift = 0
+for coord in sorted(set(observed) | set(doc["goldens"])):
+    row = observed.get(coord)
+    verdict = canary.compare_probe(
+        {"coord": coord, "scene": (row or {}).get("scene"), "digest": row},
+        doc)
+    drift += verdict["status"] != "ok"
+    print(json.dumps({k: verdict.get(k)
+                      for k in ("coord", "scene", "status", "fields")}))
+print(json.dumps({"sentinel": "drift" if drift else "ok",
+                  "coords": len(observed), "drift": drift}))
+sys.exit(1 if drift else 0)
+PYEOF
+run sentinel_check 700 python "$OUT/sentinel_check.py" ${MCT_PLATFORM:-}
 if [ -n "${MCT_XPROF:-}" ] && [ -z "${MCT_NO_OBS:-}" ]; then
   # span-triggered profiler capture: one repeat, first opening of each
   # named span is bracketed by start/stop_trace (obs/xprof.py)

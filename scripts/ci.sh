@@ -87,16 +87,29 @@
 #    the live-scan contract, end to end (MCT_STREAM_SMOKE=0 skips).
 #    FATAL. The full acceptance matrix lives in tests/test_streaming.py.
 #
+# 3g. runs the canary sentinel drill (distinct exit code 10): a
+#    sentinel-armed warm-baseline daemon soaks clean against the
+#    COMMITTED canary_goldens.json (>= 2 canary rounds, zero drift,
+#    every goldens coordinate verified, zero post-warm compiles), then
+#    a scripted silent bit-flip (corrupt:A.host — no exception, so the
+#    retry/degradation ladder CANNOT heal it) must be detected on the
+#    first canary round, dump a canary_drift postmortem naming the
+#    coordinate, and page `obs.slo --check`'s zero-tolerance
+#    `correctness` objective (exit 2) — the correctness-observability
+#    contract, end to end (MCT_CANARY_DRILL=0 skips). FATAL. The
+#    cross-topology digest pins live in tests/test_sentinel.py.
+#
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
 # Exits non-zero on test failures (1), a fault-matrix failure (3), an
 # mct-check finding or ruff violation (4), a concurrency-family finding
 # (5), a retrace-family finding (6), a serve-smoke failure (7), a
-# crash-respawn smoke failure (8), a streaming-smoke failure (9), or a
-# perf regression (2), so it gates correctness, fault tolerance, the
-# invariants, thread safety, the compile surface, the serving layer,
-# crash containment, the streaming contract AND the trajectory.
+# crash-respawn smoke failure (8), a streaming-smoke failure (9), a
+# canary-drill failure (10), or a perf regression (2), so it gates
+# correctness, fault tolerance, the invariants, thread safety, the
+# compile surface, the serving layer, crash containment, the streaming
+# contract, correctness observability AND the trajectory.
 # Every gate still RUNS after a failure, but the exit code is the FIRST
 # failing gate's — triage by exit code points at the right gate.
 set -u -o pipefail
@@ -222,6 +235,25 @@ if [ "${MCT_STREAM_SMOKE:-1}" != "0" ]; then
         echo "ci: streaming smoke FAILED (streaming diverged from batch," \
              "a post-warm chunk compiled, or the residency cap broke)" >&2
         fail 9
+    fi
+fi
+
+if [ "${MCT_CANARY_DRILL:-1}" != "0" ]; then
+    echo "== ci: canary sentinel drill (clean soak + scripted corruption, <600s) =="
+    # the correctness-observability gate: a sentinel-armed warm-baseline
+    # daemon must soak clean against the COMMITTED canary_goldens.json
+    # (zero drift, zero post-warm compiles — probes replay warm
+    # executables), then a scripted corrupt:A.host bit-flip (silent — the
+    # retry ladder never sees it) must drift on the FIRST canary round,
+    # emit the typed canary.drift event + canary_drift flight dump, and
+    # page obs.slo's zero-tolerance correctness objective (exit 2)
+    if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+            python scripts/load_gen.py --canary-drill --no-ledger; then
+        echo "ci: canary sentinel drill FAILED (drift on a clean soak" \
+             "means outputs changed or goldens are stale — audit, then" \
+             "regenerate with load_gen --write-goldens; an undetected" \
+             "corruption means the sentinel plane is dark)" >&2
+        fail 10
     fi
 fi
 

@@ -628,6 +628,312 @@ def run_smoke(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# mct-sentinel: the audited goldens regeneration + the canary drill
+# ---------------------------------------------------------------------------
+
+DEFAULT_GOLDENS = os.path.join(REPO_ROOT, "canary_goldens.json")
+SURFACE_BASELINE = os.path.join(REPO_ROOT, "compile_surface_baseline.json")
+
+
+def run_write_goldens(args) -> int:
+    """Regenerate canary_goldens.json: ONE in-process canary round under
+    the census cfg (obs/canary.goldens_config — the same knobs the
+    compile-surface census pins) over the committed surface baseline's
+    workload. The resulting git diff IS the audit artifact: inspect it
+    before committing (a changed digest at an unchanged coordinate is a
+    correctness change, not a refresh)."""
+    from maskclustering_tpu.obs import canary as _canary
+    from maskclustering_tpu.run import init_backend_or_die
+
+    init_backend_or_die(120.0, platform="cpu")  # goldens are CPU-generated
+    cfg = _canary.goldens_config()
+    path = args.write_goldens
+    log(f"write-goldens: census cfg ({cfg.count_dtype}, fpad "
+        f"{cfg.frame_pad_multiple}, mpad {cfg.mask_pad_multiple}), "
+        f"workload from {SURFACE_BASELINE}")
+    t0 = time.monotonic()
+    try:
+        goldens = _canary.generate_goldens(cfg,
+                                           baseline_path=SURFACE_BASELINE)
+    except (RuntimeError, ValueError) as e:
+        log(f"write-goldens: FAIL — {e}")
+        return 1
+    doc = _canary.write_goldens(path, goldens, config={
+        "count_dtype": cfg.count_dtype,
+        "distance_threshold": cfg.distance_threshold,
+        "frame_pad_multiple": cfg.frame_pad_multiple,
+        "mask_pad_multiple": cfg.mask_pad_multiple,
+        "point_chunk": cfg.point_chunk,
+        "backend": "cpu",
+    })
+    print(json.dumps({"kind": "goldens", "path": path,
+                      "coords": sorted(doc["goldens"]),
+                      "seconds": round(time.monotonic() - t0, 1)},
+                     sort_keys=True), flush=True)
+    log(f"write-goldens: wrote {len(doc['goldens'])} coordinate(s) to "
+        f"{path} — audit the diff before committing")
+    return 0
+
+
+def _spawn_sentinel_daemon(tmp: str, *, goldens: str, interval_s: float,
+                           fault_plan: Optional[str] = None):
+    """A warm-baseline daemon with the sentinel armed (census knobs are
+    the scannet config's own — the drill must probe under EXACTLY the
+    goldens' cfg, so no --set overrides here)."""
+    sock = os.path.join(tmp, "mct.sock")
+    events = os.path.join(tmp, "serve_events.jsonl")
+    flight_dir = os.path.join(tmp, "flight")
+    cmd = [sys.executable, "-m", "maskclustering_tpu.serve",
+           "--config", "scannet", "--socket", sock, "--data_root", tmp,
+           "--retrace-sanitizer",
+           "--aot-cache", os.path.join(tmp, "aot"),
+           "--obs_events", events,
+           "--warm-baseline", SURFACE_BASELINE,
+           "--telemetry-window", "1.0",
+           "--flight-dir", flight_dir,
+           "--journal-dir", os.path.join(tmp, "journals"),
+           "--canary-interval", str(interval_s),
+           "--canary-goldens", goldens]
+    if fault_plan:
+        cmd += ["--fault-plan", fault_plan]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    log(f"canary-drill: starting daemon: {' '.join(cmd)}")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, cwd=REPO_ROOT,
+                            env=env, text=True)
+    return proc, sock, events, flight_dir
+
+
+def _poll_sentinel(sock: str, done, timeout_s: float) -> Optional[Dict]:
+    """Poll ``status detail=sentinel`` until ``done(stats)`` or timeout;
+    returns the last sentinel snapshot (None when never reachable)."""
+    from maskclustering_tpu.serve.client import ServeClient
+
+    deadline = time.monotonic() + timeout_s
+    snap = None
+    while time.monotonic() < deadline:
+        try:
+            with ServeClient(sock, timeout_s=30.0) as client:
+                snap = client.sentinel().get("sentinel") or snap
+        except OSError:
+            pass
+        if snap is not None and done(snap):
+            return snap
+        time.sleep(0.2)
+    return snap
+
+
+def _drain_daemon(proc, failures: List[str], phase: str):
+    """SIGTERM -> communicate; returns the parsed final digest line."""
+    proc.send_signal(signal.SIGTERM)
+    try:
+        out, _ = proc.communicate(timeout=120.0)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        failures.append(f"{phase}: daemon did not drain within 120s of "
+                        f"SIGTERM")
+        return None
+    if proc.returncode != 143:
+        failures.append(f"{phase}: daemon exit code {proc.returncode} "
+                        f"(expected 143 — SIGTERM-clean drain)")
+    for line in (out or "").splitlines():
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if doc.get("kind") == "digest":
+            return doc
+    failures.append(f"{phase}: daemon printed no final digest line")
+    return None
+
+
+def _slo_check(events: str) -> Tuple[int, str]:
+    """Offline SLO verdict over the daemon's events file (the CI shape:
+    ``obs.slo --events ... --check``)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "maskclustering_tpu.obs.slo",
+         "--events", events, "--check"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120.0)
+    return r.returncode, (r.stdout or "") + (r.stderr or "")
+
+
+def run_canary_drill(args) -> int:
+    """The end-to-end sentinel gate, two phases against the COMMITTED
+    goldens:
+
+    1. clean soak — a sentinel-armed warm-baseline daemon idles through
+       >= 2 canary rounds: zero drift, every goldens coordinate verified,
+       zero post-warm compiles (probes replay warm executables), and the
+       offline SLO check passes.
+    2. corrupt drill — the same daemon under ``corrupt:A.host`` (a silent
+       deterministic bit-flip of scene A's pulled assignment — no
+       exception, so the retry ladder CANNOT heal it): drift must be
+       detected on the FIRST canary round, the typed ``canary.drift``
+       event and the ``canary_drift`` flight dump must name the
+       coordinate, and ``obs.slo --check`` must exit 2 naming the
+       zero-tolerance ``correctness`` objective.
+    """
+    from maskclustering_tpu.analysis.retrace import expected_goldens_coords
+    from maskclustering_tpu.obs import flight as _flight
+
+    goldens = args.canary_goldens or DEFAULT_GOLDENS
+    if not os.path.exists(goldens):
+        log(f"canary-drill: FAIL — no goldens at {goldens}; generate with "
+            f"--write-goldens and commit")
+        return 1
+    expected = expected_goldens_coords()
+    failures: List[str] = []
+    verdict: Dict = {"metric": "serve canary time-to-detection (s)",
+                     "value": None, "unit": "s", "canary_drill": True}
+
+    # -- phase 1: clean soak ------------------------------------------------
+    tmp = tempfile.mkdtemp(prefix="mct_canary_clean_")
+    proc, sock, events, _fd = _spawn_sentinel_daemon(
+        tmp, goldens=goldens, interval_s=args.canary_interval)
+    try:
+        if not _wait_for_socket(sock, proc, timeout_s=args.smoke_startup_s):
+            log("canary-drill: FAIL — clean-soak daemon never became "
+                "reachable")
+            proc.kill()
+            return 1
+        snap = _poll_sentinel(sock, lambda s: int(s.get("rounds", 0)) >= 2,
+                              timeout_s=180.0)
+        digest = _drain_daemon(proc, failures, "clean soak")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    if snap is None or int(snap.get("rounds", 0)) < 2:
+        failures.append(f"clean soak: sentinel completed "
+                        f"{int((snap or {}).get('rounds', 0))} round(s) in "
+                        f"180s (need >= 2)")
+    if snap:
+        if int(snap.get("drift_total", 0)):
+            failures.append(f"clean soak: {snap['drift_total']} drift "
+                            f"event(s) against committed goldens — "
+                            f"outputs changed or goldens are stale")
+        seen = set(snap.get("coords") or ())
+        if seen != expected:
+            failures.append(f"clean soak: verified coordinates {sorted(seen)} "
+                            f"!= goldens coordinates {sorted(expected)}")
+        verdict["canary_probes"] = int(snap.get("rounds", 0)) * len(expected)
+        verdict["digest_coord"] = ",".join(sorted(seen))
+    if digest:
+        retrace = digest.get("retrace") or {}
+        if retrace.get("post_freeze"):
+            failures.append(f"clean soak: {retrace['post_freeze']} post-warm "
+                            f"compile(s) — canary probes must replay warm "
+                            f"executables, never compile")
+        canary = digest.get("canary") or {}
+        if not canary.get("rounds"):
+            failures.append("clean soak: the final digest carries no canary "
+                            "round count — the sentinel summary is dark")
+    rc, out = _slo_check(events)
+    if rc != 0:
+        failures.append(f"clean soak: offline SLO check exited {rc} "
+                        f"(want 0): {out.strip()[:200]}")
+
+    # -- phase 2: the corrupt drill -----------------------------------------
+    tmp2 = tempfile.mkdtemp(prefix="mct_canary_corrupt_")
+    proc, sock, events2, flight_dir = _spawn_sentinel_daemon(
+        tmp2, goldens=goldens, interval_s=args.canary_interval,
+        fault_plan="corrupt:A.host")
+    t_start = time.monotonic()
+    try:
+        if not _wait_for_socket(sock, proc, timeout_s=args.smoke_startup_s):
+            log("canary-drill: FAIL — corrupt-drill daemon never became "
+                "reachable")
+            proc.kill()
+            return 1
+        # >= 2 drift events: the burn-rate rule pages on repeated
+        # occurrences, a single blip never does (obs/slo.py)
+        snap2 = _poll_sentinel(
+            sock, lambda s: int(s.get("drift_total", 0)) >= 2,
+            timeout_s=180.0)
+        detect_s = time.monotonic() - t_start
+        _drain_daemon(proc, failures, "corrupt drill")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    if snap2 is None:
+        failures.append("corrupt drill: sentinel op never answered")
+    else:
+        rounds2 = int(snap2.get("rounds", 0))
+        drift2 = int(snap2.get("drift_total", 0))
+        verdict["canary_drift"] = drift2
+        verdict["value"] = round(detect_s, 1)
+        if drift2 < 2:
+            failures.append(f"corrupt drill: only {drift2} drift event(s) "
+                            f"after {rounds2} round(s) — the bit-flip went "
+                            f"undetected")
+        elif rounds2 and drift2 < rounds2:
+            # every round probes the corrupted scene; fewer drifts than
+            # rounds means some probe of A silently passed
+            failures.append(f"corrupt drill: {drift2} drift(s) over "
+                            f"{rounds2} round(s) — detection missed "
+                            f"round(s)")
+        drift_coords = snap2.get("drift_coords") or {}
+        if not drift_coords:
+            failures.append("corrupt drill: no drift coordinate recorded")
+    # the typed event on the armed sink
+    drift_events = []
+    try:
+        with open(events2, "r", encoding="utf-8") as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("kind") == "canary.drift":
+                    drift_events.append(ev)
+    except OSError:
+        pass
+    if not drift_events:
+        failures.append(f"corrupt drill: no typed canary.drift event in "
+                        f"{events2}")
+    elif not (drift_events[0].get("coord")
+              and drift_events[0].get("fields")):
+        failures.append("corrupt drill: the canary.drift event names no "
+                        "coordinate/fields — drift is unattributable")
+    # the postmortem flight dump naming the coordinate
+    dumps = sorted(os.listdir(flight_dir)) if os.path.isdir(flight_dir) \
+        else []
+    drift_dumps = [n for n in dumps if "canary_drift" in n]
+    if not drift_dumps:
+        failures.append(f"corrupt drill: no canary_drift flight dump under "
+                        f"{flight_dir} (found: {dumps or 'nothing'})")
+    else:
+        _meta, rows = _flight.read_dump(
+            os.path.join(flight_dir, drift_dumps[-1]))
+        if not any(r.get("kind") == "canary.drift" and r.get("coord")
+                   for r in rows):
+            failures.append("corrupt drill: the flight dump carries no "
+                            "canary.drift row naming the coordinate")
+    # the SLO plane must page, naming the zero-tolerance objective
+    rc2, out2 = _slo_check(events2)
+    if rc2 != 2:
+        failures.append(f"corrupt drill: offline SLO check exited {rc2} "
+                        f"(want 2 — the correctness objective must page)")
+    elif "correctness" not in out2:
+        failures.append(f"corrupt drill: SLO violation names no "
+                        f"'correctness' objective: {out2.strip()[:200]}")
+
+    if failures:
+        verdict["error"] = "; ".join(failures)
+    print(json.dumps(verdict, sort_keys=True), flush=True)
+    if not args.no_ledger:
+        append_ledger_row(verdict, args.ledger)
+    if failures:
+        for f in failures:
+            log(f"canary-drill: FAIL — {f}")
+        return 1
+    log(f"canary-drill: PASS — clean soak held goldens, corruption "
+        f"detected in {verdict['value']}s "
+        f"({verdict.get('canary_drift')} drift event(s)), SLO paged on "
+        f"correctness")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="mct-serve load generator (+ --smoke CI gate)")
@@ -679,8 +985,31 @@ def main(argv=None) -> int:
     parser.add_argument("--fault-plan", default=None,
                         help="smoke only: FaultPlan spec passed to the "
                              "daemon (e.g. 'flaky:lg-b:1')")
+    parser.add_argument("--write-goldens", nargs="?", const=DEFAULT_GOLDENS,
+                        default=None, metavar="PATH",
+                        help="regenerate canary_goldens.json (flag alone: "
+                             "the repo-root file) via one in-process canary "
+                             "round under the census cfg — audit the git "
+                             "diff before committing")
+    parser.add_argument("--canary-drill", action="store_true",
+                        help="the mct-sentinel CI gate: clean soak (zero "
+                             "drift, zero post-warm compiles) then a "
+                             "scripted corrupt:A.host bit-flip that must "
+                             "be detected within one canary round, dump a "
+                             "postmortem and page the SLO correctness "
+                             "objective")
+    parser.add_argument("--canary-goldens", default=None, metavar="PATH",
+                        help="committed goldens for --canary-drill "
+                             "(default: the repo-root canary_goldens.json)")
+    parser.add_argument("--canary-interval", type=float, default=1.0,
+                        help="--canary-drill scheduler period seconds "
+                             "(default 1.0)")
     args = parser.parse_args(argv)
 
+    if args.write_goldens:
+        return run_write_goldens(args)
+    if args.canary_drill:
+        return run_canary_drill(args)
     if args.smoke:
         return run_smoke(args)
     if not args.socket and not args.host:

@@ -1,0 +1,485 @@
+"""mct-sentinel acceptance: invariant digests, goldens, and the drift plane.
+
+Pins the correctness-observability contract (obs/digest.py + obs/canary.py
++ the retrace goldens ratchet + the SLO correctness objective):
+
+- the scene digest is DETERMINISTIC: repeat runs are byte-identical, and
+  every coordinate that claims identity (count_dtype encodings,
+  degradation-ladder rungs, overlapped vs sequential executor) produces
+  digests that match byte-for-byte — the runtime form of the repo's
+  exact-integer view-consensus invariant;
+- a scripted ``corrupt`` fault flips ONLY the plane digest (the artifact
+  was computed before the bit-flip) and never raises — the retry ladder
+  stays blind by design, the sentinel is the only thing that can see it;
+- goldens round-trip through write/load, and any version skew (file
+  format OR digest schema) invalidates the whole file to None rather
+  than turning every probe into a false drift;
+- the committed canary_goldens.json covers EXACTLY the canonical
+  workload's digest coordinates, and retrace.check_goldens flags growth,
+  shrinkage, version skew and unreadability as mct-check findings;
+- one CanarySentinel drift trips the whole chain: typed ``canary.drift``
+  event on the armed sink, FlightRecorder postmortem naming the
+  coordinate, and the zero-tolerance ``correctness`` SLO objective pages
+  on a single occurrence in the long window (``obs.slo --check`` exits 2
+  — the ci.sh canary-drill gate shape), while a lone post-warm compile
+  still does not.
+
+Scene runs use the TINY shape bucket (2 boxes, 6 frames, 40x56,
+point_chunk 2048, frame_pad 4 — test_faults.py's bucket) so warm device
+phases are ~2 s of dispatch overhead on CPU; the full goldens
+regeneration (census-bucket scenes, ~40 s) is slow-marked.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu import obs
+from maskclustering_tpu.config import load_config
+from maskclustering_tpu.obs import canary
+from maskclustering_tpu.obs import digest as digest_mod
+from maskclustering_tpu.obs import flight, slo
+from maskclustering_tpu.utils import faults
+from maskclustering_tpu.utils.synthetic import (make_scene, to_scene_tensors,
+                                                write_scannet_layout)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+COORD = "k63:f32:n16384|bf16|single|r0|c0"
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+
+
+def _cfg(**kw):
+    return load_config("scannet").replace(
+        step=1, distance_threshold=0.05, mask_pad_multiple=32,
+        frame_pad_multiple=4, point_chunk=2048, **kw)
+
+
+def _golden_doc(goldens):
+    return {"version": canary.GOLDENS_VERSION,
+            "digest_version": digest_mod.DIGEST_VERSION,
+            "config": {}, "goldens": goldens}
+
+
+# ---------------------------------------------------------------------------
+# unit: digest schema, coordinates, comparison
+# ---------------------------------------------------------------------------
+
+
+def test_digest_coord_and_comparison_units():
+    d = {"v": 1, "bucket": "k63:f32:n16384", "count_dtype": "bf16",
+         "plane": "57810067", "artifact": "0ae5783a", "nan_inf": 0}
+    assert digest_mod.digest_coord(d) == COORD
+    assert digest_mod.digest_coord(d, mesh="m4x2", rung=2, chunk=3) \
+        == "k63:f32:n16384|bf16|m4x2|r2|c3"
+    assert digest_mod.digest_coord(None) == ""
+    assert digest_mod.digests_match(d, dict(d))
+    # count_dtype/bucket are coordinate axes, not digest content — two
+    # coordinates that claim identity must still MATCH
+    other = dict(d, count_dtype="int8", bucket="fused")
+    assert digest_mod.digests_match(d, other)
+    assert digest_mod.diff_digests(d, dict(d, plane="deadbeef")) == ["plane"]
+    assert digest_mod.diff_digests(d, dict(d, v=2, nan_inf=4)) \
+        == ["v", "nan_inf"]
+    assert digest_mod.diff_digests(d, None) == ["missing"]
+    assert not digest_mod.digests_match(d, None)
+
+
+def test_artifact_only_digest_shape():
+    class _Obj:
+        point_ids_list = [np.array([1, 2, 3], np.int64)]
+        mask_list = [[("f0", 4, 0.5)]]
+        num_points = 3
+
+    d = digest_mod.artifact_only_digest(_Obj(), bucket="fused",
+                                        count_dtype="bf16")
+    assert d["plane"] == "" and d["bucket"] == "fused"
+    assert len(d["artifact"]) == 8 and int(d["artifact"], 16) >= 0
+    # artifact-only digests still participate in comparison: a second
+    # computation over the same objects is byte-equal
+    assert digest_mod.digests_match(
+        d, digest_mod.artifact_only_digest(_Obj(), bucket="fused",
+                                           count_dtype="int8"))
+
+
+# ---------------------------------------------------------------------------
+# unit: goldens file round-trip + version invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_goldens_roundtrip_and_version_invalidation(tmp_path):
+    path = str(tmp_path / "goldens.json")
+    assert canary.load_goldens(path) is None  # absent -> no goldens
+    row = {"v": 1, "bucket": "k63:f32:n16384", "count_dtype": "bf16",
+           "plane": "57810067", "artifact": "0ae5783a", "nan_inf": 0,
+           "scene": "A"}
+    doc = canary.write_goldens(path, {COORD: row}, config={"backend": "cpu"})
+    assert doc["version"] == canary.GOLDENS_VERSION
+    loaded = canary.load_goldens(path)
+    assert loaded is not None and loaded["goldens"][COORD] == row
+    assert loaded["config"] == {"backend": "cpu"}
+
+    # any version skew invalidates the WHOLE file — stale goldens must
+    # read as "no goldens", never as a wall of false drift
+    for skew in ({"version": 99}, {"digest_version": 99}):
+        bad = dict(loaded, **skew)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(bad, f)
+        assert canary.load_goldens(path) is None
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("not json{")
+    assert canary.load_goldens(path) is None
+
+
+def test_probes_to_goldens_filters_malformed():
+    good = {"coord": COORD, "scene": "A",
+            "digest": {"v": 1, "plane": "aa", "artifact": "bb", "nan_inf": 0}}
+    out = canary.probes_to_goldens(
+        [good, {"coord": "", "digest": {}}, {"scene": "x"}, {}])
+    assert set(out) == {COORD}
+    assert out[COORD]["scene"] == "A" and out[COORD]["plane"] == "aa"
+
+
+def test_compare_probe_verdicts():
+    golden = {"v": 1, "plane": "aa", "artifact": "bb", "nan_inf": 0}
+    doc = _golden_doc({COORD: golden})
+    ok = canary.compare_probe(
+        {"coord": COORD, "scene": "A", "digest": dict(golden)}, doc)
+    assert ok["status"] == "ok" and ok["fields"] == []
+    drift = canary.compare_probe(
+        {"coord": COORD, "scene": "A",
+         "digest": dict(golden, plane="dead")}, doc)
+    assert drift["status"] == "drift" and drift["fields"] == ["plane"]
+    assert drift["golden"] == golden
+    unc = canary.compare_probe(
+        {"coord": "k1:f1:n1|bf16|single|r0|c0", "digest": dict(golden)}, doc)
+    assert unc["status"] == "uncovered"
+
+
+# ---------------------------------------------------------------------------
+# the committed goldens + the mct-check ratchet
+# ---------------------------------------------------------------------------
+
+
+def test_committed_goldens_cover_canonical_workload():
+    """The file in the repo root is current-version and covers EXACTLY the
+    coordinates the ratchet derives from the canonical workload."""
+    from maskclustering_tpu.analysis.retrace import expected_goldens_coords
+
+    doc = canary.load_goldens(os.path.join(REPO_ROOT,
+                                           canary.DEFAULT_GOLDENS_PATH))
+    assert doc is not None, "committed canary_goldens.json must load clean"
+    assert set(doc["goldens"]) == expected_goldens_coords()
+    for coord, row in doc["goldens"].items():
+        assert row["v"] == digest_mod.DIGEST_VERSION
+        assert len(row["plane"]) == 8 and len(row["artifact"]) == 8
+        assert coord.startswith(row["bucket"] + "|" + row["count_dtype"])
+
+
+def test_check_goldens_ratchets_growth_and_shrinkage(tmp_path):
+    from maskclustering_tpu.analysis.retrace import (check_goldens,
+                                                     expected_goldens_coords)
+
+    root = str(tmp_path)
+    path = os.path.join(root, canary.DEFAULT_GOLDENS_PATH)
+    ids = lambda fs: [f.id for f in fs]  # noqa: E731
+
+    assert ids(check_goldens(root)) == ["RETRACE.GOLDENS:missing"]
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("{broken")
+    assert ids(check_goldens(root)) == ["RETRACE.GOLDENS:unreadable"]
+    expected = sorted(expected_goldens_coords())
+    row = {"v": 1, "plane": "aa", "artifact": "bb", "nan_inf": 0}
+    doc = _golden_doc({c: dict(row) for c in expected})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(dict(doc, version=99), f)
+    assert ids(check_goldens(root)) == ["RETRACE.GOLDENS:version"]
+
+    # exact coverage -> clean; a dropped coordinate AND a bogus one both
+    # fail loudly (shrinkage un-guards a bucket, growth describes
+    # executables the workload no longer produces)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    assert check_goldens(root) == []
+    skewed = {c: dict(row) for c in expected[1:]}
+    skewed["k1:f1:n1|bf16|single|r0|c0"] = dict(row)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(_golden_doc(skewed), f)
+    got = ids(check_goldens(root))
+    assert f"RETRACE.GOLDENS:uncovered:{expected[0]}" in got
+    assert "RETRACE.GOLDENS:stale:k1:f1:n1|bf16|single|r0|c0" in got
+    assert len(got) == 2
+
+
+# ---------------------------------------------------------------------------
+# the idle-aware scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_scheduler_units():
+    golden = {"v": 1, "plane": "aa", "artifact": "bb", "nan_inf": 0}
+    doc = _golden_doc({COORD: golden})
+    probe = {"coord": COORD, "scene": "A", "digest": dict(golden)}
+    idle = [False]
+    rounds = [None]
+    sent = canary.CanarySentinel(run_round=lambda: rounds[0], goldens=doc,
+                                 interval_s=60.0, is_idle=lambda: idle[0])
+
+    # busy daemon: the tick is SKIPPED — canaries never add latency
+    assert sent.tick() is None
+    idle[0] = True
+    # run_round returning None (worker busy mid-handshake) also skips
+    assert sent.tick() is None
+    st = sent.stats()
+    assert st["rounds"] == 0 and st["skipped_busy"] == 2
+
+    rounds[0] = [probe]
+    res = sent.tick()
+    assert [r["status"] for r in res] == ["ok"]
+    st = sent.stats()
+    assert st["rounds"] == 1 and st["drift_total"] == 0
+    assert st["coords"] == [COORD]
+    assert st["last_verified_age_s"][COORD] >= 0.0
+
+    rounds[0] = [{"coord": COORD, "scene": "A",
+                  "digest": dict(golden, artifact="dead")}]
+    res = sent.tick()
+    assert res[0]["status"] == "drift" and res[0]["fields"] == ["artifact"]
+    st = sent.stats()
+    assert st["rounds"] == 2 and st["drift_total"] == 1
+    assert st["drift_coords"] == {COORD: 1}
+    assert st["last_results"][0]["status"] == "drift"
+    # interval clamps away from a busy-loop
+    assert canary.CanarySentinel(run_round=lambda: None, goldens=doc,
+                                 interval_s=0.0).interval_s >= 0.05
+
+
+# ---------------------------------------------------------------------------
+# drift -> typed event -> flight dump -> SLO page (the drill's chain)
+# ---------------------------------------------------------------------------
+
+
+def test_drift_trips_event_flight_and_slo(tmp_path, capsys):
+    from maskclustering_tpu.obs.events import (KIND_DRIFT, KIND_TELEMETRY,
+                                               read_events)
+
+    events = str(tmp_path / "events.jsonl")
+    fdir = str(tmp_path / "flight")
+    golden = {"v": 1, "plane": "aa", "artifact": "bb", "nan_inf": 0}
+    doc = _golden_doc({COORD: golden})
+    probe = {"coord": COORD, "scene": "A",
+             "digest": dict(golden, plane="dead")}
+    obs.configure(events, sample_memory=False, truncate=True,
+                  meta={"tool": "test_sentinel"})
+    flight.arm(fdir)
+    try:
+        sent = canary.CanarySentinel(run_round=lambda: [probe], goldens=doc,
+                                     interval_s=60.0)
+        res = sent.tick()
+        assert res[0]["status"] == "drift"
+        # the window row a sentinel-armed daemon's aggregator would fold
+        # (obs/telemetry.py "drift") — makes this events file the exact
+        # offline input `obs.slo --events --check` gates on
+        obs.emit_event(KIND_TELEMETRY, {"requests": 0, "drift": 1})
+    finally:
+        flight.arm(None)
+        obs.disable()
+
+    drift_rows = [e for e in read_events(events)
+                  if e.get("kind") == KIND_DRIFT]
+    assert drift_rows and drift_rows[0]["coord"] == COORD
+    assert drift_rows[0]["fields"] == ["plane"]
+    assert drift_rows[0]["golden"]["plane"] == "aa"
+
+    dumps = glob.glob(os.path.join(fdir, "*canary_drift*.jsonl"))
+    assert len(dumps) == 1, "drift must dump a postmortem immediately"
+    _meta, rows = flight.read_dump(dumps[0])
+    marks = [r for r in rows if r.get("kind") == "canary.drift"]
+    assert marks and marks[0]["coord"] == COORD
+
+    # the CI gate shape: offline SLO over this file pages on correctness
+    rc = slo.main(["--events", events, "--check"])
+    cap = capsys.readouterr()
+    assert rc == 2 and "correctness" in cap.err
+
+
+def test_slo_drift_zero_tolerance_semantics():
+    """drift_count at threshold 0 pages on ONE occurrence in the long
+    window; other zero-threshold counts keep the strict burn rule."""
+    spec = slo.load_spec(None)
+
+    def win(drift=0, pwc=0):
+        return {"requests": 0, "drift": drift, "post_warm_compiles": pwc}
+
+    one_drift = slo.evaluate(spec, {"windows": [win(), win(1), win(), win()]})
+    assert slo.violated(one_drift) == ["correctness"]
+    assert not one_drift["ok"]
+    clean = slo.evaluate(spec, {"windows": [win(), win()]})
+    assert "correctness" not in slo.violated(clean)
+    # a lone post-warm compile burns at exactly 1.0 — not a page
+    # (pinned in test_blackbox.py; the sentinel carve-out must not leak)
+    assert slo.violated(slo.evaluate(
+        spec, {"windows": [win(pwc=1), win()]})) == []
+    # drift older than the long window has aged out of the verdict
+    aged = slo.evaluate(spec, {"windows": [win(1)] + [win()] * 5})
+    assert slo.violated(aged) == []
+
+
+# ---------------------------------------------------------------------------
+# integration: determinism across coordinates on the tiny bucket
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_runs():
+    """One tiny scene through run_scene at several coordinates (shared
+    compile cache: every variant lands in the same shape bucket)."""
+    from maskclustering_tpu.models.pipeline import run_scene
+
+    scene = make_scene(num_boxes=2, num_frames=6, image_hw=(40, 56),
+                       spacing=0.06, seed=7)
+
+    def run(**kw):
+        return run_scene(to_scene_tensors(scene), _cfg(**kw), k_max=15,
+                         seq_name="tiny0")
+
+    return {"scene": scene, "run": run, "base": run(), "repeat": run()}
+
+
+def test_digest_deterministic_across_runs(tiny_runs):
+    base, repeat = tiny_runs["base"].digest, tiny_runs["repeat"].digest
+    assert base is not None
+    assert base == repeat  # full dict byte-identity, coordinate included
+    assert base["v"] == digest_mod.DIGEST_VERSION
+    assert base["nan_inf"] == 0
+    assert len(base["plane"]) == 8 and len(base["artifact"]) == 8
+    assert digest_mod.digest_coord(base) \
+        == f"{base['bucket']}|bf16|single|r0|c0"
+
+
+def test_digest_matches_across_count_dtypes_and_rungs(tiny_runs):
+    """Every coordinate that claims identity produces MATCHING digests:
+    the count_dtype axis and each applicable degradation rung (the
+    scannet config is mesh-less, so the ladder's rungs are donation-off
+    and host-postprocess) — byte-stability is what makes one golden per
+    bucket sufficient."""
+    base = tiny_runs["base"].digest
+    alt = tiny_runs["run"](count_dtype="int8").digest
+    assert alt["count_dtype"] == "int8"  # its own coordinate...
+    assert digest_mod.digests_match(base, alt)  # ...same bytes
+    for overrides in ({"donate_buffers": False},
+                      {"donate_buffers": False,
+                       "device_postprocess": False}):
+        rung = tiny_runs["run"](**overrides).digest
+        assert digest_mod.digests_match(base, rung), \
+            f"digest drifted under {overrides}"
+
+
+def test_corrupt_fault_flips_plane_only(tiny_runs):
+    """The scripted silent bit-flip: no exception (the retry ladder never
+    heals it), the artifact hash is untouched (objects were computed
+    before the flip), and ONLY the plane digest moves — exactly the
+    signal shape the canary drill detects."""
+    clean = tiny_runs["base"]
+    faults.set_plan(faults.FaultPlan.from_spec("corrupt:tiny0.host"))
+    try:
+        bad = tiny_runs["run"]()
+    finally:
+        faults.set_plan(None)
+    assert digest_mod.diff_digests(bad.digest, clean.digest) == ["plane"]
+    assert bad.digest["artifact"] == clean.digest["artifact"]
+    assert bad.assignment[0] == clean.assignment[0] ^ 0x1
+    np.testing.assert_array_equal(bad.assignment[1:], clean.assignment[1:])
+
+
+def test_executors_stamp_identical_digests(tmp_path):
+    """cluster_scenes stamps digest + full census coordinate on every
+    SceneStatus, and the overlapped executor's digests are byte-identical
+    to the sequential loop's — the executor reorders execution, never
+    results, and now the sentinel can SEE that at runtime."""
+    from maskclustering_tpu.run import cluster_scenes
+
+    root = str(tmp_path)
+    names = []
+    for i in range(2):
+        scene = make_scene(num_boxes=2, num_frames=6, image_hw=(40, 56),
+                           spacing=0.06, seed=30 + i)
+        names.append(f"scene{i:04d}_00")
+        write_scannet_layout(scene, root, names[-1])
+    over = cluster_scenes(_cfg(data_root=root, config_name="sovl"), names,
+                          resume=False)
+    seq = cluster_scenes(_cfg(data_root=root, config_name="sseq",
+                              scene_overlap=False), names, resume=False)
+    assert [s.status for s in over] == ["ok", "ok"]
+    for a, b in zip(over, seq):
+        assert a.digest is not None and a.digest == b.digest
+        assert a.digest_coord == b.digest_coord
+        assert a.digest_coord == digest_mod.digest_coord(a.digest)
+        assert a.digest_coord.endswith("|single|r0|c0")
+
+
+# ---------------------------------------------------------------------------
+# slow: the full goldens regeneration reproduces the committed file
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_isolated_worker_canary_matches_committed(tmp_path):
+    """Cross-topology identity: a REAL --isolate-worker child (jax in a
+    subprocess, worker_main's canary op over the supervisor pipe)
+    reproduces the committed goldens byte-for-byte — the same coordinates
+    and the same bytes the in-process round produces."""
+    from maskclustering_tpu.serve.admission import AdmissionQueue
+    from maskclustering_tpu.serve.router import Router
+    from maskclustering_tpu.serve.supervisor import WorkerSupervisor
+
+    baseline = os.path.join(REPO_ROOT, "compile_surface_baseline.json")
+    committed = canary.load_goldens(os.path.join(
+        REPO_ROOT, canary.DEFAULT_GOLDENS_PATH))
+    assert committed is not None
+    # the drill's daemon cfg: scannet's math knobs ARE the goldens cfg
+    cfg = load_config("scannet").replace(
+        data_root=str(tmp_path), worker_heartbeat_s=60.0)
+    sup = WorkerSupervisor(cfg, AdmissionQueue(4),
+                           Router(cfg, baseline_path=baseline),
+                           warm_baseline=baseline, freeze_after_warm=True,
+                           start_timeout_s=600.0, poll_s=0.1)
+    try:
+        sup.start()
+        probes = sup.run_canary(timeout_s=300.0)
+    finally:
+        sup.stop(timeout_s=60.0)
+    assert probes, "isolated worker produced no canary probes"
+    got = canary.probes_to_goldens(probes)
+    assert set(got) == set(committed["goldens"])
+    for coord, row in got.items():
+        assert digest_mod.digests_match(row, committed["goldens"][coord]), \
+            f"isolated-worker digest drifted at {coord}"
+
+
+@pytest.mark.slow
+def test_regenerated_goldens_match_committed():
+    """The cross-topology canary e2e: an in-process canary round over the
+    census-bucket warm vocabulary (the exact flow behind --write-goldens
+    AND behind a sentinel-armed daemon's probes) reproduces the committed
+    goldens byte-for-byte."""
+    committed = canary.load_goldens(os.path.join(
+        REPO_ROOT, canary.DEFAULT_GOLDENS_PATH))
+    assert committed is not None
+    observed = canary.generate_goldens(
+        canary.goldens_config(),
+        baseline_path=os.path.join(REPO_ROOT,
+                                   "compile_surface_baseline.json"))
+    assert set(observed) == set(committed["goldens"])
+    for coord, row in observed.items():
+        assert digest_mod.digests_match(row, committed["goldens"][coord]), \
+            f"regenerated golden drifted at {coord}"

@@ -104,6 +104,21 @@ def test_stub_serves_and_drains_in_flight(stub_sup):
     assert slow.done.wait(5.0) and slow.terminal["status"] == "ok"
 
 
+def test_stub_canary_round_over_pipe(stub_sup):
+    """mct-sentinel over the isolated-worker pipe: run_canary posts the
+    op for the PUMP thread to ship (the child's stdin keeps its
+    single-writer invariant — no lock ever wraps the pipe IO) and
+    returns the child's probe rows; real traffic interleaves cleanly."""
+    sup, queue = stub_sup
+    probes = sup.run_canary(timeout_s=10.0)
+    assert probes and probes[0]["coord"] == "k63:f32:n16384|bf16|single|r0|c0"
+    assert probes[0]["digest"]["plane"] == "aaaaaaaa"
+    c = _submit(queue, "stub-ok", 7)
+    probes2 = sup.run_canary(timeout_s=10.0)
+    assert c.done.wait(10.0) and c.terminal["status"] == "ok"
+    assert probes2 and probes2[0]["scene"] == "A"
+
+
 def test_stub_crash_respawns_requeues_and_pre_degrades(stub_sup):
     """A SIGKILL mid-request: typed worker_crash status (requeued), the
     respawned worker serves it pre-degraded (crashes -> rung), neighbors

@@ -64,6 +64,15 @@ def main():
         doc = json.loads(line)
         if doc.get("op") == "shutdown":
             break
+        if doc.get("op") == "canary":
+            # worker_main's mct-sentinel answer, in miniature: one probe
+            # row per warm bucket (the supervisor relays these verbatim)
+            emit({"kind": "canary", "probes": [
+                {"coord": "k63:f32:n16384|bf16|single|r0|c0", "scene": "A",
+                 "digest": {"v": 1, "bucket": "k63:f32:n16384",
+                            "count_dtype": "bf16", "plane": "aaaaaaaa",
+                            "artifact": "bbbbbbbb", "nan_inf": 0}}]})
+            continue
         if doc.get("op") != "scene":
             continue
         rid, scene = doc["id"], doc["scene"]
