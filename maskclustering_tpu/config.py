@@ -207,6 +207,19 @@ class PipelineConfig:
     # before declaring the device unserveable and stopping the daemon
     worker_heartbeat_s: float = 20.0
     worker_respawns: int = 2
+    # continuous scene batching (serve/worker.py + parallel/batch.py):
+    # the worker drains up to this many SAME-BUCKET requests from the
+    # admission queue into ONE fused scene-axis dispatch (1 = off, the
+    # sequential path). Partial batches (2 <= k < S) are padded to
+    # exactly S with the router's warm synthetic tensors so the width
+    # vocabulary stays {1, S} — one AOT executable per bucket per width,
+    # zero post-warm compiles at any occupancy. Solo requests keep the
+    # per-scene path (already warm, full degradation ladder).
+    serve_batch_max: int = 1
+    # bounded linger: how long the scheduler may hold the batch head open
+    # waiting for same-bucket company. Always clipped to half the head's
+    # remaining deadline budget, so a lone request never waits past it.
+    serve_batch_linger_s: float = 0.05
 
     # --- persistent AOT executable cache (utils/aot_cache.py) ---
     # "" = off (unless $MCT_AOT_CACHE arms it), "auto" = aot_cache/ next
@@ -308,6 +321,18 @@ class PipelineConfig:
         if self.worker_respawns < 0:
             raise ValueError(
                 f"worker_respawns must be >= 0, got {self.worker_respawns}")
+        if self.serve_batch_max < 1:
+            raise ValueError(
+                f"serve_batch_max must be >= 1, got {self.serve_batch_max}")
+        if self.serve_batch_linger_s < 0:
+            raise ValueError(
+                f"serve_batch_linger_s must be >= 0, "
+                f"got {self.serve_batch_linger_s}")
+        if self.serve_batch_max > 1 and self.streaming_chunk > 0:
+            raise ValueError(
+                "serve_batch_max > 1 packs whole scenes onto the scene "
+                "mesh axis — streaming_chunk is a single-chip whole-stream "
+                "mode; unset one")
 
     def replace(self, **kw) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)

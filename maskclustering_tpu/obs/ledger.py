@@ -199,7 +199,14 @@ def serve_row(verdict: Dict, **extra) -> Dict:
               # mct-sentinel: canary probe accounting (fenced from the
               # latency headline — canaries never enter the latency
               # window) and the coordinates the probes verified
-              "canary_probes", "canary_drift", "digest_coord"):
+              "canary_probes", "canary_drift", "digest_coord",
+              # continuous batching: the packing scheduler's occupancy
+              # coordinate — a packed row's latency/throughput belongs to
+              # its occupancy, so --regress fences/attributes on these
+              # (batch_dimension below, occupancy advisory in
+              # check_regression)
+              "batch_occupancy", "batch_dispatches", "batch_max",
+              "batch_hist"):
         if verdict.get(k) is not None:
             row[k] = verdict[k]
     row.update(extra)
@@ -227,6 +234,20 @@ def sentinel_dimension(row: Optional[Dict]) -> bool:
     drifted one.
     """
     return bool((row or {}).get("canary_drift"))
+
+
+def batch_dimension(row: Optional[Dict]) -> bool:
+    """True when a ledger row was measured under the packing scheduler
+    (continuous scene batching, ``serve_batch_max > 1``).
+
+    A packed row's per-request latency and throughput belong to its batch
+    occupancy — dispatch overhead amortizes across batchmates — so
+    --regress fences the dimension BOTH ways (obs/report.py), like
+    ``tenant_dimension``: a packed row never gates against a sequential
+    baseline, and vice versa. Occupancy SHIFTS between two packed rows are
+    attributed as advisory lines in ``check_regression`` instead.
+    """
+    return (row or {}).get("batch_occupancy") is not None
 
 
 def tier1_row(wall_s: float, passed: int, **extra) -> Dict:
@@ -394,6 +415,25 @@ def check_regression(current: Optional[Dict], baseline: Optional[Dict], *,
                 f"  {label} row recorded {int(r['canary_drift'])} canary "
                 f"drift event(s) [sentinel fence — correctness was "
                 f"violated while measuring; not a perf datapoint]")
+    # occupancy attribution (continuous batching): two packed rows with
+    # different mean occupancy measured different amortization — the
+    # throughput/latency delta is the packing's before it is code drift
+    # (the digest-coord move, applied to the batching dimension; rows on
+    # OPPOSITE sides of the dimension never reach this gate — obs/report
+    # fences batch_dimension both ways)
+    cur_occ = current.get("batch_occupancy")
+    base_occ = baseline.get("batch_occupancy")
+    if cur_occ is not None and base_occ is not None:
+        try:
+            co, bo = float(cur_occ), float(base_occ)
+        except (TypeError, ValueError):
+            co = bo = 0.0
+        if abs(co - bo) >= 0.25:
+            lines.append(
+                f"  batch_occupancy: {bo:g} -> {co:g} [occupancy shift — "
+                f"packed dispatches amortize over their members; attribute "
+                f"the per-request delta to the packing mix before blaming "
+                f"code]")
     cur_stages = current.get("stages") or {}
     base_stages = baseline.get("stages") or {}
     for k in sorted(set(cur_stages) & set(base_stages)):

@@ -939,6 +939,14 @@ def _regress_eval(ledger_path: str, baseline_path: str,
     # row must not gate against a drifted baseline either
     drifted = led.sentinel_dimension(baseline or {})
     rows = [r for r in rows if led.sentinel_dimension(r) == drifted]
+    # batch-dimension fence, both ways (continuous batching): a row
+    # measured under the packing scheduler carries its mean occupancy —
+    # its per-request latency amortizes dispatch overhead across
+    # batchmates, so it only gates against a baseline measured under
+    # packing too (occupancy SHIFTS between two packed rows become
+    # advisory attribution lines inside check_regression)
+    packed = led.batch_dimension(baseline or {})
+    rows = [r for r in rows if led.batch_dimension(r) == packed]
     # gate comparable rows: a run-row median must not be compared against a
     # bench baseline just because it is the newest numeric row
     current = None

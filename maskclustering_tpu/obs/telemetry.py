@@ -498,6 +498,18 @@ class WindowAggregator:
                 row["queue_wait"] = waits
             if dropped:
                 row["samples_dropped"] = dropped
+            # continuous batching (serve.batch.* counters; relayed fold
+            # included, so the isolated child's packing shows up here
+            # too): this window's dispatch count + mean occupancy
+            dispatches = int(deltas.get("serve.batch.dispatches", 0))
+            if dispatches:
+                packed = int(deltas.get("serve.batch.packed_requests", 0))
+                row["batch"] = {
+                    "dispatches": dispatches,
+                    "packed_requests": packed,
+                    "occupancy": round(packed / dispatches, 3),
+                    "pad_lanes": int(deltas.get("serve.batch.pad_lanes", 0)),
+                }
             if self._tenants:
                 row["tenants"] = _tenant_rows(self._tenants)
                 self._tenants = {}

@@ -57,12 +57,19 @@ def batch_shapes(tensors_list: Sequence[SceneTensors], cfg: PipelineConfig,
 
 
 def pad_scene_batch(tensors_list: Sequence[SceneTensors], f_pad: int, n_pad: int,
-                    num_scenes: int):
+                    num_scenes: int,
+                    pad_tensors: Optional[SceneTensors] = None):
     """Stack scenes into the fused step's batched arrays.
 
-    Short batches repeat the last scene (outputs for the repeats are
-    discarded by the caller); padded frames are invalid, padded points sit
-    at the sentinel. Returns the 6-tuple of (S, ...) arrays.
+    Short batches fill the lanes past ``len(tensors_list)`` with
+    ``pad_tensors`` when given (the serving scheduler's warm synthetic
+    scene — keeps partial batches on the full-width executable), else
+    repeat the last scene; either way the pad lanes' outputs are discarded
+    by the caller (``cluster_scene_batch`` post-processes real lanes only,
+    so pad lanes never reach export or accounting). Scene lanes are
+    data-parallel over the ``scene`` mesh axis — pad-lane contents cannot
+    perturb a real lane's bytes. Padded frames are invalid, padded points
+    sit at the sentinel. Returns the 6-tuple of (S, ...) arrays.
     """
     h, w = tensors_list[0].depths.shape[1:3]
     s = num_scenes
@@ -73,7 +80,10 @@ def pad_scene_batch(tensors_list: Sequence[SceneTensors], f_pad: int, n_pad: int
     c2w = np.tile(np.eye(4, dtype=np.float32), (s, f_pad, 1, 1))
     fv = np.zeros((s, f_pad), dtype=bool)
     for i in range(s):
-        t = tensors_list[min(i, len(tensors_list) - 1)]
+        if i >= len(tensors_list) and pad_tensors is not None:
+            t = pad_tensors
+        else:
+            t = tensors_list[min(i, len(tensors_list) - 1)]
         f, n = t.num_frames, t.num_points
         pts[i, :n] = t.scene_points
         depths[i, :f] = t.depths
@@ -161,25 +171,45 @@ def cluster_scene_batch(
     *,
     k_max: Optional[int] = None,
     seq_names: Optional[Sequence[str]] = None,
+    pads: Optional[Tuple[int, int]] = None,
+    width: Optional[int] = None,
+    pad_tensors: Optional[SceneTensors] = None,
 ) -> List[SceneObjects]:
     """Run a batch of scenes through the fused mesh step to SceneObjects.
 
     The batch is padded up to a multiple of the ``scene`` axis; every scene
     in it shares one (F_pad, N_pad, k_max) shape bucket, so distinct buckets
     compile once each (lru-cached jit).
+
+    The serving scheduler's packing kwargs pin the dispatch shape
+    independently of the members so every partial batch reuses one warm
+    executable: ``pads`` is a (f_pad, n_pad) floor (re-rounded to the mesh
+    lcm multiples — the members' natural shapes never exceed it when they
+    classified into the bucket), ``width`` is a scene-lane floor (the batch
+    is padded up to it, then to the scene-axis multiple), and
+    ``pad_tensors`` fills those extra lanes with a warm synthetic scene.
+    Only the ``len(tensors_list)`` real lanes are post-processed — the
+    demux drops pad lanes before export, digesting, or accounting.
     """
     if not tensors_list:
         return []
     s_axis = int(mesh.shape["scene"])
-    num_scenes = _round_up(len(tensors_list), s_axis)
+    num_scenes = _round_up(max(len(tensors_list), int(width or 0)), s_axis)
     f_pad, n_pad = batch_shapes(tensors_list, cfg, mesh)
+    if pads is not None:
+        f_mult = math.lcm(int(mesh.shape["frame"]),
+                          max(cfg.frame_pad_multiple, 1))
+        n_mult = math.lcm(point_axis_size(mesh), max(cfg.point_chunk, 1))
+        f_pad = _round_up(max(f_pad, int(pads[0])), f_mult)
+        n_pad = _round_up(max(n_pad, int(pads[1])), n_mult)
     if k_max is None:
         max_id = max(int(np.max(t.segmentations)) if np.size(t.segmentations) else 0
                      for t in tensors_list)
         k_max = bucket_k_max(max_id)
 
     step = _cached_step(mesh, cfg, k_max)
-    args = pad_scene_batch(tensors_list, f_pad, n_pad, num_scenes)
+    args = pad_scene_batch(tensors_list, f_pad, n_pad, num_scenes,
+                           pad_tensors=pad_tensors)
     # persistent AOT cache: a warm-started process dispatches the restored
     # fused step (zero tracing); a cold bucket captures its export for the
     # next process. Keyed through the sharded.py export seam so the census

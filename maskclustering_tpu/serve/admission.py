@@ -16,8 +16,10 @@ truth.
 
 from __future__ import annotations
 
+import collections
 import queue
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional
 
 from maskclustering_tpu.analysis.lock_sanitizer import mct_lock
 from maskclustering_tpu.obs import flight as _flight
@@ -75,6 +77,13 @@ class AdmissionQueue:
         self._lock = mct_lock("serve.AdmissionQueue._lock")
         self._high_water = 0
         self._admitted = 0
+        # the batch scheduler's look-aside: requests popped while hunting
+        # for same-bucket company but belonging to a DIFFERENT bucket wait
+        # here, ahead of the queue (FIFO preserved at head granularity).
+        # Touched only by the single consumer thread (the worker / the
+        # supervisor pump — the same thread that calls next/next_batch),
+        # so it needs no lock of its own; deque ops are atomic regardless.
+        self._stash: "collections.deque[SceneRequest]" = collections.deque()
 
     def submit(self, req: SceneRequest) -> int:
         """Admit one request; returns the post-admission depth."""
@@ -100,15 +109,91 @@ class AdmissionQueue:
         return depth
 
     def next(self, timeout_s: float = 0.25) -> Optional[SceneRequest]:
-        """The worker's pop: one request, or None after ``timeout_s``."""
-        try:
-            req = self._q.get(timeout=timeout_s)
-        except queue.Empty:
-            return None
+        """The worker's pop: one request, or None after ``timeout_s``.
+
+        Stashed requests (left behind by an earlier ``next_batch`` hunt)
+        go first — they were admitted before anything still in the queue.
+        """
+        if self._stash:
+            req = self._stash.popleft()
+        else:
+            try:
+                req = self._q.get(timeout=timeout_s)
+            except queue.Empty:
+                return None
         if self.metered:
-            _gauge("serve.queue_depth", float(self._q.qsize()))
-            _flight_admit("dequeue", req, depth=self._q.qsize())
+            _gauge("serve.queue_depth", float(self.depth()))
+            _flight_admit("dequeue", req, depth=self.depth())
         return req
+
+    def next_batch(self, key_fn: Callable[[SceneRequest], Optional[tuple]],
+                   *, max_n: int, linger_s: float,
+                   timeout_s: float = 0.25) -> Optional[List[SceneRequest]]:
+        """The packing scheduler's pop: up to ``max_n`` same-key requests.
+
+        Pops the FIFO head, then hunts the stash and the queue for
+        requests whose ``key_fn`` matches the head's (a shape-bucket
+        tuple; ``None`` marks an unbatchable request — streams, resumes,
+        unknown buckets — which always dispatches solo). Non-matching
+        requests return to the stash IN ORDER, ahead of the queue, so the
+        hunt never reorders heads. The hunt is bounded by the linger
+        window: ``linger_s``, clipped to half the smallest remaining
+        deadline budget in the batch — a lone request never waits past
+        its latency budget for company that may not come.
+
+        Returns None after ``timeout_s`` with nothing queued; else a
+        non-empty list whose first element is the FIFO head.
+        """
+        head = self.next(timeout_s=timeout_s)
+        if head is None:
+            return None
+        if max_n <= 1:
+            return [head]
+        key = key_fn(head)
+        if key is None:
+            return [head]
+        batch = [head]
+        skipped: List[SceneRequest] = []
+
+        def _window_end(now: float, end: float, req: SceneRequest) -> float:
+            rem = req.remaining_s()
+            return end if rem is None else min(end, now + 0.5 * max(rem, 0.0))
+
+        now = time.monotonic()
+        end = _window_end(now, now + max(linger_s, 0.0), head)
+        # the stash first (older admissions), then the queue
+        for _ in range(len(self._stash)):
+            req = self._stash.popleft()
+            if len(batch) < max_n and key_fn(req) == key:
+                batch.append(req)
+                end = _window_end(time.monotonic(), end, req)
+            else:
+                skipped.append(req)
+        while len(batch) < max_n:
+            now = time.monotonic()
+            try:
+                # drain without waiting first; linger only on an empty queue
+                req = self._q.get_nowait()
+            except queue.Empty:
+                if now >= end:
+                    break
+                try:
+                    req = self._q.get(timeout=min(end - now, 0.02))
+                except queue.Empty:
+                    continue
+            if key_fn(req) == key:
+                batch.append(req)
+                end = _window_end(time.monotonic(), end, req)
+            else:
+                skipped.append(req)
+        # skipped requests go back IN ORDER, ahead of the queue
+        self._stash.extendleft(reversed(skipped))
+        if self.metered:
+            _gauge("serve.queue_depth", float(self.depth()))
+            for req in batch[1:]:
+                _flight_admit("dequeue_batch", req, depth=self.depth(),
+                              batch=len(batch))
+        return batch
 
     def requeue(self, req: SceneRequest) -> bool:
         """Hand a popped-but-unserved request back (the worker's stop path:
@@ -126,8 +211,10 @@ class AdmissionQueue:
         return True
 
     def drain(self) -> List[SceneRequest]:
-        """Everything still queued (shutdown: answer, don't run)."""
-        out: List[SceneRequest] = []
+        """Everything still queued (shutdown: answer, don't run). Called
+        after the consumer thread has stopped, so the stash is quiescent."""
+        out: List[SceneRequest] = list(self._stash)
+        self._stash.clear()
         while True:
             try:
                 out.append(self._q.get_nowait())
@@ -140,7 +227,7 @@ class AdmissionQueue:
         return out
 
     def depth(self) -> int:
-        return self._q.qsize()
+        return self._q.qsize() + len(self._stash)
 
     @property
     def high_water(self) -> int:

@@ -276,6 +276,11 @@ class ServeDaemon:
         try:
             for name, tensors in self.router.warmup_workload():
                 self.worker.warm_tensors(name, tensors)
+                # continuous batching: the width-S fused executable is a
+                # DISTINCT program from the width-1 warm above — pay its
+                # compile here too, or the first packed batch compiles
+                # after the sanitizer freeze (a post-warm violation)
+                self.worker.warm_batch_executable(name, tensors)
             if self.warm_scenes:
                 from maskclustering_tpu.run import cluster_scenes
 
@@ -284,6 +289,7 @@ class ServeDaemon:
                 for st in statuses:
                     log.info("mct-serve: warm scene %s -> %s", st.seq_name,
                              st.status)
+                self._warm_batch_from_disk()
         finally:
             faults.set_plan(drill)
         self._warmup_s = time.monotonic() - t0
@@ -296,6 +302,29 @@ class ServeDaemon:
             # report reads straight off this freeze
             retrace_sanitizer.freeze()
             log.info("mct-serve: retrace sanitizer frozen after warm-up")
+
+    def _warm_batch_from_disk(self) -> None:
+        """Classify --warm disk scenes in the router and pay their width-S
+        fused compiles (no-op with batching off).
+
+        cluster_scenes warms the single-chip ladder but never touches the
+        router, so without this the first live request for a warm scene
+        dispatches solo-unclassified AND the first packed batch compiles
+        after the sanitizer freeze."""
+        if int(getattr(self.cfg, "serve_batch_max", 1) or 1) <= 1:
+            return
+        from maskclustering_tpu.datasets import get_dataset
+
+        for name in self.warm_scenes:
+            try:
+                ds = get_dataset(self.cfg.dataset, name,
+                                 data_root=self.cfg.data_root)
+                tensors = ds.load_scene_tensors(self.cfg.step)
+            except Exception:
+                log.exception("mct-serve: batch warm skipped for %s", name)
+                continue
+            self.router.remember(name, self.router.classify_tensors(tensors))
+            self.worker.warm_batch_executable(name, tensors)
 
     def request_stop(self) -> None:
         self._stop.set()
@@ -527,6 +556,10 @@ class ServeDaemon:
                         "drift_total": self.sentinel.stats()["drift_total"]}
                        if self.sentinel is not None else None),
             "draining": self._draining.is_set(),
+            # the packing scheduler's occupancy digest (in-thread worker
+            # only; under --isolate-worker the CHILD packs and its
+            # serve.batch.* counters relay up via telemetry instead)
+            **({"batch": w["batch"]} if "batch" in w else {}),
             **({"worker": w["worker"]} if "worker" in w else {}),
         }
 
@@ -540,3 +573,6 @@ class ServeDaemon:
         obs.gauge("serve.queue_depth_high_water",
                   float(self.queue.high_water))
         obs.gauge("serve.warm_buckets", float(len(self.router.warm_buckets())))
+        batch = getattr(self.worker, "batch_stats", lambda: None)()
+        if batch and batch.get("dispatches"):
+            obs.gauge("serve.batch_occupancy", float(batch["occupancy"]))

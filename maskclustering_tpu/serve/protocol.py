@@ -243,6 +243,18 @@ def forward_request(req: SceneRequest) -> Dict:
     return doc
 
 
+def forward_batch(reqs) -> Dict:
+    """A same-bucket request batch -> ONE pipe envelope (pipe-only op).
+
+    The supervisor's packing pump forwards a whole batch in one write so
+    the child's scheduler sees the members together (its own
+    ``next_batch`` re-packs them into one fused dispatch instead of
+    meeting them one stdin line at a time). The envelope is
+    supervisor-internal — ``parse_line`` never accepts it from a client.
+    """
+    return {"op": "batch", "requests": [forward_request(r) for r in reqs]}
+
+
 # ---------------------------------------------------------------------------
 # response builders (the only shapes the daemon ever sends)
 # ---------------------------------------------------------------------------

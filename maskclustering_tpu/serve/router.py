@@ -76,6 +76,11 @@ class Router:
         # synthetic requests must not regenerate a whole scene host-side
         # just to re-derive a bucket that cannot have changed
         self._by_scene: Dict[str, Bucket] = {}
+        # bucket -> warm synthetic SceneTensors: the packing scheduler's
+        # pad-lane source (serve/worker.py fills it at warm-up/first-serve;
+        # a partial batch pads to full width with THESE tensors so every
+        # occupancy reuses the one full-width executable)
+        self._pad_tensors: Dict[Bucket, object] = {}
         self.vocabulary: List[Dict] = []  # baseline workload entries
         if baseline_path:
             self.vocabulary = self._load_vocabulary(baseline_path)
@@ -129,6 +134,19 @@ class Router:
     def warm_buckets(self) -> Set[Bucket]:
         with self._lock:
             return set(self._warm)
+
+    def remember_pad_tensors(self, bucket: Bucket, tensors) -> None:
+        """Retain one scene's tensors as the bucket's warm pad lane (first
+        writer wins — pad bytes must stay stable across a daemon's life so
+        partial-batch dispatches are reproducible)."""
+        with self._lock:
+            self._pad_tensors.setdefault(bucket, tensors)
+
+    def pad_tensors_for(self, bucket: Bucket):
+        """The bucket's warm pad-lane tensors, or None before any scene of
+        that bucket has been warmed/served."""
+        with self._lock:
+            return self._pad_tensors.get(bucket)
 
     def warmup_workload(self) -> Iterable[Tuple[str, "object"]]:
         """(name, SceneTensors) per DISTINCT baseline-vocabulary bucket.

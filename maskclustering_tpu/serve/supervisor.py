@@ -119,9 +119,14 @@ class WorkerSupervisor:
         self._ready = threading.Event()
         self._heartbeat = faults.Heartbeat(
             max(getattr(cfg, "worker_heartbeat_s", 0.0), 0.0), seam="worker")
-        # in-flight request state, written by the pump, relayed to by the
-        # reader: {"req": SceneRequest, "terminal": dict|None, "done": Event}
-        self._inflight: Optional[Dict] = None
+        # in-flight request state keyed by request id, written by the
+        # pump, relayed to by the reader. One entry per member:
+        # {"req": SceneRequest, "terminal": dict|None, "done": Event}.
+        # The packing pump (serve_batch_max > 1) forwards same-bucket
+        # batches as ONE pipe envelope, so several entries can ride a
+        # single dispatch — a crash requeues exactly the members whose
+        # terminal events never landed.
+        self._inflight: Dict[str, Dict] = {}
         self._latencies: Deque[float] = deque(maxlen=4096)
         self._counts = {"requests": 0, "ok": 0, "failed": 0, "deadline": 0,
                         "skipped": 0, "interrupted": 0}
@@ -288,9 +293,8 @@ class WorkerSupervisor:
             if rid is None:
                 continue
             with self._lock:
-                entry = self._inflight
-            if entry is None or entry["req"].id != rid \
-                    or entry["done"].is_set():
+                entry = self._inflight.get(rid)
+            if entry is None or entry["done"].is_set():
                 log.warning("worker supervisor: dropping stray child event "
                             "for %s", rid)
                 continue
@@ -442,34 +446,62 @@ class WorkerSupervisor:
                     break
                 continue
             self._maybe_send_canary()
-            req = self.queue.next(timeout_s=self.poll_s)
-            if req is None:
+            batch = self._next_work()
+            if batch is None:
                 continue
             if self._stop.is_set():
-                if not self.queue.requeue(req):
-                    obs.count("serve.admission.rejects.draining")
-                    _send(req, protocol.reject(
-                        "draining", req=req,
-                        detail="daemon shutting down before dispatch"))
+                for req in batch:
+                    if not self.queue.requeue(req):
+                        obs.count("serve.admission.rejects.draining")
+                        _send(req, protocol.reject(
+                            "draining", req=req,
+                            detail="daemon shutting down before dispatch"))
                 break
             self._idle.clear()
             try:
-                self._serve_one(req)
-            except Exception:  # noqa: BLE001 — one request, not the daemon
-                log.exception("worker supervisor: request %s crashed the "
-                              "pump", req.id)
-                _send(req, protocol.result(req, "failed",
-                                           error="internal supervisor error",
-                                           error_class="terminal"))
+                self._serve_batch(batch)
+            except Exception:  # noqa: BLE001 — one batch, not the daemon
+                log.exception("worker supervisor: batch %s crashed the "
+                              "pump", [r.id for r in batch])
+                for req in batch:
+                    with self._lock:
+                        entry = self._inflight.pop(req.id, None)
+                    if entry is not None and entry["terminal"] is not None:
+                        continue  # answered before the pump tripped
+                    _send(req, protocol.result(
+                        req, "failed", error="internal supervisor error",
+                        error_class="terminal"))
             finally:
                 self._idle.set()
 
-    def _serve_one(self, req: protocol.SceneRequest) -> None:
-        # NB: serve.requests / serve.requests_<status> obs counters for
-        # forwarded requests are booked by the CHILD and arrive via the
-        # telem relay — booking them here too would double-count the fold.
-        # Only the paths the child never sees (expired-at-dequeue, the
-        # crash cap in _on_crash) book parent-side.
+    def _next_work(self) -> Optional[list]:
+        """One dispatch unit off the admission queue: a single request,
+        or — when continuous batching is on — up to ``serve_batch_max``
+        same-bucket requests packed by the shared scheduler
+        (AdmissionQueue.next_batch). The parent's key fn only needs the
+        router's memory: the CHILD's own packing scheduler re-derives
+        buckets (and peeks its fault plan) before fusing, so an over-eager
+        parent key costs nothing but a wider pipe envelope."""
+        batch_max = max(int(getattr(self.cfg, "serve_batch_max", 1)), 1)
+        if batch_max <= 1:
+            req = self.queue.next(timeout_s=self.poll_s)
+            return None if req is None else [req]
+        return self.queue.next_batch(
+            self._batch_key, max_n=batch_max,
+            linger_s=float(getattr(self.cfg, "serve_batch_linger_s", 0.0)),
+            timeout_s=self.poll_s)
+
+    def _batch_key(self, req: protocol.SceneRequest) -> Optional[tuple]:
+        """Same-bucket grouping key for the pipe pump; None = solo (never
+        batched): streams, resumes, crash-requeued requests, and scenes
+        the router has not classified yet."""
+        if req.op != "scene" or req.resume or req.crashes:
+            return None
+        return self.router.bucket_for(req.scene)
+
+    def _book_arrival(self, req: protocol.SceneRequest) -> bool:
+        """Parent-side dequeue bookkeeping; False = expired at dequeue
+        (typed deadline reject — the child never sees the request)."""
         with self._lock:
             self._counts["requests"] += 1
         telemetry.record_queue_wait(
@@ -484,51 +516,69 @@ class WorkerSupervisor:
                 "deadline", req=req,
                 detail=f"deadline_s={req.deadline_s:g} expired after "
                        f"{time.monotonic() - req.admitted_at:.2f}s in queue"))
+            return False
+        return True
+
+    def _serve_batch(self, batch) -> None:
+        # NB: serve.requests / serve.requests_<status> obs counters for
+        # forwarded requests are booked by the CHILD and arrive via the
+        # telem relay — booking them here too would double-count the fold.
+        # Only the paths the child never sees (expired-at-dequeue, the
+        # crash cap in _contain_crash) book parent-side.
+        live = [req for req in batch if self._book_arrival(req)]
+        if not live:
             return
         t0 = time.monotonic()
-        entry = {"req": req, "terminal": None, "done": threading.Event()}
+        entries = {req.id: {"req": req, "terminal": None,
+                            "done": threading.Event()} for req in live}
         with self._lock:
-            self._inflight = entry
+            self._inflight.update(entries)
         child = self._child
+        doc = (protocol.forward_request(live[0]) if len(live) == 1
+               else protocol.forward_batch(live))
         try:
-            child.stdin.write(
-                json.dumps(protocol.forward_request(req), sort_keys=True)
-                + "\n")
+            child.stdin.write(json.dumps(doc, sort_keys=True) + "\n")
             child.stdin.flush()
         except (OSError, ValueError, AttributeError):
-            self._crash_inflight(req, entry, "pipe to worker broke on "
-                                             "forward")
+            self._crash_batch(entries, "pipe to worker broke on forward")
             return
-        # wait for the terminal event, watching the child the whole time:
-        # a crash mid-request is the supervised case, not an exception (a
-        # drain keeps waiting here — the in-flight request must answer)
-        while not entry["done"].wait(0.25):
+        # the deadline backstop spans the batch (the child enforces each
+        # member's own folded deadline; this only catches a child that
+        # ignores them outright) and only arms when EVERY member carries
+        # one — an unbounded member legitimately runs as long as it needs
+        deadlines = [req.deadline_s for req in live if req.deadline_s > 0]
+        backstop = (max(deadlines) + max(self.cfg.watchdog_device_s, 30.0)
+                    + 5.0) if len(deadlines) == len(live) else None
+        # wait for every member's terminal event, watching the child the
+        # whole time: a crash mid-batch is the supervised case, not an
+        # exception (a drain keeps waiting here — in-flight must answer)
+        while True:
+            pending = [e for e in entries.values()
+                       if not e["done"].is_set()]
+            if not pending:
+                break
+            pending[0]["done"].wait(0.25)
             detail = self._child_dead()
             if detail is not None:
-                # the child may have ANSWERED and then died: give the
-                # reader a bounded window to drain the buffered result
-                # before declaring the request crashed — a completed
-                # scene must never be re-executed (or worse, converted
-                # into a typed failure at the crash cap)
-                if entry["done"].wait(2.0):
-                    break  # result landed; the death respawns at loop top
-                if self._crash_inflight(req, entry, detail):
-                    return
-                break  # the reader won the race after all: book normally
-            if req.deadline_s > 0 and time.monotonic() - t0 > \
-                    req.deadline_s + max(self.cfg.watchdog_device_s, 30.0) \
-                    + 5.0:
-                # the child enforces the folded deadline itself; this only
-                # backstops a child that ignores it outright
-                if self._crash_inflight(req, entry,
-                                        "worker ignored the request "
-                                        "deadline"):
-                    return
+                # the child may have ANSWERED (some or all members) and
+                # then died: give the reader a bounded window to drain
+                # buffered results before declaring members crashed — a
+                # completed scene must never be re-executed (or worse,
+                # converted into a typed failure at the crash cap)
+                grace = time.monotonic() + 2.0
+                for e in entries.values():
+                    e["done"].wait(max(grace - time.monotonic(), 0.0))
+                self._crash_batch(entries, detail)
                 break
-        terminal = entry["terminal"] or {}
-        with self._lock:
-            self._inflight = None
-        self._book_result(req, terminal, t0)
+            if backstop is not None and time.monotonic() - t0 > backstop:
+                self._crash_batch(entries,
+                                  "worker ignored the request deadline")
+                break
+        for entry in entries.values():
+            if entry["terminal"] is not None:
+                with self._lock:
+                    self._inflight.pop(entry["req"].id, None)
+                self._book_result(entry["req"], entry["terminal"], t0)
 
     def _book_result(self, req: protocol.SceneRequest, terminal: Dict,
                      t0: float) -> None:
@@ -541,7 +591,6 @@ class WorkerSupervisor:
         # latency-by-bucket are parent-side bookings here
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + 1
-            self._inflight = None
         latency = time.monotonic() - t0
         self._latencies.append(latency)
         bucket = terminal.get("bucket")
@@ -560,24 +609,28 @@ class WorkerSupervisor:
                 else self.router.bucket_for(req.scene), latency,
                 tenant=req.tenant, status=key)
 
-    def _crash_inflight(self, req: protocol.SceneRequest, entry: Dict,
-                        detail: str) -> bool:
-        """The in-flight request's worker died: typed event + requeue (or
-        typed failure), then the pump's next iteration respawns. False
-        when the reader relayed the terminal event while we decided — the
-        request COMPLETED, so the caller books it normally and only the
-        worker death is contained."""
-        if entry["done"].is_set():
-            self._on_crash(None, detail)
-            return False
-        entry["done"].set()  # the reader must not relay stale events
+    def _crash_batch(self, entries: Dict, detail: str) -> None:
+        """The in-flight batch's worker died: contain ONCE (kill + dump),
+        then requeue (or answer at the crash cap) exactly the members
+        WITHOUT terminal events. A batchmate whose result landed before
+        the death is booked normally by the caller — a completed scene is
+        never re-executed, and never converted into a typed failure."""
+        victims = []
         with self._lock:
-            self._inflight = None
-        self._on_crash(req, detail)
-        return True
+            for entry in entries.values():
+                if entry["done"].is_set():
+                    continue  # terminal landed; the caller books it
+                entry["done"].set()  # the reader must not relay stale events
+                self._inflight.pop(entry["req"].id, None)
+                victims.append(entry["req"])
+        self._contain_crash(victims, detail)
 
     def _on_crash(self, req: Optional[protocol.SceneRequest],
                   detail: str) -> None:
+        """Idle-crash shim: contain with zero (or one) harmed requests."""
+        self._contain_crash([req] if req is not None else [], detail)
+
+    def _contain_crash(self, reqs, detail: str) -> None:
         self.crashes += 1
         obs.count("serve.worker_crashes")
         log.error("worker supervisor: %s", detail)
@@ -585,12 +638,15 @@ class WorkerSupervisor:
         child_pid = child.pid if child is not None else None
         self._kill_child()
         _flight.record(_flight.KIND_CRASH, detail=detail,
-                       request=req.id if req else None,
-                       scene=req.scene if req else None,
+                       request=",".join(r.id for r in reqs) or None,
+                       scene=",".join(r.scene for r in reqs) or None,
                        child_pid=child_pid, crashes=self.crashes)
         self._dump_blackbox(child_pid)
-        if req is None:
-            return
+        for req in reqs:
+            self._requeue_crashed(req, detail)
+
+    def _requeue_crashed(self, req: protocol.SceneRequest,
+                         detail: str) -> None:
         # zero-width trace marker: obs.trace renders the crash between the
         # dead attempt and the requeue's second queue-wait segment
         obs.record_span("serve.worker_crash", 0.0, request=req.id,
@@ -729,9 +785,11 @@ class WorkerSupervisor:
         with self._lock:
             counts = dict(self._counts)
             ready = dict(self.last_ready)
-            inflight = self._inflight
-            inflight_id = inflight["req"].id if inflight else None
-            inflight_crashes = inflight["req"].crashes if inflight else 0
+            inflight = list(self._inflight.values())
+            inflight_id = inflight[0]["req"].id if inflight else None
+            inflight_width = len(inflight)
+            inflight_crashes = max((e["req"].crashes for e in inflight),
+                                   default=0)
         child = self._child
         alive = child is not None and child.poll() is None
         return {"counts": counts,
@@ -748,6 +806,7 @@ class WorkerSupervisor:
                            "hb_age_s": round(self._heartbeat.age_s(), 3),
                            "hb_budget_s": self._heartbeat.budget_s,
                            "inflight": inflight_id,
+                           "inflight_width": inflight_width,
                            "inflight_crashes": inflight_crashes,
                            "warmup_s": ready.get("warmup_s"),
                            "aot": ready.get("aot"),

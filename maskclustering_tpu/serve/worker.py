@@ -153,6 +153,12 @@ class ServeWorker:
         self._canary_pending = threading.Event()
         self._canary_done = threading.Event()
         self._canary_probes: Optional[List[Dict]] = None
+        # continuous scene batching (cfg.serve_batch_max > 1): the fused
+        # dispatch mesh (lazy — single-chip daemons build a (1, 1) mesh)
+        # and the occupancy histogram {batch width -> dispatches}, both
+        # worker-thread-only
+        self._mesh = None
+        self._batch_hist: Dict[int, int] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -193,9 +199,17 @@ class ServeWorker:
     # -- the thread main ----------------------------------------------------
 
     def _run(self) -> None:
+        batch_max = max(int(self.cfg.serve_batch_max), 1)
         while not self._stop.is_set():
-            req = self.queue.next(timeout_s=self.poll_s)
-            if req is None:
+            if batch_max > 1:
+                batch = self.queue.next_batch(
+                    self._batch_key, max_n=batch_max,
+                    linger_s=self.cfg.serve_batch_linger_s,
+                    timeout_s=self.poll_s)
+            else:
+                head = self.queue.next(timeout_s=self.poll_s)
+                batch = None if head is None else [head]
+            if batch is None:
                 if self._canary_pending.is_set():
                     # idle poll: run the requested canary round HERE, on
                     # the device-owning thread — canaries never race a
@@ -212,25 +226,39 @@ class ServeWorker:
                         self._canary_done.set()
                 continue
             if self._stop.is_set():
-                # stop landed while we were blocked in the pop: this
-                # request was promised a draining reject, not execution —
-                # hand it back for the daemon's drain (or answer the
+                # stop landed while we were blocked in the pop: these
+                # requests were promised a draining reject, not execution —
+                # hand them back for the daemon's drain (or answer the
                 # reject ourselves if a racing submit refilled the slot)
-                if not self.queue.requeue(req):
-                    obs.count("serve.admission.rejects.draining")
-                    _send(req, protocol.reject(
-                        "draining", req=req,
-                        detail="daemon shutting down before dispatch"))
+                for req in batch:
+                    if not self.queue.requeue(req):
+                        obs.count("serve.admission.rejects.draining")
+                        _send(req, protocol.reject(
+                            "draining", req=req,
+                            detail="daemon shutting down before dispatch"))
                 break
             self._idle.clear()
             try:
-                self._serve_one(req)
-            except Exception:  # noqa: BLE001 — one request, not the daemon
-                log.exception("serve: request %s crashed the worker loop",
-                              req.id)
-                _send(req, protocol.result(req, "failed",
-                                           error="internal worker error",
-                                           error_class="terminal"))
+                if len(batch) == 1:
+                    if batch_max > 1 and batch[0].op == "scene":
+                        # solo scene dispatch under the packing scheduler:
+                        # a width-1 histogram entry, so `occupancy` means
+                        # requests-per-dispatch over ALL scene dispatches
+                        # (not just the fused ones, which are >= 2 by
+                        # construction)
+                        obs.count("serve.batch.dispatches")
+                        obs.count("serve.batch.packed_requests")
+                        self._batch_hist[1] = self._batch_hist.get(1, 0) + 1
+                    self._serve_one(batch[0])
+                else:
+                    self._serve_batch(batch)
+            except Exception:  # noqa: BLE001 — one batch, not the daemon
+                log.exception("serve: request(s) %s crashed the worker "
+                              "loop", [r.id for r in batch])
+                for req in batch:
+                    _send(req, protocol.result(req, "failed",
+                                               error="internal worker error",
+                                               error_class="terminal"))
             finally:
                 self._idle.set()
 
@@ -274,9 +302,11 @@ class ServeWorker:
         _send(req, protocol.result(req, status_,
                                    seconds=round(latency, 4), **fields))
 
-    def _serve_one(self, req: protocol.SceneRequest) -> None:
-        from maskclustering_tpu.run import SceneSupervisor
-
+    def _book_arrival(self, req: protocol.SceneRequest) -> bool:
+        """Per-request arrival bookkeeping (request count, queue wait,
+        deadline cutoff) — shared by the solo and the packed paths so
+        admission accounting cannot drift between them. False when the
+        request was answered with a typed ``deadline`` reject."""
         obs.count("serve.requests")
         with self._lock:
             self._counts["requests"] += 1
@@ -297,11 +327,19 @@ class ServeWorker:
                 "deadline", req=req,
                 detail=f"deadline_s={req.deadline_s:g} expired after "
                        f"{time.monotonic() - req.admitted_at:.2f}s in queue"))
-            return
+            return False
+        return True
 
+    def _serve_one(self, req: protocol.SceneRequest) -> None:
+        if not self._book_arrival(req):
+            return
         if req.op in ("stream_chunk", "stream_end"):
             self._serve_stream(req)
             return
+        self._serve_scene(req)
+
+    def _serve_scene(self, req: protocol.SceneRequest) -> None:
+        from maskclustering_tpu.run import SceneSupervisor
 
         t0 = time.monotonic()
         bucket = None
@@ -387,6 +425,12 @@ class ServeWorker:
             fields = {"scene_seconds": round(st.seconds, 4),
                       "attempts": st.attempts, "rung": st.degradation_rung,
                       "num_objects": st.num_objects}
+            if getattr(st, "digest", None):
+                # per-request invariant digest: the pack-vs-sequential
+                # identity gate compares this against the fused path's
+                # per-lane digest (artifact fingerprint is universal)
+                fields["digest"] = st.digest
+                fields["digest_coord"] = getattr(st, "digest_coord", "")
             if st.error:
                 fields["error"] = str(st.error).strip().splitlines()[-1][:200]
                 fields["error_class"] = st.error_class
@@ -397,6 +441,221 @@ class ServeWorker:
             buckets_new=len(new_buckets),
             **({"bucket": list(bucket)} if bucket is not None else {}),
             **fields)
+
+    # -- continuous scene batching (cfg.serve_batch_max > 1) ----------------
+
+    def _run_mesh(self):
+        """The fused dispatch mesh: cfg.mesh_shape when set, else a
+        single-device (1, 1) mesh (scene lanes still batch — they stack
+        on the scene dim and shard 1-wide)."""
+        if self._mesh is None:
+            import jax
+
+            from maskclustering_tpu.parallel.batch import make_run_mesh
+            from maskclustering_tpu.parallel.mesh import make_mesh
+
+            # the fallback pins ONE device explicitly: make_mesh must
+            # cover every device it is handed, and multi-device hosts
+            # (8-core TPU, forced-multi-CPU tests) would reject (1, 1)
+            self._mesh = (make_run_mesh(self.cfg) if self.cfg.mesh_shape
+                          else make_mesh((1, 1),
+                                         devices=jax.devices()[:1]))
+        return self._mesh
+
+    def _batch_key(self, req: protocol.SceneRequest) -> Optional[tuple]:
+        """The packing scheduler's grouping key: the request's shape
+        bucket, or None for requests that must dispatch solo.
+
+        Solo (None): stream ops (one bucket per stream stays the rule),
+        resume requests (artifact-exists short-circuit is a sequential-
+        path contract), crash-requeued requests (they re-run pre-degraded
+        on their own ladder), scenes the router has not classified yet
+        (first sight classifies on the sequential path, repeats batch),
+        and scenes with a pending FaultPlan entry — the sequential
+        ladder owns fault handling, so a scripted fault fails or retries
+        ONLY its own request while batchmates pack normally.
+        """
+        if req.op != "scene" or req.resume or req.crashes:
+            return None
+        bucket = self.router.bucket_for(req.scene)
+        if bucket is None:
+            return None
+        plan = faults.active_plan()
+        if plan is not None and any(
+                e.scene == req.scene
+                and (e.remaining is None or e.remaining > 0)
+                for e in plan.entries):
+            return None
+        return bucket
+
+    def _serve_batch(self, batch: List[protocol.SceneRequest]) -> None:
+        """One fused scene-axis dispatch for up to S same-bucket requests.
+
+        Members are padded to exactly ``cfg.serve_batch_max`` lanes with
+        the router's warm pad tensors, so every occupancy >= 2 replays the
+        one full-width warm executable (solo requests take the sequential
+        path — the batch-width vocabulary is {1, S}). Results demux
+        per-lane: each member gets its own export, artifact digest,
+        journal rows and telemetry booking, byte-identical to sequential
+        execution; pad lanes book nothing anywhere. Any dispatch-level
+        failure falls the whole batch back to the sequential path, where
+        each member's own retry/degradation ladder takes over.
+        """
+        from maskclustering_tpu.datasets import get_dataset
+        from maskclustering_tpu.models.postprocess import export_artifacts
+        from maskclustering_tpu.obs import digest as sentinel
+        from maskclustering_tpu.parallel.batch import cluster_scene_batch
+        from maskclustering_tpu.parallel.mesh import mesh_label
+
+        members = [r for r in batch if self._book_arrival(r)]
+        if not members:
+            return
+        if len(members) == 1:
+            self._serve_scene(members[0])
+            return
+        # pure classification, NOT _batch_key: the solo-routing policy
+        # (fault plans, resume, crashes) belongs to the scheduler that
+        # built this batch — by the time a batch reaches the dispatcher
+        # its members pack, and scripted faults land per-lane below
+        bucket = self.router.bucket_for(members[0].scene)
+        if bucket is None:
+            for req in members:
+                self._serve_scene(req)
+            return
+        k_max, f_b, n_b = bucket
+        t0 = time.monotonic()
+        loaded: List[tuple] = []  # (req, dataset, tensors)
+        for req in members:
+            try:
+                if req.synthetic is not None:
+                    ensure_synthetic_scene(self.cfg, req.scene, req.synthetic)
+                ds = get_dataset(self.cfg.dataset, req.scene,
+                                 data_root=self.cfg.data_root)
+                tensors = faults.call_with_deadline(
+                    lambda ds=ds: ds.load_scene_tensors(self.cfg.step),
+                    self.cfg.watchdog_load_s, seam="load", scene=req.scene)
+                if self.router.classify_tensors(tensors) != bucket:
+                    # the remembered bucket went stale (scene bytes
+                    # changed on disk): serve it solo rather than force
+                    # it into the wrong executable
+                    self.router.remember(
+                        req.scene, self.router.classify_tensors(tensors))
+                    self._serve_scene(req)
+                    continue
+                loaded.append((req, ds, tensors))
+            except Exception as e:  # noqa: BLE001 — one member, not the batch
+                log.exception("serve: batch member %s failed to load",
+                              req.id)
+                self._finish_request(
+                    req, "failed", time.monotonic() - t0,
+                    telemetry_bucket=bucket,
+                    error=f"scene load: {e}"[:200],
+                    error_class=faults.classify_error(e))
+        if not loaded:
+            return
+        if len(loaded) == 1:
+            self._serve_scene(loaded[0][0])
+            return
+
+        width = max(int(self.cfg.serve_batch_max), len(loaded))
+        pad_tensors = self.router.pad_tensors_for(bucket)
+        if pad_tensors is None:
+            # no warm pad retained yet (first batch of a bucket warmed
+            # by real traffic): the first member's tensors pad — same
+            # executable shape, pad lanes still discarded
+            pad_tensors = loaded[0][2]
+            self.router.remember_pad_tensors(bucket, pad_tensors)
+        for req, _, _ in loaded:
+            _send(req, protocol.status(
+                req, "running", scene=req.scene, bucket=list(bucket),
+                warm=self.router.is_warm(bucket), batch=len(loaded)))
+
+        budget = self.cfg.watchdog_device_s
+        rems = [r.remaining_s() for r, _, _ in loaded
+                if not math.isinf(r.deadline_at)]
+        if rems:
+            tightest = max(min(rems), 0.01)
+            budget = min(budget, tightest) if budget > 0 else tightest
+        buckets_before = _scene_buckets()
+        try:
+            objects_list = faults.call_with_deadline(
+                lambda: cluster_scene_batch(
+                    self.cfg, self._run_mesh(),
+                    [t for _, _, t in loaded], k_max=k_max,
+                    seq_names=[r.scene for r, _, _ in loaded],
+                    pads=(f_b, n_b), width=width, pad_tensors=pad_tensors),
+                budget, seam="device",
+                scene=",".join(r.scene for r, _, _ in loaded))
+        except Exception:  # noqa: BLE001 — fall back, don't fail the batch
+            log.exception(
+                "serve: fused batch %s failed; falling back to the "
+                "sequential path", [r.id for r, _, _ in loaded])
+            obs.count("serve.batch.fallbacks")
+            for req, _, _ in loaded:
+                self._serve_scene(req)
+            return
+        wall = time.monotonic() - t0
+        new_buckets = _scene_buckets() - buckets_before
+        for b in new_buckets:
+            self.router.note_served(b)
+        self.router.note_served(bucket)
+        k = len(loaded)
+        per_scene = wall / k
+        obs.count("serve.batch.dispatches")
+        obs.count("serve.batch.packed_requests", k)
+        if width > k:
+            obs.count("serve.batch.pad_lanes", width - k)
+        self._batch_hist[k] = self._batch_hist.get(k, 0) + 1
+
+        mesh_lab = (mesh_label(self.cfg.mesh_shape) if self.cfg.mesh_shape
+                    else "single")
+        for (req, ds, _), objects in zip(loaded, objects_list):
+            journal = self._journal(req)
+            if journal is not None:
+                journal.begin_run()
+                journal.attempt(req.scene, 1, 0)
+            try:
+                faults.inject("export", req.scene)
+                export_artifacts(
+                    objects, req.scene, self.cfg.config_name,
+                    ds.object_dict_dir, prediction_root=self.prediction_root,
+                    top_k_repre=self.cfg.num_representative_masks)
+                # per-LANE invariant digest (never per-dispatch): the
+                # fused path materializes no DeviceHandoff, so the
+                # universal artifact fingerprint carries the identity
+                dg = sentinel.artifact_only_digest(
+                    objects, bucket="fused",
+                    count_dtype=self.cfg.count_dtype)
+                coord = sentinel.digest_coord(dg, mesh=mesh_lab)
+                if journal is not None:
+                    journal.outcome(
+                        req.scene, "ok", attempt=1, rung=0,
+                        seconds=per_scene,
+                        num_objects=len(objects.point_ids_list))
+                obs.record_span("serve.request", wall, request=req.id,
+                                scene=req.scene, batch=k)
+                self._finish_request(
+                    req, "ok", wall, telemetry_bucket=bucket,
+                    bucket=list(bucket), batch=k,
+                    scene_seconds=round(per_scene, 4), attempts=1, rung=0,
+                    num_objects=len(objects.point_ids_list),
+                    buckets_new=len(new_buckets),
+                    digest=dg, digest_coord=coord)
+            except Exception as e:  # noqa: BLE001 — one lane, not the batch
+                log.exception("serve: batch member %s export failed",
+                              req.id)
+                if journal is not None:
+                    journal.outcome(req.scene, "failed", attempt=1, rung=0,
+                                    error_class=faults.classify_error(e),
+                                    error=str(e)[:200], seconds=per_scene)
+                self._finish_request(
+                    req, "failed", wall, telemetry_bucket=bucket,
+                    batch=k, error=str(e).strip().splitlines()[-1][:200],
+                    error_class=faults.classify_error(e))
+            finally:
+                if journal is not None:
+                    journal.end_run()
+                    journal.close()
 
     # -- live-scan streaming (stream_chunk / stream_end ops) ----------------
 
@@ -552,11 +811,44 @@ class ServeWorker:
             return False
         self.router.note_served(bucket)
         obs.count("serve.warmup_scenes")
+        # the packing scheduler's pad-lane source: partial batches pad to
+        # full width with THIS bucket's warm synthetic tensors
+        self.router.remember_pad_tensors(bucket, tensors)
         # sentinel: retain the fitted tensors — canary probes replay them
         # byte-for-byte through the warm executables (never compiling,
         # never regenerating scenes host-side)
         if all(n != name for n, _ in self._warm_cache):
             self._warm_cache.append((name, tensors))
+        return True
+
+    def warm_batch_executable(self, name: str, tensors) -> bool:
+        """Warm the FULL-WIDTH fused executable for the scene's bucket.
+
+        One width-S dispatch per warm bucket (real lane = the warm scene,
+        pad lanes = the same tensors) so every packed batch — including
+        partial ones, which pad to exactly S — replays a warm executable:
+        zero post-warm compiles at any occupancy. No-op when batching is
+        off; best-effort like ``warm_tensors``.
+        """
+        if int(self.cfg.serve_batch_max) <= 1:
+            return False
+        from maskclustering_tpu.parallel.batch import cluster_scene_batch
+
+        bucket = self.router.classify_tensors(tensors)
+        width = int(self.cfg.serve_batch_max)
+        try:
+            with obs.span("serve.warmup_batch", scene=name, width=width):
+                cluster_scene_batch(
+                    self.cfg, self._run_mesh(), [tensors],
+                    k_max=bucket[0], seq_names=[name],
+                    pads=(bucket[1], bucket[2]), width=width,
+                    pad_tensors=tensors)
+        except Exception:  # noqa: BLE001 — warm-up must not kill startup
+            log.exception("serve: fused-batch warm-up %s (bucket %s, "
+                          "width %d) failed", name, bucket, width)
+            return False
+        self.router.remember_pad_tensors(bucket, tensors)
+        obs.count("serve.warmup_batches")
         return True
 
     # -- mct-sentinel canary probes -----------------------------------------
@@ -618,10 +910,29 @@ class ServeWorker:
                 "p95_s": round(percentile(vals, 95), 4),
                 "count": len(vals)}
 
+    def batch_stats(self) -> Optional[Dict]:
+        """Occupancy view of the packing scheduler (None when off):
+        dispatches, packed requests, mean occupancy, width histogram."""
+        if int(self.cfg.serve_batch_max) <= 1:
+            return None
+        hist = dict(self._batch_hist)
+        dispatches = sum(hist.values())
+        packed = sum(k * n for k, n in hist.items())
+        return {"max": int(self.cfg.serve_batch_max),
+                "linger_s": float(self.cfg.serve_batch_linger_s),
+                "dispatches": dispatches,
+                "packed_requests": packed,
+                "occupancy": (round(packed / dispatches, 3)
+                              if dispatches else None),
+                "hist": {str(k): hist[k] for k in sorted(hist)}}
+
     def stats(self) -> Dict:
         with self._lock:
             counts = dict(self._counts)
         out = {"counts": counts,
                "latency": self.latency_quantiles(),
                "warm_buckets": sorted(self.router.warm_buckets())}
+        batch = self.batch_stats()
+        if batch is not None:
+            out["batch"] = batch
         return out

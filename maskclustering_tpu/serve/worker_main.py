@@ -11,9 +11,12 @@ Wire contract (line-delimited JSON over the stdio pipes; stderr carries
 logging only):
 
 - stdin  <- ``{"op": "scene", "id": ..., ...}`` (protocol.forward_request
-  shape: remaining deadline, crash count), ``{"op": "canary"}`` (one
-  mct-sentinel probe round; answers ``{"kind": "canary", "probes": ...}``)
-  and ``{"op": "shutdown"}``; EOF == shutdown.
+  shape: remaining deadline, crash count), ``{"op": "batch",
+  "requests": [...]}`` (protocol.forward_batch: a same-bucket pack whose
+  members land in the local queue together so the worker's own scheduler
+  re-fuses them), ``{"op": "canary"}`` (one mct-sentinel probe round;
+  answers ``{"kind": "canary", "probes": ...}``) and
+  ``{"op": "shutdown"}``; EOF == shutdown.
 - stdout -> ``{"kind": "ready", ...}`` once warm (carries the warm-up
   wall, the AOT-cache restore stats and the retrace digest — the
   supervisor's proof the respawn reached first dispatch with zero
@@ -212,10 +215,15 @@ def main(argv=None) -> int:
     from maskclustering_tpu.serve.worker import ServeWorker
 
     router = Router(cfg, baseline_path=args.warm_baseline)
-    # the supervisor serializes; 2 = margin. metered=False: this queue is
-    # pipe plumbing — the PARENT's queue is the admission layer, and this
-    # one's counters must not relay up as doubled admission accounting
-    queue = AdmissionQueue(capacity=2, metered=False)
+    # the supervisor serializes dispatch units; 2 = margin, and a batch
+    # envelope lands all its members at once so the packing worker can
+    # re-fuse them (capacity must hold a full batch plus margin).
+    # metered=False: this queue is pipe plumbing — the PARENT's queue is
+    # the admission layer, and this one's counters must not relay up as
+    # doubled admission accounting
+    queue = AdmissionQueue(
+        capacity=max(2, int(getattr(cfg, "serve_batch_max", 1)) + 1),
+        metered=False)
     worker = ServeWorker(cfg, queue, router,
                          journal_dir=args.journal_dir,
                          prediction_root=args.prediction_root)
@@ -228,6 +236,10 @@ def main(argv=None) -> int:
     try:
         for name, tensors in router.warmup_workload():
             worker.warm_tensors(name, tensors)
+            # the width-S fused executable is a distinct program from the
+            # width-1 warm — compile it pre-freeze or the first packed
+            # batch books a post-warm violation
+            worker.warm_batch_executable(name, tensors)
         warm = [s for s in (args.warm or "").split("+") if s]
         if warm:
             from maskclustering_tpu.run import cluster_scenes
@@ -235,6 +247,22 @@ def main(argv=None) -> int:
             for st in cluster_scenes(cfg, warm, resume=False):
                 log.info("worker: warm scene %s -> %s", st.seq_name,
                          st.status)
+            if int(getattr(cfg, "serve_batch_max", 1) or 1) > 1:
+                # classify warm scenes + pay their width-S fused compile,
+                # mirroring daemon._warm_batch_from_disk
+                from maskclustering_tpu.datasets import get_dataset
+
+                for name in warm:
+                    try:
+                        ds = get_dataset(cfg.dataset, name,
+                                         data_root=cfg.data_root)
+                        tensors = ds.load_scene_tensors(cfg.step)
+                    except Exception:
+                        log.exception("worker: batch warm skipped for %s",
+                                      name)
+                        continue
+                    router.remember(name, router.classify_tensors(tensors))
+                    worker.warm_batch_executable(name, tensors)
     finally:
         faults.set_plan(drill)
     if not args.no_freeze and retrace_sanitizer.enabled():
@@ -276,20 +304,31 @@ def main(argv=None) -> int:
             emit_raw({"kind": "canary", "id": doc.get("id"),
                       "probes": probes})
             continue
-        if op not in protocol.SCENE_OPS:
-            continue
-        req = protocol.build_request(doc, str(doc.get("id") or "r-local"))
-        req.send = emit
-        flight.record(flight.KIND_REQUEST, event="received", request=req.id,
-                      scene=req.scene, op=req.op,
-                      **({"tenant": req.tenant} if req.tenant else {}))
-        ship_flight()  # victim identity must reach the parent pre-crash
-        try:
-            queue.submit(req)
-        except Exception as e:  # noqa: BLE001 — answer, never die silently
-            emit(protocol.result(req, "failed",
-                                 error=f"worker admission: {e}",
-                                 error_class="terminal"))
+        if op == "batch":
+            # the supervisor's packing envelope (protocol.forward_batch):
+            # all members land in the local queue in one stdin line, so
+            # the worker's own next_batch sees them together and re-packs
+            # the fused dispatch instead of draining one line at a time
+            member_docs = [d for d in (doc.get("requests") or ())
+                           if isinstance(d, dict)]
+        else:
+            if op not in protocol.SCENE_OPS:
+                continue
+            member_docs = [doc]
+        for member in member_docs:
+            req = protocol.build_request(member,
+                                         str(member.get("id") or "r-local"))
+            req.send = emit
+            flight.record(flight.KIND_REQUEST, event="received",
+                          request=req.id, scene=req.scene, op=req.op,
+                          **({"tenant": req.tenant} if req.tenant else {}))
+            ship_flight()  # victim identity must reach the parent pre-crash
+            try:
+                queue.submit(req)
+            except Exception as e:  # noqa: BLE001 — answer, never die silently
+                emit(protocol.result(req, "failed",
+                                     error=f"worker admission: {e}",
+                                     error_class="terminal"))
     drained = worker.stop(timeout_s=max(cfg.watchdog_device_s, 60.0) * 2)
     hb_stop.set()
     hb_thread.join(2.0)

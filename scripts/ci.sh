@@ -99,6 +99,17 @@
 #    contract, end to end (MCT_CANARY_DRILL=0 skips). FATAL. The
 #    cross-topology digest pins live in tests/test_sentinel.py.
 #
+# 3h. runs the continuous-batching pack drill (distinct exit code 11):
+#    the same 8-request mixed-bucket burst through a sequential daemon
+#    and through a packing daemon (serve_batch_max=3, open-loop
+#    arrivals via load_gen --rate). Asserts per-scene artifact digests
+#    and exported artifact CRCs byte-identical across the two paths,
+#    zero post-warm compiles in the packed daemon (warm pad lanes keep
+#    partial batches on the one width-S executable), and batch
+#    occupancy > 1.0 — the continuous-batching contract, end to end
+#    (MCT_PACK_SMOKE=0 skips). FATAL. The scheduler unit matrix lives
+#    in tests/test_serve_batch.py.
+#
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
@@ -106,10 +117,11 @@
 # mct-check finding or ruff violation (4), a concurrency-family finding
 # (5), a retrace-family finding (6), a serve-smoke failure (7), a
 # crash-respawn smoke failure (8), a streaming-smoke failure (9), a
-# canary-drill failure (10), or a perf regression (2), so it gates
-# correctness, fault tolerance, the invariants, thread safety, the
-# compile surface, the serving layer, crash containment, the streaming
-# contract, correctness observability AND the trajectory.
+# canary-drill failure (10), a pack-drill failure (11), or a perf
+# regression (2), so it gates correctness, fault tolerance, the
+# invariants, thread safety, the compile surface, the serving layer,
+# crash containment, the streaming contract, correctness observability,
+# the packing scheduler AND the trajectory.
 # Every gate still RUNS after a failure, but the exit code is the FIRST
 # failing gate's — triage by exit code points at the right gate.
 set -u -o pipefail
@@ -254,6 +266,26 @@ if [ "${MCT_CANARY_DRILL:-1}" != "0" ]; then
              "regenerate with load_gen --write-goldens; an undetected" \
              "corruption means the sentinel plane is dark)" >&2
         fail 10
+    fi
+fi
+
+if [ "${MCT_PACK_SMOKE:-1}" != "0" ]; then
+    echo "== ci: continuous-batching pack drill (packed vs sequential byte identity, <560s) =="
+    # the packing-scheduler gate: the same 8-request mixed-bucket burst
+    # runs once through the sequential path and once (open-loop arrivals)
+    # through the scene-axis packing scheduler — per-scene artifact
+    # digests and exported artifact CRCs must match byte for byte, the
+    # packed daemon must book ZERO post-warm compiles at every occupancy
+    # (warm synthetic pad lanes keep partial batches on the width-S
+    # executable), and occupancy must exceed 1.0 (the scheduler actually
+    # fused) — the continuous-batching contract, end to end
+    if ! timeout -k 10 560 env JAX_PLATFORMS=cpu \
+            python scripts/load_gen.py --pack-drill --requests 8 \
+            --no-ledger; then
+        echo "ci: pack drill FAILED (packed artifacts diverged from" \
+             "sequential, a partial batch recompiled, or the scheduler" \
+             "never fused a batch)" >&2
+        fail 11
     fi
 fi
 

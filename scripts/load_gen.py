@@ -104,25 +104,73 @@ def _address(args) -> object:
 
 def run_load(address, *, requests: int, concurrency: int, buckets: int,
              deadline_s: float, resume: bool,
-             tenant_mix: Optional[List[str]] = None) -> Dict:
-    """Fire the burst; returns the aggregate verdict fields."""
+             tenant_mix: Optional[List[str]] = None,
+             rate: float = 0.0,
+             collect: Optional[List[Dict]] = None) -> Dict:
+    """Fire the burst; returns the aggregate verdict fields.
+
+    ``rate > 0`` switches from the closed loop (``concurrency`` clients,
+    each firing its next request the moment the previous returns) to an
+    OPEN loop: request i is released at ``t0 + i/rate`` regardless of how
+    many are still in flight, the arrival process a live deployment sees.
+    ``collect`` (when given) receives every terminal result verbatim, for
+    callers that need per-request digests (the pack drill).
+    """
     from maskclustering_tpu.serve.client import ServeClient
 
     specs = list(BUCKET_SPECS[:max(1, min(buckets, len(BUCKET_SPECS)))])
     cycle = list(tenant_mix or [])
     sent_tenants: Dict[str, int] = {}
-    work: "queue.Queue[Tuple[int, str, Dict, str]]" = queue.Queue()
+    plan: List[Tuple[int, str, Dict, str]] = []
     for i in range(requests):
         name, params = specs[i % len(specs)]
         tenant = cycle[i % len(cycle)] if cycle else ""
         if tenant:
             sent_tenants[tenant] = sent_tenants.get(tenant, 0) + 1
-        work.put((i, name, params, tenant))
+        plan.append((i, name, params, tenant))
     results: List[Dict] = []
     latencies: List[float] = []
     rejects: Dict[str, int] = {}
     crash_events = [0]  # worker_crash status events seen (crash drills)
     lock = threading.Lock()
+
+    def one_request(client, i: int, name: str, params: Dict,
+                    tenant: str) -> None:
+        attempts = 0
+        while True:
+            terminal, _statuses, latency = client.run_scene(
+                name, synthetic=params, deadline_s=deadline_s,
+                resume=resume, tag=f"lg-{i:04d}", tenant=tenant)
+            ncrash = sum(1 for s in _statuses
+                         if s.get("state") == "worker_crash")
+            if ncrash:
+                with lock:
+                    crash_events[0] += ncrash
+            if terminal.get("kind") == "reject" \
+                    and terminal.get("reason") == "queue_full" \
+                    and attempts < 10:
+                # backpressure is the CONTRACT: count it, back off,
+                # resubmit — a full queue is not a failed request
+                attempts += 1
+                with lock:
+                    rejects["queue_full"] = \
+                        rejects.get("queue_full", 0) + 1
+                time.sleep(0.2 * attempts)
+                continue
+            break
+        with lock:
+            if terminal.get("kind") == "reject":
+                rejects[terminal.get("reason", "?")] = \
+                    rejects.get(terminal.get("reason", "?"), 0) + 1
+            else:
+                terminal.setdefault("scene", name)
+                results.append(terminal)
+                if terminal.get("status") == "ok":
+                    latencies.append(latency)
+
+    work: "queue.Queue[Tuple[int, str, Dict, str]]" = queue.Queue()
+    for item in plan:
+        work.put(item)
 
     def client_loop() -> None:
         with ServeClient(address, timeout_s=600.0) as client:
@@ -131,44 +179,32 @@ def run_load(address, *, requests: int, concurrency: int, buckets: int,
                     i, name, params, tenant = work.get_nowait()
                 except queue.Empty:
                     return
-                attempts = 0
-                while True:
-                    terminal, _statuses, latency = client.run_scene(
-                        name, synthetic=params, deadline_s=deadline_s,
-                        resume=resume, tag=f"lg-{i:04d}", tenant=tenant)
-                    ncrash = sum(1 for s in _statuses
-                                 if s.get("state") == "worker_crash")
-                    if ncrash:
-                        with lock:
-                            crash_events[0] += ncrash
-                    if terminal.get("kind") == "reject" \
-                            and terminal.get("reason") == "queue_full" \
-                            and attempts < 10:
-                        # backpressure is the CONTRACT: count it, back off,
-                        # resubmit — a full queue is not a failed request
-                        attempts += 1
-                        with lock:
-                            rejects["queue_full"] = \
-                                rejects.get("queue_full", 0) + 1
-                        time.sleep(0.2 * attempts)
-                        continue
-                    break
-                with lock:
-                    if terminal.get("kind") == "reject":
-                        rejects[terminal.get("reason", "?")] = \
-                            rejects.get(terminal.get("reason", "?"), 0) + 1
-                    else:
-                        results.append(terminal)
-                        if terminal.get("status") == "ok":
-                            latencies.append(latency)
+                one_request(client, i, name, params, tenant)
+
+    def open_loop_one(item: Tuple[int, str, Dict, str]) -> None:
+        with ServeClient(address, timeout_s=600.0) as client:
+            one_request(*((client,) + item))
 
     t0 = time.monotonic()
     threads = []
-    for i in range(max(1, concurrency)):
-        t = threading.Thread(target=client_loop, daemon=True,
-                             name=f"load-gen-{i}")
-        t.start()
-        threads.append(t)
+    if rate > 0:
+        # open loop: each request gets its own thread + connection,
+        # started on the arrival clock — completions never gate arrivals
+        for item in plan:
+            due = t0 + item[0] / rate
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=open_loop_one, args=(item,),
+                                 daemon=True, name=f"load-gen-{item[0]}")
+            t.start()
+            threads.append(t)
+    else:
+        for i in range(max(1, concurrency)):
+            t = threading.Thread(target=client_loop, daemon=True,
+                                 name=f"load-gen-{i}")
+            t.start()
+            threads.append(t)
     for t in threads:
         t.join(900.0)
     wall = time.monotonic() - t0
@@ -178,11 +214,13 @@ def run_load(address, *, requests: int, concurrency: int, buckets: int,
     ok = [r for r in results if r.get("status") == "ok"]
     failed = [r for r in results if r.get("status") not in ("ok", "skipped")]
     vals = sorted(latencies)
+    if collect is not None:
+        collect.extend(results)
 
     def pct(q: float) -> Optional[float]:
         return round(percentile(vals, q), 4) if vals else None
 
-    return {
+    verdict = {
         "metric": f"serve s/request (p50 of {requests} synthetic requests)",
         "value": pct(50),
         "unit": "s/request",
@@ -201,6 +239,25 @@ def run_load(address, *, requests: int, concurrency: int, buckets: int,
         "worker_crash_events": crash_events[0],
         "tenant_mix_sent": sent_tenants or None,
     }
+    if rate > 0:
+        verdict["arrival_rate_rps"] = rate
+        verdict["arrival"] = "open-loop"
+    # batch-occupancy histogram: every packed member's terminal carries
+    # batch=k, so each width-k fused dispatch contributes exactly k
+    # results; solo dispatches (width 1) have no batch field. Stamped
+    # only when packing was actually observed — a sequential run must
+    # NOT grow the batch dimension (obs.ledger.batch_dimension fence).
+    hist: Dict[int, int] = {}
+    for r in results:
+        w = int(r.get("batch", 1) or 1)
+        hist[w] = hist.get(w, 0) + 1
+    if any(w > 1 for w in hist):
+        dispatches = hist.get(1, 0) + sum(
+            max(1, int(round(n / w))) for w, n in hist.items() if w > 1)
+        verdict["batch_hist"] = {str(w): hist[w] for w in sorted(hist)}
+        verdict["batch_dispatches"] = dispatches
+        verdict["batch_occupancy"] = round(len(results) / dispatches, 3)
+    return verdict
 
 
 def append_ledger_row(verdict: Dict, path: Optional[str]) -> None:
@@ -629,6 +686,226 @@ def run_smoke(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# the pack drill: packed scheduler vs sequential path, byte for byte
+# ---------------------------------------------------------------------------
+
+
+def _artifact_crcs(root: str) -> Dict[str, str]:
+    """CRC32 every artifact under ``root`` keyed by relative path.
+
+    ``.npz`` members are hashed per-array (bytes + dtype + shape): the
+    zip container embeds write timestamps, so raw file bytes differ
+    between two runs that produced identical arrays."""
+    import zlib
+
+    import numpy as np
+
+    out: Dict[str, str] = {}
+    if not os.path.isdir(root):
+        return out
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in sorted(files):
+            p = os.path.join(dirpath, fn)
+            rel = os.path.relpath(p, root)
+            if fn.endswith(".npz"):
+                with np.load(p, allow_pickle=True) as z:
+                    for key in sorted(z.files):
+                        arr = np.asarray(z[key])
+                        crc = zlib.crc32(arr.tobytes())
+                        crc = zlib.crc32(str(arr.dtype).encode(), crc)
+                        crc = zlib.crc32(str(arr.shape).encode(), crc)
+                        out[f"{rel}:{key}"] = f"{crc & 0xffffffff:08x}"
+            else:
+                with open(p, "rb") as fh:
+                    out[rel] = f"{zlib.crc32(fh.read()) & 0xffffffff:08x}"
+    return out
+
+
+def _pack_phase(tag: str, *, requests: int, extra_sets: Tuple[str, ...],
+                rate: float, concurrency: int, startup_s: float,
+                collect: List[Dict]):
+    """One drill phase: fresh daemon over its own tmp data_root (the
+    synthetic scenes are seed-deterministic, so artifacts compare across
+    phases), bounded burst, SIGTERM drain.
+
+    Returns ``(verdict, final_digest, artifact_crcs, failures)``."""
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    write_scannet_layout)
+
+    tmp = tempfile.mkdtemp(prefix=f"mct_pack_{tag}_")
+    sock = os.path.join(tmp, "mct.sock")
+    warm_names = []
+    for name, params in BUCKET_SPECS:
+        kw = dict(params)
+        kw["image_hw"] = tuple(kw["image_hw"])
+        write_scannet_layout(make_scene(**kw), tmp, name)
+        warm_names.append(name)
+    cmd = [sys.executable, "-m", "maskclustering_tpu.serve",
+           "--config", "scannet", "--socket", sock, "--data_root", tmp,
+           "--capacity", str(max(8, requests)), "--retrace-sanitizer",
+           "--aot-cache", os.path.join(tmp, "aot"),
+           "--obs_events", os.path.join(tmp, "serve_events.jsonl"),
+           "--warm", "+".join(warm_names), "--telemetry-window", "1.0"]
+    for kv in SMOKE_CONFIG_SETS + tuple(extra_sets):
+        cmd += ["--set", kv]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    log(f"pack-drill[{tag}]: starting daemon: {' '.join(cmd)}")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, cwd=REPO_ROOT,
+                            env=env, text=True)
+    failures: List[str] = []
+    verdict: Dict = {}
+    digest = None
+    out = ""
+    try:
+        if not _wait_for_socket(sock, proc, timeout_s=startup_s):
+            proc.kill()
+            return verdict, None, {}, [f"{tag}: daemon never became "
+                                       f"reachable"]
+        verdict = run_load(sock, requests=requests, concurrency=concurrency,
+                           buckets=2, deadline_s=0.0, resume=False,
+                           rate=rate, collect=collect)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=90.0)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return verdict, None, {}, [f"{tag}: daemon did not drain within "
+                                   f"90s of SIGTERM"]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    for line in (out or "").splitlines():
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if doc.get("kind") == "digest":
+            digest = doc
+    if proc.returncode != 143:
+        failures.append(f"{tag}: daemon exit code {proc.returncode} "
+                        f"(expected 143 — SIGTERM-clean drain)")
+    if digest is None:
+        failures.append(f"{tag}: daemon printed no final digest line")
+    else:
+        retrace = digest.get("retrace") or {}
+        if retrace.get("post_freeze"):
+            failures.append(f"{tag}: {retrace['post_freeze']} post-warm "
+                            f"compile(s) — the serve-many contract broke")
+        if not retrace.get("frozen"):
+            failures.append(f"{tag}: retrace sanitizer never froze")
+    if verdict.get("ok") != requests:
+        failures.append(f"{tag}: only {verdict.get('ok')}/{requests} "
+                        f"requests answered ok")
+    return verdict, digest, _artifact_crcs(os.path.join(tmp, "prediction")), \
+        failures
+
+
+def run_pack_drill(args) -> int:
+    """The continuous-batching CI gate: the same mixed-bucket burst runs
+    once through the sequential path and once (open-loop) through the
+    packing scheduler; the packed run must be byte-identical — per-scene
+    artifact digests AND exported artifact CRCs — with zero post-warm
+    compiles, occupancy > 1, and per-request p50 strictly below
+    batch_max x the sequential p50."""
+    S = max(2, int(args.pack_batch_max))
+    rate = args.rate if args.rate > 0 else 12.0
+    seq_results: List[Dict] = []
+    pack_results: List[Dict] = []
+    v_seq, _d_seq, crc_seq, failures = _pack_phase(
+        "seq", requests=args.requests, extra_sets=(),
+        rate=0.0, concurrency=args.concurrency,
+        startup_s=args.smoke_startup_s, collect=seq_results)
+    v_pack, _d_pack, crc_pack, fail_pack = _pack_phase(
+        "packed", requests=args.requests,
+        extra_sets=(f"serve_batch_max={S}",
+                    f"serve_batch_linger_s={args.pack_linger}"),
+        rate=rate, concurrency=args.concurrency,
+        startup_s=args.smoke_startup_s, collect=pack_results)
+    failures += fail_pack
+
+    def by_scene(rows: List[Dict]) -> Dict[str, set]:
+        m: Dict[str, set] = {}
+        for r in rows:
+            if r.get("status") == "ok":
+                m.setdefault(str(r.get("scene")), set()).add(
+                    (r.get("digest") or {}).get("artifact"))
+        return m
+
+    # invariant-digest identity: the `artifact` fingerprint is the one
+    # digest field both paths compute (the fused mesh path materializes
+    # no DeviceHandoff, so `plane` is sequential-only by design)
+    seq_dg, pack_dg = by_scene(seq_results), by_scene(pack_results)
+    for scene in sorted(set(seq_dg) | set(pack_dg)):
+        sa = seq_dg.get(scene, set())
+        sb = pack_dg.get(scene, set())
+        for label, s in (("sequential", sa), ("packed", sb)):
+            if len(s) != 1 or None in s:
+                failures.append(f"{label} artifact digests for {scene} not "
+                                f"unanimous: {sorted(map(str, s))}")
+        if sa and sb and sa != sb:
+            failures.append(f"artifact digest DIVERGED for {scene}: "
+                            f"sequential {sorted(map(str, sa))} vs packed "
+                            f"{sorted(map(str, sb))}")
+    if crc_seq != crc_pack:
+        diff = sorted(k for k in set(crc_seq) | set(crc_pack)
+                      if crc_seq.get(k) != crc_pack.get(k))
+        failures.append(f"artifact CRCs diverged between the paths: "
+                        f"{diff[:8]}{'...' if len(diff) > 8 else ''}")
+    elif not crc_seq:
+        failures.append("no artifacts found to compare — both prediction "
+                        "trees are empty")
+    occ = v_pack.get("batch_occupancy")
+    if not occ or occ <= 1.0:
+        failures.append(f"batch occupancy {occ} — the packing scheduler "
+                        f"never fused a batch (hist "
+                        f"{v_pack.get('batch_hist')})")
+    # the S-x latency bound is a SCENE-AXIS-PARALLEL claim: with >= S
+    # devices each lane runs on its own hardware and a width-S dispatch
+    # must beat S sequential runs. On fewer devices (single-CPU CI) the
+    # fused dispatch serializes its lanes over the fused step's
+    # worst-case mask capacity, so the bound cannot hold — the byte
+    # identity / zero-compile / occupancy gates above still do, and the
+    # latency comparison degrades to an advisory log.
+    try:
+        import jax
+        n_dev = len(jax.devices())
+    except Exception:  # noqa: BLE001 — no backend: advisory only
+        n_dev = 1
+    latency_gated = n_dev >= S
+    verdict_gate = "enforced" if latency_gated else "advisory"
+    p50_seq, p50_pack = v_seq.get("value"), v_pack.get("value")
+    if p50_seq and p50_pack is not None and p50_pack >= S * p50_seq:
+        msg = (f"packed p50 {p50_pack}s >= {S}x sequential p50 {p50_seq}s "
+               f"— batching lost to the sequential path outright")
+        if latency_gated:
+            failures.append(msg)
+        else:
+            log(f"pack-drill: ADVISORY ({n_dev} device(s) < width {S}) — "
+                f"{msg}")
+    verdict = dict(v_pack)
+    verdict["latency_gate"] = verdict_gate
+    verdict["pack_drill"] = True
+    verdict["batch_max"] = S
+    verdict["arrival_rate_rps"] = rate
+    verdict["sequential_p50_s"] = p50_seq
+    verdict["sequential_wall_s"] = v_seq.get("wall_s")
+    verdict["crc_entries"] = len(crc_pack)
+    if failures:
+        verdict["error"] = "; ".join(failures)
+    print(json.dumps(verdict, sort_keys=True), flush=True)
+    if not args.no_ledger:
+        append_ledger_row(verdict, args.ledger)
+    if failures:
+        for f in failures:
+            log(f"pack-drill: FAIL — {f}")
+        return 1
+    log(f"pack-drill: PASS — occupancy {occ} (hist "
+        f"{verdict.get('batch_hist')}), {len(crc_pack)} artifact CRCs + "
+        f"per-scene digests byte-identical to sequential, zero post-warm "
+        f"compiles, p50 {p50_pack}s vs sequential {p50_seq}s")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # mct-sentinel: the audited goldens regeneration + the canary drill
 # ---------------------------------------------------------------------------
 
@@ -955,6 +1232,11 @@ def main(argv=None) -> int:
                              "burst (name:weight, comma-joined); arms the "
                              "per-tenant accounting assertions (smoke "
                              "default: A:3,B:1)")
+    parser.add_argument("--rate", type=float, default=0.0,
+                        help="open-loop arrival rate in requests/s: request "
+                             "i is released at t0 + i/rate regardless of "
+                             "in-flight count (0 = closed loop driven by "
+                             "--concurrency)")
     parser.add_argument("--resume", action="store_true",
                         help="send resume=true (repeats become artifact "
                              "skips — throughput numbers then measure "
@@ -985,6 +1267,18 @@ def main(argv=None) -> int:
     parser.add_argument("--fault-plan", default=None,
                         help="smoke only: FaultPlan spec passed to the "
                              "daemon (e.g. 'flaky:lg-b:1')")
+    parser.add_argument("--pack-drill", action="store_true",
+                        help="the continuous-batching CI gate: one "
+                             "sequential daemon + one packing daemon over "
+                             "the same mixed-bucket burst; artifact CRCs "
+                             "and per-scene digests must match byte for "
+                             "byte, zero post-warm compiles, occupancy > 1")
+    parser.add_argument("--pack-batch-max", type=int, default=3,
+                        help="pack drill: serve_batch_max for the packing "
+                             "daemon (default 3)")
+    parser.add_argument("--pack-linger", type=float, default=0.3,
+                        help="pack drill: serve_batch_linger_s for the "
+                             "packing daemon (default 0.3)")
     parser.add_argument("--write-goldens", nargs="?", const=DEFAULT_GOLDENS,
                         default=None, metavar="PATH",
                         help="regenerate canary_goldens.json (flag alone: "
@@ -1010,6 +1304,8 @@ def main(argv=None) -> int:
         return run_write_goldens(args)
     if args.canary_drill:
         return run_canary_drill(args)
+    if args.pack_drill:
+        return run_pack_drill(args)
     if args.smoke:
         return run_smoke(args)
     if not args.socket and not args.host:
@@ -1018,7 +1314,7 @@ def main(argv=None) -> int:
     verdict = run_load(_address(args), requests=args.requests,
                        concurrency=args.concurrency, buckets=args.buckets,
                        deadline_s=args.deadline, resume=args.resume,
-                       tenant_mix=tenant_mix)
+                       tenant_mix=tenant_mix, rate=args.rate)
     from maskclustering_tpu.serve.client import ServeClient
 
     tenant_failures: List[str] = []

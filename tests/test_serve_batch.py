@@ -1,0 +1,346 @@
+"""Continuous scene batching: the packing scheduler's tier-1 matrix.
+
+Unit coverage for the admission queue's same-bucket hunt
+(``next_batch``), the worker's solo/batch routing gates, the warm-pad
+demux (pad lanes excluded from results and accounting), single-member
+fault isolation inside a fused batch, and packed-vs-sequential artifact
+identity at the worker level. The end-to-end gate — two real daemons,
+exported artifact CRCs, zero post-warm compiles under a frozen retrace
+sanitizer, occupancy > 1 — lives in ``scripts/load_gen.py --pack-drill``
+(ci.sh exit code 11); the heavier supervisor plumbing is pinned in
+tests/test_serve_supervisor.py.
+"""
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+from maskclustering_tpu.config import load_config
+from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.serve.admission import AdmissionQueue
+from maskclustering_tpu.serve.router import Router
+from maskclustering_tpu.utils import faults
+
+# the shared tiny fused-batch fixture shapes (test_parallel.py sizes —
+# NOT fresh full-depth scenes; tier-1 wall budget)
+SPEC_P = {"num_boxes": 3, "num_frames": 8, "image_hw": (32, 48),
+          "spacing": 0.08, "seed": 60}
+SPEC_Q = {"num_boxes": 3, "num_frames": 8, "image_hw": (32, 48),
+          "spacing": 0.08, "seed": 61}
+
+
+def _cfg(data_root, **kw):
+    base = dict(data_root=str(data_root), config_name="batched", step=1,
+                distance_threshold=0.05, mask_pad_multiple=32,
+                frame_pad_multiple=8)
+    base.update(kw)
+    return load_config("scannet").replace(**base)
+
+
+def _req(scene, i, *, synthetic=None, deadline_s=0.0, **kw):
+    doc = {"op": "scene", "scene": scene}
+    if synthetic is not None:
+        doc["synthetic"] = {k: list(v) if isinstance(v, tuple) else v
+                            for k, v in synthetic.items()}
+    if deadline_s:
+        doc["deadline_s"] = deadline_s
+    doc.update(kw)
+    return protocol.build_request(protocol.parse_line(json.dumps(doc)),
+                                  f"r-{i:06d}")
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.set_plan(None)
+    faults.clear_stop()
+    yield
+    faults.set_plan(None)
+    faults.clear_stop()
+
+
+# ---------------------------------------------------------------------------
+# units: AdmissionQueue.next_batch (pure scheduling, no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_next_batch_groups_same_bucket_and_requeues_skipped():
+    q = AdmissionQueue(8, metered=False)
+    key = {"a": ("A",), "b": ("B",)}
+    for i, s in enumerate(["a", "b", "a", "a", "b"]):
+        q.submit(_req(s, i))
+    batch = q.next_batch(lambda r: key[r.scene], max_n=3, linger_s=0.0,
+                         timeout_s=0.1)
+    # head's bucket wins; same-bucket company joins up to max_n, in order
+    assert [r.id for r in batch] == ["r-000000", "r-000002", "r-000003"]
+    # skipped B requests kept THEIR arrival order, ahead of the queue
+    batch2 = q.next_batch(lambda r: key[r.scene], max_n=3, linger_s=0.0,
+                          timeout_s=0.1)
+    assert [r.id for r in batch2] == ["r-000001", "r-000004"]
+    assert q.next_batch(lambda r: key[r.scene], max_n=3, linger_s=0.0,
+                        timeout_s=0.05) is None
+    assert q.depth() == 0
+
+
+def test_next_batch_respects_max_n_and_stash_survives_drain():
+    q = AdmissionQueue(8, metered=False)
+    for i in range(5):
+        q.submit(_req("a" if i != 1 else "b", i))
+    batch = q.next_batch(lambda r: (r.scene,), max_n=2, linger_s=0.0,
+                         timeout_s=0.1)
+    assert [r.id for r in batch] == ["r-000000", "r-000002"]
+    # the skipped "b" head plus the unclaimed "a" tail are all still owed:
+    # drain (the shutdown path) must surface stash + queue, in order
+    assert [r.id for r in q.drain()] == ["r-000001", "r-000003", "r-000004"]
+
+
+def test_next_batch_unbatchable_key_dispatches_solo_immediately():
+    q = AdmissionQueue(4, metered=False)
+    q.submit(_req("solo", 0))
+    q.submit(_req("solo", 1))
+    t0 = time.monotonic()
+    batch = q.next_batch(lambda r: None, max_n=4, linger_s=5.0,
+                         timeout_s=0.1)
+    # key None (stream / resume / unknown bucket) must NOT linger
+    assert [r.id for r in batch] == ["r-000000"]
+    assert time.monotonic() - t0 < 1.0
+    # max_n <= 1 (batching off) is the plain pop, also linger-free
+    batch = q.next_batch(lambda r: (r.scene,), max_n=1, linger_s=5.0,
+                         timeout_s=0.1)
+    assert [r.id for r in batch] == ["r-000001"]
+
+
+def test_next_batch_linger_clipped_by_member_deadline():
+    q = AdmissionQueue(4, metered=False)
+    q.submit(_req("a", 0, deadline_s=0.2))
+    t0 = time.monotonic()
+    batch = q.next_batch(lambda r: ("A",), max_n=4, linger_s=30.0,
+                         timeout_s=0.1)
+    waited = time.monotonic() - t0
+    assert [r.id for r in batch] == ["r-000000"]
+    # the window is linger clipped to HALF the member's remaining budget
+    # (0.1s here), never the raw 30s linger: a lone request must not burn
+    # its latency budget waiting for company
+    assert waited < 2.0, waited
+
+
+def test_next_batch_lingers_for_late_same_bucket_company():
+    import threading
+
+    q = AdmissionQueue(4, metered=False)
+    q.submit(_req("a", 0))
+
+    def late_submit():
+        time.sleep(0.15)
+        q.submit(_req("a", 1))
+
+    t = threading.Thread(target=late_submit)
+    t.start()
+    batch = q.next_batch(lambda r: ("A",), max_n=4, linger_s=2.0,
+                         timeout_s=0.1)
+    t.join()
+    # the linger window existed to catch exactly this arrival
+    assert [r.id for r in batch] == ["r-000000", "r-000001"]
+
+
+# ---------------------------------------------------------------------------
+# units: the worker's batch gates (no dispatch)
+# ---------------------------------------------------------------------------
+
+
+def _make_worker(tmp_path, **cfg_kw):
+    from maskclustering_tpu.serve.worker import ServeWorker
+
+    cfg = _cfg(tmp_path, **cfg_kw)
+    queue = AdmissionQueue(8, metered=False)
+    router = Router(cfg)
+    return ServeWorker(cfg, queue, router), cfg, queue, router
+
+
+def test_worker_batch_key_gates_streams_resume_crashes_and_faults(tmp_path):
+    worker, _cfg_, _q, router = _make_worker(tmp_path, serve_batch_max=3)
+    bucket = (7, 8, 4096)
+    router.remember("known", bucket)
+    assert worker._batch_key(_req("known", 0)) == bucket
+    # unknown bucket -> solo (classification happens on the sequential path)
+    assert worker._batch_key(_req("novel", 1)) is None
+    # resume requests skip execution entirely -> never packed
+    assert worker._batch_key(_req("known", 2, resume=True)) is None
+    # crash-requeued requests rerun their own degradation ladder -> solo
+    crashed = _req("known", 3)
+    crashed.crashes = 1
+    assert worker._batch_key(crashed) is None
+    # a scene with a pending FaultPlan entry must stay solo so the drill
+    # lands on the sequential path's retry ladder, not on batchmates —
+    # including unlimited entries (remaining=None)
+    faults.set_plan(faults.FaultPlan.from_spec("flaky:known:1"))
+    assert worker._batch_key(_req("known", 4)) is None
+    faults.set_plan(faults.FaultPlan.from_spec("load:known"))
+    assert worker._batch_key(_req("known", 5)) is None
+    faults.set_plan(faults.FaultPlan.from_spec("flaky:other:1"))
+    assert worker._batch_key(_req("known", 6)) == bucket
+
+
+# ---------------------------------------------------------------------------
+# fused dispatch: warm-pad demux + fault isolation + byte identity
+# (one module-scoped worker; tiny 32x48 scenes — the shared cheap shapes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def benv(tmp_path_factory):
+    from maskclustering_tpu.run import init_backend_or_die
+    from maskclustering_tpu.serve.worker import ServeWorker
+
+    init_backend_or_die(120.0, platform="cpu")
+    tmp = tmp_path_factory.mktemp("serve_batch")
+    cfg = _cfg(tmp, serve_batch_max=3, serve_batch_linger_s=0.02)
+    queue = AdmissionQueue(8, metered=False)
+    router = Router(cfg)
+    worker = ServeWorker(cfg, queue, router)
+    return worker, cfg, router
+
+
+def _run_capture(fn, *reqs):
+    """Bind capture sinks to the requests, run, return events per request."""
+    sinks = []
+    for r in reqs:
+        events = []
+        r.send = events.append
+        sinks.append(events)
+    fn(list(reqs))
+    return sinks
+
+
+def _terminal(events):
+    out = [e for e in events if e.get("kind") == "result"]
+    assert len(out) == 1, events
+    return out[0]
+
+
+def test_packed_batch_byte_identical_to_sequential_with_warm_pad(benv):
+    worker, cfg, router = benv
+    # sequential reference first: classifies + remembers both buckets and
+    # yields the per-scene artifact digests the packed run must reproduce
+    seq = {}
+    for i, (scene, spec) in enumerate([("bt-p", SPEC_P), ("bt-q", SPEC_Q)]):
+        req = _req(scene, 10 + i, synthetic=spec)
+        events = _run_capture(lambda b: worker._serve_one(b[0]), req)[0]
+        term = _terminal(events)
+        assert term["status"] == "ok", term
+        assert "batch" not in term  # sequential results carry no width
+        seq[scene] = term
+    bucket = router.bucket_for("bt-p")
+    assert bucket is not None and bucket == router.bucket_for("bt-q")
+
+    # packed: 2 members, serve_batch_max=3 -> one width-3 dispatch with a
+    # warm pad lane; per-lane demux must hand each member its own ok +
+    # digest, byte-identical to its sequential run
+    reqs = [_req("bt-p", 20, synthetic=SPEC_P),
+            _req("bt-q", 21, synthetic=SPEC_Q)]
+    sinks = _run_capture(worker._serve_batch, *reqs)
+    stats = worker.batch_stats()
+    # one width-2-occupancy dispatch (hist keys are JSON-friendly strings)
+    assert stats["hist"].get("2") == 1, stats
+    for req, events in zip(reqs, sinks):
+        term = _terminal(events)
+        assert term["status"] == "ok", term
+        assert term["batch"] == 2
+        # the artifact fingerprint is the cross-path identity claim (the
+        # fused path materializes no DeviceHandoff, so `plane` is
+        # sequential-only by design)
+        assert term["digest"]["artifact"] == \
+            seq[req.scene]["digest"]["artifact"]
+        assert seq[req.scene]["digest"]["artifact"]
+        # the census coordinate survives the fused path, stamped with the
+        # fused bucket label and the full 5-field grammar
+        coord = term["digest_coord"]
+        assert coord.startswith("fused|") and len(coord.split("|")) == 5
+    # the pad lane came from the router's retained warm tensors path
+    assert router.pad_tensors_for(bucket) is not None
+
+
+def test_single_member_export_fault_isolated_to_its_lane(benv):
+    worker, cfg, router = benv
+    before = dict(worker.batch_stats())
+    # the fault fires at the EXPORT seam inside the demux loop — after the
+    # fused dispatch succeeded — so exactly one lane may fail
+    faults.set_plan(faults.FaultPlan.from_spec("fail:bt-p.export:1"))
+    reqs = [_req("bt-p", 30, synthetic=SPEC_P),
+            _req("bt-q", 31, synthetic=SPEC_Q)]
+    sinks = _run_capture(worker._serve_batch, *reqs)
+    term_p, term_q = _terminal(sinks[0]), _terminal(sinks[1])
+    assert term_p["status"] == "failed" and term_p["batch"] == 2
+    assert term_q["status"] == "ok" and term_q["batch"] == 2
+    after = worker.batch_stats()
+    # the dispatch itself succeeded: one more fused dispatch, no fallback
+    assert after["dispatches"] == before["dispatches"] + 1
+
+
+def test_batch_dispatch_failure_falls_back_to_sequential(benv, monkeypatch):
+    import maskclustering_tpu.parallel.batch as pb
+
+    worker, cfg, router = benv
+
+    def boom(*a, **kw):
+        raise RuntimeError("scripted dispatch failure")
+
+    monkeypatch.setattr(pb, "cluster_scene_batch", boom)
+    before = dict(worker.batch_stats())
+    reqs = [_req("bt-p", 40, synthetic=SPEC_P),
+            _req("bt-q", 41, synthetic=SPEC_Q)]
+    sinks = _run_capture(worker._serve_batch, *reqs)
+    for events in sinks:
+        term = _terminal(events)
+        # every member still answers ok — via its own sequential ladder
+        assert term["status"] == "ok", term
+        assert "batch" not in term
+    after = worker.batch_stats()
+    assert after["dispatches"] == before["dispatches"]
+
+
+def test_warm_batch_executable_noop_when_batching_off(tmp_path):
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    to_scene_tensors)
+
+    worker, cfg, _q, router = _make_worker(tmp_path)  # serve_batch_max=1
+    tensors = to_scene_tensors(make_scene(**SPEC_P))
+    worker.warm_batch_executable("w", tensors)
+    assert worker.batch_stats() is None
+    assert router.pad_tensors_for(router.classify_tensors(tensors)) is None
+
+
+def test_cluster_scene_batch_pad_lanes_never_returned():
+    """parallel/batch contract the scheduler leans on: width pins the
+    dispatch shape, pad_tensors fill the extra lanes, and exactly
+    len(tensors_list) results come back."""
+    import jax
+
+    from maskclustering_tpu.config import PipelineConfig
+    from maskclustering_tpu.models.pipeline import run_scene
+    from maskclustering_tpu.parallel.batch import cluster_scene_batch
+    from maskclustering_tpu.parallel.mesh import make_mesh
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    to_scene_tensors)
+
+    cfg = PipelineConfig(
+        config_name="padtest", dataset="demo", distance_threshold=0.06,
+        few_points_threshold=10, point_chunk=1024, frame_pad_multiple=8,
+        mask_pad_multiple=8)
+    tensors = [to_scene_tensors(make_scene(
+        num_boxes=3, num_frames=8, image_hw=(32, 48), spacing=0.08, seed=s))
+        for s in (60, 61)]
+    pad = to_scene_tensors(make_scene(
+        num_boxes=3, num_frames=8, image_hw=(32, 48), spacing=0.08, seed=99))
+    mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+    objs = cluster_scene_batch(cfg, mesh, tensors, k_max=7, width=3,
+                               pad_tensors=pad)
+    assert len(objs) == 2  # the pad lane's output is discarded, not demuxed
+    for t, om in zip(tensors, objs):
+        ref = run_scene(t, cfg, k_max=7).objects
+        assert len(om.point_ids_list) == len(ref.point_ids_list)
+        for a, b in zip(om.point_ids_list, ref.point_ids_list):
+            np.testing.assert_array_equal(a, b)
+        assert om.mask_list == ref.mask_list

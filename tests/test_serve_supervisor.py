@@ -222,6 +222,8 @@ def test_scene_supervisor_initial_rungs():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # minutes of real subprocess warm-up; ci.sh gates the
+# same contract end to end via the rc-8 crash-respawn smoke
 def test_real_worker_crash_respawn_byte_identical_zero_compiles(tmp_path):
     """The ISSUE-12 acceptance on a real worker subprocess pair: a
     scripted SIGKILL under an exporting request -> typed worker_crash +
