@@ -13,9 +13,80 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 _CONFIG_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "configs")
+
+
+def parse_carve_spec(spec: str) -> Tuple[int, int]:
+    """``"KxC"`` -> (workers, chips_per_worker), with typed errors.
+
+    Pure grammar: the device-product division check lives at pool start
+    (serve/pool.py), where the backend is visible.
+    """
+    parts = spec.lower().split("x")
+    if len(parts) != 2:
+        raise ValueError(
+            f"serve_carve must be 'KxC' (workers x chips), got {spec!r}")
+    try:
+        workers, chips = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"serve_carve must be 'KxC' with integer K and C, "
+            f"got {spec!r}") from None
+    if workers < 1 or chips < 1:
+        raise ValueError(
+            f"serve_carve needs K >= 1 and C >= 1, got {spec!r}")
+    return workers, chips
+
+
+def parse_tenant_spec(spec: str) -> Dict[str, Tuple[float, Optional[int]]]:
+    """``"name:weight[:quota],..."`` -> {name: (weight, quota_or_None)}.
+
+    The pool scheduler's QoS table (weight = weighted-fair dequeue
+    share; quota = max queued requests before a typed ``quota`` reject).
+    Typed errors per the PR-5 config validation pattern.
+    """
+    table: Dict[str, Tuple[float, Optional[int]]] = {}
+    for entry in (e.strip() for e in spec.split(",")):
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"serve_tenants entry must be 'name:weight' or "
+                f"'name:weight:quota', got {entry!r}")
+        name = parts[0]
+        if not name or "/" in name or "\\" in name:
+            raise ValueError(
+                f"serve_tenants name must be non-empty without path "
+                f"separators, got {name!r}")
+        if name in table:
+            raise ValueError(f"serve_tenants repeats tenant {name!r}")
+        try:
+            weight = float(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"serve_tenants weight must be a number, got "
+                f"{parts[1]!r} for tenant {name!r}") from None
+        if weight <= 0:
+            raise ValueError(
+                f"serve_tenants weight must be > 0, got {weight} for "
+                f"tenant {name!r}")
+        quota: Optional[int] = None
+        if len(parts) == 3:
+            try:
+                quota = int(parts[2])
+            except ValueError:
+                raise ValueError(
+                    f"serve_tenants quota must be an integer, got "
+                    f"{parts[2]!r} for tenant {name!r}") from None
+            if quota < 1:
+                raise ValueError(
+                    f"serve_tenants quota must be >= 1, got {quota} "
+                    f"for tenant {name!r}")
+        table[name] = (weight, quota)
+    return table
 
 
 @dataclasses.dataclass(frozen=True)
@@ -221,6 +292,29 @@ class PipelineConfig:
     # remaining deadline budget, so a lone request never waits past it.
     serve_batch_linger_s: float = 0.05
 
+    # --- worker pool (serve/pool.py) ---
+    # how many supervised device-owning worker subprocesses the daemon
+    # runs (1 = the classic single-worker topology). Each worker is a
+    # full PR-12 crash-containment ladder (heartbeat, SIGKILL, bounded
+    # respawn) over its own device slice; the pool scheduler routes by
+    # bucket affinity and weighted-fair tenant share
+    serve_workers: int = 1
+    # device carve spec "KxC": K workers x C chips each, reusing the
+    # make_run_mesh scene x frame x point product vocabulary (a v5e-8 is
+    # "4x2" for small buckets or "1x8" for 1M-point scenes). "" = every
+    # worker sees the whole backend (CPU tests / single-chip hosts). K
+    # must equal serve_workers; K*C must divide the device product —
+    # grammar is validated here, the device check happens at pool start
+    # (the config cannot see the backend)
+    serve_carve: str = ""
+    # tenant QoS spec "name:weight[:quota],...": weight > 0 sets the
+    # weighted-fair dequeue share (a 3:1 weight ratio yields ~3:1
+    # completions under saturation), optional integer quota >= 1 bounds
+    # the tenant's QUEUED (admitted, pre-dispatch) requests — exceeding
+    # it answers a typed "quota" reject. Unlisted tenants serve at
+    # weight 1 with no quota; "" = no QoS (FIFO)
+    serve_tenants: str = ""
+
     # --- persistent AOT executable cache (utils/aot_cache.py) ---
     # "" = off (unless $MCT_AOT_CACHE arms it), "auto" = aot_cache/ next
     # to the perf ledger, any other value = explicit directory. Armed, the
@@ -333,6 +427,18 @@ class PipelineConfig:
                 "serve_batch_max > 1 packs whole scenes onto the scene "
                 "mesh axis — streaming_chunk is a single-chip whole-stream "
                 "mode; unset one")
+        if self.serve_workers < 1:
+            raise ValueError(
+                f"serve_workers must be >= 1, got {self.serve_workers}")
+        if self.serve_carve:
+            workers, _chips = parse_carve_spec(self.serve_carve)
+            if workers != self.serve_workers:
+                raise ValueError(
+                    f"serve_carve {self.serve_carve!r} names {workers} "
+                    f"workers but serve_workers={self.serve_workers}; "
+                    f"the carve's K must equal serve_workers")
+        if self.serve_tenants:
+            parse_tenant_spec(self.serve_tenants)  # grammar check (typed)
 
     def replace(self, **kw) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)

@@ -453,7 +453,60 @@ def render_serving(run: "RunData") -> Optional[str]:
     tenants = render_tenants(run.telemetry_rows)
     if tenants:
         lines.extend(tenants)
+    pool = render_pool(run)
+    if pool:
+        lines.extend(pool)
     return "\n".join(lines)
+
+
+def render_pool(run: "RunData") -> List[str]:
+    """Worker-pool digest: scheduler counters (``serve.pool.*``) plus
+    per-worker completion shares summed from the telemetry windows'
+    ``workers`` maps and per-tenant dequeue shares from their tenant
+    sub-rows. Empty list when the run never carved a pool (no pool
+    counters AND no window carried a workers map) — single-worker
+    reports are unchanged."""
+    c = run._counters
+    by_worker: Dict[str, int] = {}
+    for r in run.telemetry_rows or ():
+        for wid, n in (r.get("workers") or {}).items():
+            by_worker[wid] = by_worker.get(wid, 0) + int(n or 0)
+    pool_counters = any(k.startswith("serve.pool.") for k in c)
+    if not pool_counters and not by_worker:
+        return []
+    out: List[str] = []
+    hits = int(c.get("serve.pool.affinity_hits", 0))
+    misses = int(c.get("serve.pool.affinity_misses", 0))
+    routed = hits + misses
+    line = (f"pool: dispatched {int(c.get('serve.pool.dispatched', 0))} | "
+            f"affinity {hits}/{routed} warm")
+    if routed:
+        line += f" ({hits / routed:.0%})"
+    if c.get("serve.pool.crash_reroutes"):
+        line += f" | crash reroutes {int(c['serve.pool.crash_reroutes'])}"
+    if c.get("serve.pool.workers_retired"):
+        line += f" | workers retired {int(c['serve.pool.workers_retired'])}"
+    if c.get("serve.pool.recarves"):
+        line += f" | recarves {int(c['serve.pool.recarves'])}"
+    out.append(line)
+    total = sum(by_worker.values())
+    for wid in sorted(by_worker, key=lambda w: (len(w), w)):
+        n = by_worker[wid]
+        share = f" ({n / total:.0%})" if total else ""
+        out.append(f"  worker {wid}: completions {n}{share}")
+    # dequeue share by tenant: what the weighted-fair scheduler actually
+    # granted, from the same windows (requests completed per tenant)
+    by_tenant: Dict[str, int] = {}
+    for r in run.telemetry_rows or ():
+        for name, t in (r.get("tenants") or {}).items():
+            by_tenant[name] = (by_tenant.get(name, 0)
+                               + int(t.get("requests", 0) or 0))
+    t_total = sum(by_tenant.values())
+    if by_tenant and t_total:
+        out.append("  dequeue share: " + " | ".join(
+            f"{name} {n} ({n / t_total:.0%})"
+            for name, n in sorted(by_tenant.items())))
+    return out
 
 
 def render_tenants(rows: List[Dict]) -> List[str]:

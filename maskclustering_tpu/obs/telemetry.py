@@ -173,14 +173,18 @@ class ChildRelay:
 # ---------------------------------------------------------------------------
 
 
-def fold_telem(doc: Dict, *, child_pid: Optional[int] = None) -> None:
+def fold_telem(doc: Dict, *, child_pid: Optional[int] = None,
+               worker_id: Optional[int] = None) -> None:
     """Fold one relayed ``telem`` line into THIS process's obs state.
 
     Counters land under their own flat names (topology invariance: the
     Serving report cannot tell a relayed ``d2h.bytes.post.drain`` from a
     locally-booked one); spans replay through ``obs.record_span`` so the
     events file and the span histograms carry real samples. The relay's
-    own bookkeeping is the ``worker.*`` process tag.
+    own bookkeeping is the ``worker.*`` process tag. ``worker_id`` (the
+    pool slice that relayed this doc) stamps every replayed span so the
+    obs plane — and the pool drill's concurrency-overlap check — can
+    attribute device phases per worker.
     """
     from maskclustering_tpu import obs
 
@@ -202,6 +206,8 @@ def fold_telem(doc: Dict, *, child_pid: Optional[int] = None) -> None:
         attrs = dict(row.get("attrs") or {})
         if child_pid is not None:
             attrs["worker_pid"] = child_pid
+        if worker_id is not None:
+            attrs["worker_id"] = worker_id
         if row.get("ts") is not None:
             # the CHILD's close time: obs/trace.py anchors relayed spans on
             # this, not on the (later) parent re-emit timestamp
@@ -309,6 +315,9 @@ class WindowAggregator:
         self._tenants: Dict[str, Dict] = {}
         self._cum_tenants: Dict[str, Dict] = {}
         self._cum_tenant_hist: Dict[str, Histogram] = {}
+        # per-pool-slice completion counts for the current window (keyed
+        # by str(worker_id); single-worker daemons never populate it)
+        self._workers: Dict[str, int] = {}
         # the device-seconds / d2h attribution baseline: the counter
         # totals at the PREVIOUS request completion — one worker
         # serializes requests, so the delta between consecutive
@@ -337,6 +346,7 @@ class WindowAggregator:
             self._latency = {}
             self._waits = []
             self._tenants = {}
+            self._workers = {}
 
     # -- recorders (worker / supervisor threads) ----------------------------
 
@@ -351,7 +361,8 @@ class WindowAggregator:
         return slot
 
     def record_request(self, bucket, latency_s: float, *,
-                       tenant: str = "", status: str = "ok") -> None:
+                       tenant: str = "", status: str = "ok",
+                       worker: Optional[int] = None) -> None:
         """Book one finished request's latency under its shape bucket.
 
         The cumulative stride-decimated histogram observes EVERY sample
@@ -362,10 +373,15 @@ class WindowAggregator:
         ``tenant`` attributes the request (count, status, latency sample,
         and the device-seconds / d2h-bytes consumed since the previous
         completion) to its accounting identity; "" books globally only.
+        ``worker`` attributes the completion to a pool slice (the window
+        row's ``workers`` map; None under a single-worker daemon).
         """
         key = _bucket_key(bucket)
         attrib = _attrib_counters()  # registry lock BEFORE the window lock
         with self._lock:
+            if worker is not None:
+                wk = str(int(worker))
+                self._workers[wk] = self._workers.get(wk, 0) + 1
             dev_delta = max(attrib["device_s"]
                             - self._prev_attrib["device_s"], 0.0)
             d2h_delta = max(attrib["d2h_bytes"]
@@ -513,6 +529,9 @@ class WindowAggregator:
             if self._tenants:
                 row["tenants"] = _tenant_rows(self._tenants)
                 self._tenants = {}
+            if self._workers:
+                row["workers"] = dict(sorted(self._workers.items()))
+                self._workers = {}
             self._windows.append(row)
             self._t0 = now
         return row
@@ -632,7 +651,8 @@ def installed() -> Optional[WindowAggregator]:
 
 
 def record_request(bucket, latency_s: float, *, tenant: str = "",
-                   status: str = "ok") -> None:
+                   status: str = "ok",
+                   worker: Optional[int] = None) -> None:
     """Book one finished request into the current window (no-op without an
     installed aggregator — i.e. outside a daemon parent process). Window
     status attribution comes from the serve.requests_* counter deltas at
@@ -643,7 +663,8 @@ def record_request(bucket, latency_s: float, *, tenant: str = "",
     is what keeps tenant windows topology-invariant."""
     agg = installed()
     if agg is not None:
-        agg.record_request(bucket, latency_s, tenant=tenant, status=status)
+        agg.record_request(bucket, latency_s, tenant=tenant, status=status,
+                           worker=worker)
 
 
 def record_queue_wait(req, wait_s: float) -> None:

@@ -126,8 +126,43 @@ def render_top(stats: Dict, *, now: Optional[float] = None) -> str:
         f"respawns {int(sum(w.get('respawns', 0) for w in windows))} | "
         f"requeued {int(sum(w.get('requeued', 0) for w in windows))}")
 
-    # worker liveness (isolated topology): the wedge-is-coming panel
-    if worker:
+    # worker liveness: pool panel (one row per slice) when the daemon
+    # carves a pool, else the single isolated-worker wedge-is-coming line
+    pool = stats.get("pool") or {}
+    if pool:
+        sched = pool.get("scheduler") or {}
+        hits = int(sched.get("affinity_hits", 0))
+        misses = int(sched.get("affinity_misses", 0))
+        routed = hits + misses
+        lines.append(
+            f"pool: carve {pool.get('carve', '?')} | "
+            f"alive {worker.get('alive', '?')}/{worker.get('pool', '?')} | "
+            f"dispatched {int(sched.get('dispatched', 0))} | "
+            f"affinity {hits}/{routed} warm"
+            + (f" ({hits / routed:.0%})" if routed else "")
+            + f" | crash reroutes {int(sched.get('crash_reroutes', 0))} | "
+            f"recarves {int(sched.get('recarves', 0))}")
+        for w in pool.get("workers") or []:
+            hb = w.get("hb_age_s")
+            state = "RETIRED" if w.get("retired") else "up"
+            lines.append(
+                f"  worker {w.get('worker_id', '?')}: {state:<7} "
+                f"pid {w.get('pid', '?')} | "
+                f"hb age {_fmt(hb) if hb is not None else '-'} | "
+                f"feed {int(w.get('feed_depth', 0))} | "
+                f"dispatched {int(w.get('dispatched', 0))} | "
+                f"warm {int(w.get('warm_buckets', 0))} | "
+                f"respawns {w.get('consecutive_respawns', 0)} | "
+                f"streams open {int(w.get('open_streams', 0))}"
+                + (f" lost {int(w.get('lost_streams', 0))}"
+                   if w.get("lost_streams") else ""))
+        tenants = pool.get("tenants") or {}
+        if tenants:
+            lines.append("  dequeue share: " + " | ".join(
+                f"{t} {int(v.get('dispatched', 0))} (w={v.get('weight', 1)}"
+                + (f", quota {v.get('quota')}" if v.get("quota") else "")
+                + ")" for t, v in sorted(tenants.items())))
+    elif worker:
         hb = worker.get("hb_age_s")
         lines.append(
             f"worker: pid {worker.get('pid', '?')} | "

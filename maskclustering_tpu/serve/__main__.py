@@ -133,6 +133,20 @@ def main(argv=None) -> int:
                              "watchdog, SIGKILL-on-wedge, bounded respawn "
                              "with requeue — a hard XLA/TPU crash costs a "
                              "respawn, not the daemon")
+    parser.add_argument("--workers", type=int, default=None,
+                        help="carve the device mesh into this many worker "
+                             "slices, one supervised subprocess each "
+                             "(serve/pool.py; needs --isolate-worker; "
+                             "shorthand for --set serve_workers=K)")
+    parser.add_argument("--carve", default=None, metavar="KxC",
+                        help="explicit pool carve, K slices x C chips each "
+                             "(shorthand for --set serve_carve=KxC; K must "
+                             "equal --workers when both are given)")
+    parser.add_argument("--tenants", default=None,
+                        metavar="NAME:WEIGHT[:QUOTA],...",
+                        help="weighted-fair tenant QoS spec (shorthand for "
+                             "--set serve_tenants=...; unknown tenants get "
+                             "weight 1, no quota)")
     parser.add_argument("--aot-cache", default=None, nargs="?", const="auto",
                         metavar="DIR",
                         help="arm the persistent AOT executable cache "
@@ -175,6 +189,12 @@ def main(argv=None) -> int:
         overrides["point_shards"] = args.point_shards
     if args.aot_cache is not None:
         overrides["aot_cache_dir"] = args.aot_cache
+    if args.workers is not None:
+        overrides["serve_workers"] = args.workers
+    if args.carve is not None:
+        overrides["serve_carve"] = args.carve
+    if args.tenants is not None:
+        overrides["serve_tenants"] = args.tenants
     cfg = load_config(args.config, **overrides)
 
     from maskclustering_tpu.analysis import retrace_sanitizer

@@ -201,6 +201,22 @@ class ServeClient:
         matrix (obs/canary.py) under the ``sentinel`` key."""
         return self.stats(detail="sentinel")
 
+    def recarve(self, workers: int = 0, carve: str = "") -> Dict:
+        """Re-carve a pooled daemon's device mesh live (``recarve`` op).
+
+        Returns the ``{"kind": "recarve", "ok": ...}`` answer; a
+        single-worker daemon answers a ``bad_request`` reject instead."""
+        doc: Dict = {"op": "recarve"}
+        if workers:
+            doc["workers"] = int(workers)
+        if carve:
+            doc["carve"] = carve
+        self.send(doc)
+        while True:
+            ev = self.recv_event()
+            if ev.get("kind") in ("recarve", "reject"):
+                return ev
+
     def shutdown(self) -> Dict:
         self.send({"op": "shutdown"})
         return self.recv_event()

@@ -49,6 +49,7 @@ from maskclustering_tpu.obs import slo as _slo
 from maskclustering_tpu.obs import telemetry
 from maskclustering_tpu.serve import protocol
 from maskclustering_tpu.serve.admission import AdmissionQueue, QueueFullReject
+from maskclustering_tpu.serve.pool import QuotaReject
 from maskclustering_tpu.serve.router import Router
 from maskclustering_tpu.serve.worker import ServeWorker
 from maskclustering_tpu.utils import faults
@@ -113,7 +114,28 @@ class ServeDaemon:
         self.isolate_worker = bool(isolate_worker)
         self.queue = AdmissionQueue(capacity)
         self.router = Router(cfg, baseline_path=warm_baseline)
-        if isolate_worker:
+        pool_size = max(int(cfg.serve_workers), 1)
+        if pool_size > 1 and not isolate_worker:
+            raise ValueError(
+                f"serve_workers={pool_size} needs --isolate-worker: pool "
+                "slices are supervised subprocesses (one device owner per "
+                "slice), never threads")
+        if pool_size > 1:
+            # the worker pool (serve/pool.py): K supervised slices behind
+            # one affinity-aware weighted-fair scheduler; exposes the
+            # WorkerSupervisor surface so everything below is unchanged
+            from maskclustering_tpu.serve.pool import WorkerPool
+
+            self.worker = WorkerPool(
+                cfg, self.queue, self.router,
+                journal_dir=journal_dir,
+                prediction_root=prediction_root,
+                warm_scenes=self.warm_scenes,
+                warm_baseline=warm_baseline,
+                freeze_after_warm=freeze_after_warm,
+                fault_plan_spec=fault_plan_spec,
+                on_fatal=self.request_stop)
+        elif isolate_worker:
             # crash containment (serve/supervisor.py): the device owner is
             # a supervised SUBPROCESS — a SIGKILL'd/wedged worker costs a
             # respawn, not the daemon; warm-up (incl. the AOT-cache warm
@@ -488,6 +510,26 @@ class ServeDaemon:
                 send(protocol.reject("draining", tag=tag,
                                      detail="daemon is shutting down"))
                 return
+            if op == "recarve":
+                # pool admin op: drain + respawn under a new carve while
+                # admission keeps queueing. Blocks THIS connection's
+                # handler (the carve wall is the answer's payload); other
+                # connections keep admitting throughout
+                if not hasattr(self.worker, "recarve"):
+                    raise protocol.ProtocolError(
+                        "recarve needs a worker pool (serve_workers > 1)")
+                try:
+                    out = self.worker.recarve(
+                        workers=int(doc.get("workers", 0) or 0),
+                        carve=str(doc.get("carve", "") or ""))
+                except (ValueError, RuntimeError) as e:
+                    send({"v": protocol.PROTOCOL_VERSION, "kind": "recarve",
+                          "ok": False, "error": str(e), **({"tag": tag}
+                                                           if tag else {})})
+                    return
+                send({"v": protocol.PROTOCOL_VERSION, "kind": "recarve",
+                      **out, **({"tag": tag} if tag else {})})
+                return
             if doc.get("synthetic") is not None \
                     and self.cfg.dataset != "scannet":
                 raise protocol.ProtocolError(
@@ -498,14 +540,23 @@ class ServeDaemon:
             req = protocol.build_request(doc, self._next_id())
             req.send = send
             # submit + ack under the connection's send lock: the worker's
-            # first event for this request serializes AFTER the ack
+            # first event for this request serializes AFTER the ack. A
+            # pool worker gates admission through its tenant quotas
+            # (pool.admit raises the typed QuotaReject below)
+            submit = getattr(self.worker, "admit", self.queue.submit)
             with send.lock:
-                depth = self.queue.submit(req)
+                depth = submit(req)
                 send.raw(protocol.ack(req, queue_depth=depth))
         except protocol.ProtocolError as e:
             obs.count("serve.admission.rejects.bad_request")
             send(protocol.reject("bad_request", detail=str(e), tag=tag))
             return
+        except QuotaReject as e:
+            telemetry.record_reject(str(doc.get("tenant", "")))
+            send(protocol.reject(
+                "quota", tag=tag,
+                detail=f"tenant {e.tenant!r} at its queued-request quota "
+                       f"({e.queued}/{e.limit}); retry after completions"))
         except QueueFullReject as e:
             telemetry.record_reject(str(doc.get("tenant", "")))
             if not self._capacity_dumped.is_set():
@@ -561,6 +612,9 @@ class ServeDaemon:
             # serve.batch.* counters relay up via telemetry instead)
             **({"batch": w["batch"]} if "batch" in w else {}),
             **({"worker": w["worker"]} if "worker" in w else {}),
+            # the pool plane (serve/pool.py): per-slice liveness/warmth,
+            # scheduler affinity/share accounting, tenant QoS table
+            **({"pool": w["pool"]} if "pool" in w else {}),
         }
 
     def emit_serve_counters(self) -> None:

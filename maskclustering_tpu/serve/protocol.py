@@ -38,6 +38,13 @@ Request ops::
     {"op": "status"}              # daemon stats snapshot
     {"op": "status", "detail": "telemetry"}  # + windowed telemetry ring
     {"op": "shutdown"}            # drain in-flight requests, then exit
+    {"op": "recarve", "carve": "2x4", "workers": 2}  # worker-pool admin
+                                  # op (serve/pool.py): drain every
+                                  # slice, respawn under the new carve
+                                  # (admission keeps queueing meanwhile;
+                                  # the shared AOT cache keeps the new
+                                  # slices warm). Answers an ack-shaped
+                                  # {"kind": "recarve", "ok": ...}
 
 Responses (all carry ``id`` when bound to a request)::
 
@@ -51,12 +58,30 @@ Responses (all carry ``id`` when bound to a request)::
 
 ``worker_crash`` (process-isolated serving only, serve/supervisor.py):
 the device-owning worker subprocess died under this request; the request
-was requeued (``requeued: true``) for the respawned worker, or — after
-repeated crashes — the next event is a ``failed`` result with
-``error_class: "device"``. The same shapes ride the supervisor<->worker
-pipe (see ``forward_request``), plus three pipe-only kinds: ``hb``
-(heartbeat), ``ready`` (worker warm, carries the retrace/aot digest) and
-``bye`` (drain complete).
+was requeued (``requeued: true``) for the respawned worker (in a pool,
+rerouted to a bucket-warm neighbor), or — after repeated crashes — the
+next event is a ``failed`` result with ``error_class: "device"``.
+
+``stream_lost`` (status + terminal, streaming under crash containment):
+the worker holding this scene's device-resident ``_StreamSession`` died
+— the accumulator state died with it, so the stream CANNOT silently
+continue (the wire ``chunk`` field is frames-per-chunk, not a cursor; a
+respawned worker would reopen the stream at chunk 0 and corrupt it).
+In-flight and queued stream ops for the lost scene answer a ``status``
+with ``state: "stream_lost"`` then a ``failed`` result with
+``error_class: "stream_lost"``; the session is dropped so the client can
+restart the stream from its own source. (The ROADMAP-named worker-side
+stream-session journaling seam will turn this into a resume later.)
+
+The same shapes ride the supervisor<->worker pipe (see
+``forward_request``), plus three pipe-only kinds: ``hb`` (heartbeat),
+``ready`` (worker warm, carries the retrace/aot digest) and ``bye``
+(drain complete).
+
+``quota`` rejects and the ``recarve`` op are worker-pool surface
+(serve/pool.py): quota = the tenant's configured queued-request bound
+(config.serve_tenants) was hit; recarve = drain + respawn the pool
+under a new ``serve_carve`` while admission keeps queueing.
 """
 
 from __future__ import annotations
@@ -74,7 +99,8 @@ PROTOCOL_VERSION = 1
 # every window row
 TENANT_MAX_LEN = 64
 
-OPS = ("scene", "stream_chunk", "stream_end", "status", "shutdown")
+OPS = ("scene", "stream_chunk", "stream_end", "status", "shutdown",
+       "recarve")
 # the ops that name a scene and ride the admission queue as work items
 SCENE_OPS = ("scene", "stream_chunk", "stream_end")
 # status op detail levels: "" (the classic point-in-time snapshot),
@@ -82,7 +108,8 @@ SCENE_OPS = ("scene", "stream_chunk", "stream_end")
 # or "slo" (telemetry plus the armed spec's burn-rate verdict, obs/slo.py)
 # or "sentinel" (the canary sentinel's drift-plane snapshot, obs/canary.py)
 STATUS_DETAILS = ("", "telemetry", "slo", "sentinel")
-REJECT_REASONS = ("queue_full", "deadline", "bad_request", "draining")
+REJECT_REASONS = ("queue_full", "deadline", "bad_request", "draining",
+                  "quota")
 RESULT_STATUSES = ("ok", "failed", "skipped", "deadline", "interrupted")
 
 # make_scene parameters an inline synthetic request may set; anything else
@@ -145,6 +172,14 @@ def parse_line(line: str) -> Dict:
         if detail not in STATUS_DETAILS:
             raise ProtocolError(f"unknown status detail {detail!r} "
                                 f"(one of {STATUS_DETAILS})")
+    if op == "recarve":
+        workers = doc.get("workers", 0)
+        if not isinstance(workers, int) or isinstance(workers, bool) \
+                or workers < 0:
+            raise ProtocolError("'workers' must be an integer >= 0")
+        carve = doc.get("carve", "")
+        if not isinstance(carve, str):
+            raise ProtocolError("'carve' must be a 'KxC' string")
     if op in SCENE_OPS:
         scene = doc.get("scene")
         if not isinstance(scene, str) or not scene:

@@ -135,6 +135,13 @@ class Router:
         with self._lock:
             return set(self._warm)
 
+    def vocabulary_buckets(self) -> Set[Bucket]:
+        """The baseline workload's bucket set — what EVERY worker warms at
+        startup (the pool seeds each slice's affinity set with these:
+        a vocabulary bucket is warm on every slice by construction)."""
+        return {self.classify(e["frames"], e["points"], e["max_id"])
+                for e in self.vocabulary}
+
     def remember_pad_tensors(self, bucket: Bucket, tensors) -> None:
         """Retain one scene's tensors as the bucket's warm pad lane (first
         writer wins — pad bytes must stay stable across a daemon's life so

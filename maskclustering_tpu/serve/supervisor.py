@@ -95,10 +95,22 @@ class WorkerSupervisor:
                  child_argv: Optional[list] = None,
                  start_timeout_s: float = 600.0,
                  poll_s: float = 0.25,
-                 on_fatal=None):
+                 on_fatal=None,
+                 worker_id: int = 0,
+                 pooled: bool = False,
+                 child_env: Optional[Dict[str, str]] = None):
         self.cfg = cfg
         self.queue = queue
         self.router = router
+        # pool identity: 0 is the classic single-worker topology; a
+        # WorkerPool numbers its slices (pooled=True) and only THEN do
+        # relayed spans / telemetry rows carry the id — a lone supervisor
+        # must stay report-identical to the in-process topology
+        self.worker_id = int(worker_id)
+        self.pooled = bool(pooled)
+        # per-child environment overlay (the pool's device carve: each
+        # slice's child sees only its own chips)
+        self.child_env = dict(child_env) if child_env else None
         self.journal_dir = journal_dir
         self.prediction_root = prediction_root
         self.warm_scenes = tuple(warm_scenes)
@@ -155,6 +167,17 @@ class WorkerSupervisor:
         self._canary_done = threading.Event()
         self._canary_busy = False
         self._canary_probes: Optional[list] = None
+        # stream sessions under crash containment: scenes whose
+        # device-resident _StreamSession lives in the CURRENT child
+        # (grown from stream_chunk results, shrunk on done/stream_end).
+        # A crash moves them to _lost_streams — the accumulator died with
+        # the child, and the wire `chunk` is frames-per-chunk (not a
+        # cursor), so a respawned child would silently reopen at chunk 0.
+        # Lost scenes answer a typed stream_lost (in-flight at crash, or
+        # at dequeue for queued/later ops), then clear so the client can
+        # restart the stream from its own source.
+        self._open_streams: set = set()
+        self._lost_streams: set = set()
         self._cfg_path = self._write_cfg()
 
     # -- child plumbing ------------------------------------------------------
@@ -175,6 +198,8 @@ class WorkerSupervisor:
 
         cmd = [sys.executable, "-m", "maskclustering_tpu.serve.worker_main",
                "--cfg-json", self._cfg_path]
+        if self.worker_id:
+            cmd += ["--worker-id", str(self.worker_id)]
         if self.journal_dir:
             cmd += ["--journal-dir", self.journal_dir]
         if self.prediction_root:
@@ -199,10 +224,14 @@ class WorkerSupervisor:
         cmd = self._child_cmd(first_spawn)
         log.info("worker supervisor: spawning device worker%s",
                  "" if first_spawn else f" (respawn {self.respawns})")
+        env = None
+        if self.child_env:
+            env = dict(os.environ)
+            env.update(self.child_env)
         try:
             child = subprocess.Popen(cmd, stdin=subprocess.PIPE,
                                      stdout=subprocess.PIPE, text=True,
-                                     bufsize=1)
+                                     bufsize=1, env=env)
         except OSError:
             log.exception("worker supervisor: spawn failed")
             return False
@@ -259,7 +288,9 @@ class WorkerSupervisor:
                 # replay here — the Serving report and the telemetry
                 # windows read topology-invariant (obs/telemetry.py)
                 try:
-                    telemetry.fold_telem(doc, child_pid=child.pid)
+                    telemetry.fold_telem(
+                        doc, child_pid=child.pid,
+                        worker_id=self.worker_id if self.pooled else None)
                 except Exception:  # noqa: BLE001 — telemetry never faults
                     log.exception("worker supervisor: telem fold failed")
                 with self._lock:
@@ -300,10 +331,26 @@ class WorkerSupervisor:
                 continue
             if kind in ("result", "reject"):
                 entry["terminal"] = doc
+                self._track_stream(entry["req"], doc)
                 _send(entry["req"], doc)
                 entry["done"].set()
             else:
                 _send(entry["req"], doc)
+
+    def _track_stream(self, req: protocol.SceneRequest, doc: Dict) -> None:
+        """Mirror the child's live _StreamSession set from its terminal
+        events: an ok stream_chunk that is not ``done`` opens (or keeps)
+        the scene's session; a finished stream or an ok stream_end drops
+        it. This parent-side shadow is what crash containment consults —
+        the child's own session table dies with it."""
+        if req.op not in ("stream_chunk", "stream_end"):
+            return
+        ok = doc.get("kind") == "result" and doc.get("status") == "ok"
+        with self._lock:
+            if req.op == "stream_chunk" and ok and not doc.get("done"):
+                self._open_streams.add(req.scene)
+            elif ok:  # finished stream (done=True) or successful end
+                self._open_streams.discard(req.scene)
 
     def _kill_child(self) -> None:
         child = self._child
@@ -391,6 +438,10 @@ class WorkerSupervisor:
                 return True
             time.sleep(0.01)
         return False
+
+    def busy(self) -> bool:
+        """A dispatch unit is in flight (the pool's load metric)."""
+        return not self._idle.is_set()
 
     # -- the pump ------------------------------------------------------------
 
@@ -517,7 +568,37 @@ class WorkerSupervisor:
                 detail=f"deadline_s={req.deadline_s:g} expired after "
                        f"{time.monotonic() - req.admitted_at:.2f}s in queue"))
             return False
+        if req.op in ("stream_chunk", "stream_end"):
+            with self._lock:
+                lost = req.scene in self._lost_streams
+                self._lost_streams.discard(req.scene)
+            if lost:
+                # the session this op was continuing died with a worker;
+                # answer typed, clear the mark so a restarted stream
+                # (fresh chunk 1) serves normally. serve.requests books
+                # parent-side: the child never sees this op
+                obs.count("serve.requests")
+                self._answer_stream_lost(
+                    req, "stream session lost to a worker crash before "
+                         "this op dispatched")
+                return False
         return True
+
+    def _answer_stream_lost(self, req: protocol.SceneRequest,
+                            detail: str) -> None:
+        """Typed stream-loss terminal: the scene's device-resident
+        accumulator died with its worker and the stream CANNOT silently
+        continue (frames-per-chunk wire field, not a cursor — a respawn
+        would reopen at chunk 0). status stream_lost + failed result."""
+        obs.count("serve.streams_lost")
+        obs.count("serve.requests_failed")
+        with self._lock:
+            self._counts["failed"] += 1
+        _send(req, protocol.status(req, "stream_lost", detail=detail))
+        _send(req, protocol.result(
+            req, "failed",
+            error=f"stream session for {req.scene!r} lost: {detail}",
+            error_class="stream_lost"))
 
     def _serve_batch(self, batch) -> None:
         # NB: serve.requests / serve.requests_<status> obs counters for
@@ -607,7 +688,8 @@ class WorkerSupervisor:
             telemetry.record_request(
                 tuple(bucket) if bucket is not None
                 else self.router.bucket_for(req.scene), latency,
-                tenant=req.tenant, status=key)
+                tenant=req.tenant, status=key,
+                worker=self.worker_id if self.pooled else None)
 
     def _crash_batch(self, entries: Dict, detail: str) -> None:
         """The in-flight batch's worker died: contain ONCE (kill + dump),
@@ -634,6 +716,12 @@ class WorkerSupervisor:
         self.crashes += 1
         obs.count("serve.worker_crashes")
         log.error("worker supervisor: %s", detail)
+        with self._lock:
+            # every open session died with the child; in-flight stream
+            # victims are answered below (and clear their own mark), the
+            # rest answer stream_lost at their next op's dequeue
+            self._lost_streams |= self._open_streams
+            self._open_streams.clear()
         child = self._child
         child_pid = child.pid if child is not None else None
         self._kill_child()
@@ -655,6 +743,16 @@ class WorkerSupervisor:
         req.crashes += 1
         err = faults.WorkerCrashError(req.scene, detail)
         self._journal_crash(req, err)
+        if req.op in ("stream_chunk", "stream_end"):
+            # a stream op NEVER requeues across a crash: its session's
+            # accumulator state died with the child, and frames-per-chunk
+            # wire semantics mean a respawned child would silently reopen
+            # the stream at chunk 0 — typed loss instead (satellite 1;
+            # the journaling/resume seam lands in a later PR)
+            with self._lock:
+                self._lost_streams.discard(req.scene)
+            self._answer_stream_lost(req, detail)
+            return
         # re-admission stamp: the SECOND queue-wait segment measures from
         # the requeue, not the original ack (the first attempt's wall is
         # its own trace segment, not queue time); deadline_at is absolute
@@ -799,6 +897,9 @@ class WorkerSupervisor:
                 # consecutive respawns and the in-flight crash count make
                 # a wedging worker visible in `status` BEFORE the SIGKILL
                 "worker": {"isolated": True, "alive": alive,
+                           "worker_id": self.worker_id,
+                           "open_streams": len(self._open_streams),
+                           "lost_streams": len(self._lost_streams),
                            "spawns": self.spawns,
                            "respawns": self.respawns,
                            "consecutive_respawns": self.consecutive_respawns,

@@ -82,6 +82,10 @@ def main(argv=None) -> int:
                         help="drill spec (the supervisor passes it to the "
                              "FIRST spawn only — a respawn is the recovery "
                              "under test, not the drill target)")
+    parser.add_argument("--worker-id", type=int, default=0,
+                        help="pool slice id (serve/pool.py); stamps logs "
+                             "and the ready/bye digests so per-worker "
+                             "evidence is attributable")
     parser.add_argument("--hb-interval", type=float, default=1.0)
     parser.add_argument("--telem-interval", type=float, default=2.0,
                         help="seconds between periodic telemetry relay "
@@ -94,7 +98,8 @@ def main(argv=None) -> int:
     logging.basicConfig(
         stream=sys.stderr,  # stdout is the pipe protocol, exclusively
         level=logging.DEBUG if args.debug else logging.INFO,
-        format="%(asctime)s worker[%(process)d] %(levelname)s %(message)s")
+        format=f"%(asctime)s worker{args.worker_id}[%(process)d] "
+               "%(levelname)s %(message)s")
 
     from maskclustering_tpu.config import config_from_json
 
@@ -274,6 +279,7 @@ def main(argv=None) -> int:
                                  name="worker-hb")  # mct-thread: abandon(bounded-joined at drain below; the spawn/join pair brackets the stdin loop)
     hb_thread.start()
     emit_raw({"kind": "ready", "pid": os.getpid(),
+              "worker_id": args.worker_id,
               "warmup_s": round(warmup_s, 2), "aot": aot_stats,
               "retrace": _retrace_digest()})
     flush_telem()  # warm-up's counters (aot_cache.*, d2h.*) relay at once
@@ -342,7 +348,8 @@ def main(argv=None) -> int:
         retrace_sanitizer.emit_counters()
     flush_telem()
     ship_flight()  # final ring delta: the parent's copy ends complete
-    emit_raw({"kind": "bye", "retrace": _retrace_digest(),
+    emit_raw({"kind": "bye", "worker_id": args.worker_id,
+              "retrace": _retrace_digest(),
               "counts": worker.stats()["counts"]})
     if faults.stop_requested():
         # cooperative drain path, NOT the signal handler (CONC.SIGNAL):

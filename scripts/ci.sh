@@ -110,6 +110,21 @@
 #    (MCT_PACK_SMOKE=0 skips). FATAL. The scheduler unit matrix lives
 #    in tests/test_serve_batch.py.
 #
+# 3i. runs the multi-worker pool drill (distinct exit code 12): one
+#    daemon carves the (virtual) mesh into a 2x1 pool — two supervised
+#    worker subprocesses behind one socket — and the drill asserts the
+#    whole pool contract: >= 90% bucket-warm routing post-warm (the
+#    affinity scheduler), 3:1 weighted-fair dequeue under saturation,
+#    typed quota rejects at the admission limit, a mid-request SIGKILL
+#    of worker 0 contained to its slice (neighbor traffic untouched,
+#    victim requeued and answered ok, flight recorder + journal record
+#    the hop, respawn warm off the shared AOT cache), per-scene artifact
+#    digests unanimous across slices, cross-worker device-phase span
+#    overlap (the single-device CI form of the throughput claim), and
+#    ZERO post-warm compiles on EVERY slice (MCT_POOL_DRILL=0 skips).
+#    FATAL. The scheduler/carve unit matrix lives in
+#    tests/test_serve_pool.py.
+#
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
@@ -117,11 +132,12 @@
 # mct-check finding or ruff violation (4), a concurrency-family finding
 # (5), a retrace-family finding (6), a serve-smoke failure (7), a
 # crash-respawn smoke failure (8), a streaming-smoke failure (9), a
-# canary-drill failure (10), a pack-drill failure (11), or a perf
-# regression (2), so it gates correctness, fault tolerance, the
-# invariants, thread safety, the compile surface, the serving layer,
-# crash containment, the streaming contract, correctness observability,
-# the packing scheduler AND the trajectory.
+# canary-drill failure (10), a pack-drill failure (11), a pool-drill
+# failure (12), or a perf regression (2), so it gates correctness, fault
+# tolerance, the invariants, thread safety, the compile surface, the
+# serving layer, crash containment, the streaming contract, correctness
+# observability, the packing scheduler, multi-worker serving AND the
+# trajectory.
 # Every gate still RUNS after a failure, but the exit code is the FIRST
 # failing gate's — triage by exit code points at the right gate.
 set -u -o pipefail
@@ -286,6 +302,26 @@ if [ "${MCT_PACK_SMOKE:-1}" != "0" ]; then
              "sequential, a partial batch recompiled, or the scheduler" \
              "never fused a batch)" >&2
         fail 11
+    fi
+fi
+
+if [ "${MCT_POOL_DRILL:-1}" != "0" ]; then
+    echo "== ci: multi-worker pool drill (2x1 carve: affinity + QoS + SIGKILL containment, <600s) =="
+    # the worker-pool gate: one daemon carves the (virtual) mesh into two
+    # slices and must route >= 90% bucket-warm post-warm, front-load the
+    # heavy:3 tenant's completions 3:1 under saturation, answer typed
+    # quota rejects over capped's admission limit, contain a mid-request
+    # SIGKILL of worker 0 (neighbor untouched, victim requeued + ok,
+    # black box + journal record the hop, respawn warm off the shared
+    # AOT cache), serve byte-identical artifacts on every slice, and
+    # overlap device phases across workers — zero post-warm compiles on
+    # EVERY slice
+    if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+            python scripts/load_gen.py --pool-drill --no-ledger; then
+        echo "ci: pool drill FAILED (a slice went cold/unbalanced, QoS or" \
+             "quota broke, the crash leaked past its slice, or a worker" \
+             "recompiled post-warm)" >&2
+        fail 12
     fi
 fi
 

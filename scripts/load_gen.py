@@ -906,6 +906,412 @@ def run_pack_drill(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# the pool drill: multi-worker serving — affinity, QoS, crash containment
+# ---------------------------------------------------------------------------
+
+
+def _pool_span_overlap(events: str) -> Tuple[int, int]:
+    """Concurrency evidence on single-device CI: relayed ``serve.request``
+    spans stamped with DIFFERENT ``worker_id`` whose wall windows overlap
+    prove two slices executed device phases at the same time, even when
+    wall-clock throughput cannot 2x on one shared CPU. Returns
+    ``(overlapping_pairs, worker_tagged_spans)``."""
+    from maskclustering_tpu.obs.events import KIND_SPAN, read_events
+
+    spans: List[Tuple[float, float, int]] = []
+    try:
+        for ev in read_events(events, kinds=[KIND_SPAN]):
+            if ev.get("name") != "serve.request":
+                continue
+            attrs = ev.get("attrs") or {}
+            wid = attrs.get("worker_id")
+            if wid is None:
+                continue
+            end = attrs.get("end_ts")
+            if not isinstance(end, (int, float)):
+                end = ev.get("ts", 0.0)
+            dur = float(ev.get("dur_s", 0.0))
+            spans.append((float(end) - dur, float(end), int(wid)))
+    except OSError:
+        return 0, 0
+    overlaps = 0
+    for i, (a0, a1, wa) in enumerate(spans):
+        for b0, b1, wb in spans[i + 1:]:
+            if wa != wb and min(a1, b1) - max(a0, b0) > 0.0:
+                overlaps += 1
+    return overlaps, len(spans)
+
+
+def _pool_sched(sock: str) -> Tuple[Dict, Dict]:
+    """One stats poll: (pool plane, scheduler counters) — both empty when
+    the daemon is not pooled (itself a drill failure downstream)."""
+    from maskclustering_tpu.serve.client import ServeClient
+
+    with ServeClient(sock, timeout_s=30.0) as client:
+        pool = client.stats().get("pool") or {}
+    return pool, dict(pool.get("scheduler") or {})
+
+
+def run_pool_drill(args) -> int:
+    """The multi-worker serving CI gate (serve/pool.py), end to end on a
+    real 2x1 CPU carve:
+
+    1. warm burst  — mixed buckets x weighted tenants over both slices;
+       every request ok, both workers alive and dispatching.
+    2. affinity    — a second burst must route >= 90% bucket-warm (the
+       scheduler's hit counters, measured as a post-warm delta).
+    3. QoS         — an open-loop saturated burst under ``heavy:3`` vs
+       ``light:1``: the stride scheduler must front-load heavy's
+       completions 3:1 (+-25% over the burst's first half).
+    4. quota       — a burst over ``capped``'s admission quota must
+       answer typed ``quota`` rejects while admitted work still lands.
+    5. crash       — SIGKILL worker 0's child mid-request: worker 1's
+       traffic is untouched, the victim requeues and finishes ok, the
+       black box + journal record the hop, and the respawned slice
+       reaches first dispatch with ZERO compiles (shared AOT cache).
+
+    Plus, over the whole run: per-scene artifact digests unanimous
+    across slices (byte-identity is worker-independent), zero post-warm
+    compiles on EVERY worker, and concurrency overlap between
+    worker-tagged device spans (the single-device CI stand-in for the
+    2-worker throughput claim).
+    """
+    from maskclustering_tpu.serve.client import ServeClient
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    write_scannet_layout)
+
+    tmp = tempfile.mkdtemp(prefix="mct_pool_drill_")
+    sock = os.path.join(tmp, "mct.sock")
+    events = os.path.join(tmp, "serve_events.jsonl")
+    flight_dir = os.path.join(tmp, "flight")
+    journal_dir = os.path.join(tmp, "journals")
+    warm_names = []
+    for name, params in BUCKET_SPECS:
+        kw = dict(params)
+        kw["image_hw"] = tuple(kw["image_hw"])
+        write_scannet_layout(make_scene(**kw), tmp, name)
+        warm_names.append(name)
+
+    cmd = [sys.executable, "-m", "maskclustering_tpu.serve",
+           "--config", "scannet", "--socket", sock, "--data_root", tmp,
+           "--capacity", "64", "--retrace-sanitizer",
+           # the shared AOT cache is the drill's warm-respawn lever: both
+           # slices capture/restore from one directory
+           "--aot-cache", os.path.join(tmp, "aot"),
+           "--obs_events", events, "--warm", "+".join(warm_names),
+           "--telemetry-window", "1.0",
+           "--flight-dir", flight_dir,
+           "--journal-dir", journal_dir,
+           "--isolate-worker",
+           "--workers", str(args.pool_workers),
+           "--carve", f"{args.pool_workers}x1",
+           "--tenants", "heavy:3,light:1,capped:1:2",
+           "--set", "worker_heartbeat_s=30"]
+    for kv in SMOKE_CONFIG_SETS:
+        cmd += ["--set", kv]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    log(f"pool-drill: starting daemon: {' '.join(cmd)}")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, cwd=REPO_ROOT,
+                            env=env, text=True)
+    failures: List[str] = []
+    all_results: List[Dict] = []
+    verdict: Dict = {"metric": "serve s/request (pool drill p50)",
+                     "value": None, "unit": "s/request",
+                     "pool_drill": True, "pool_workers": args.pool_workers}
+    try:
+        if not _wait_for_socket(sock, proc, timeout_s=args.smoke_startup_s):
+            log("pool-drill: FAIL — daemon never became reachable")
+            proc.kill()
+            return 1
+
+        # -- phase 1: warm burst over both slices ---------------------------
+        v_warm = run_load(sock, requests=8, concurrency=4, buckets=2,
+                          deadline_s=0.0, resume=False,
+                          tenant_mix=parse_tenant_mix("heavy:3,light:1"),
+                          collect=all_results)
+        verdict["value"] = v_warm.get("value")
+        if v_warm["ok"] != 8:
+            failures.append(f"warm burst: {v_warm['ok']}/8 ok")
+        pool, sched_warm = _pool_sched(sock)
+        workers = pool.get("workers") or []
+        if len(workers) != args.pool_workers:
+            failures.append(f"pool reports {len(workers)} worker(s), "
+                            f"expected {args.pool_workers}")
+        alive = sum(1 for w in workers if w.get("alive"))
+        if alive != args.pool_workers:
+            failures.append(f"only {alive}/{args.pool_workers} slices "
+                            f"alive after the warm burst")
+        idle_workers = [w["worker_id"] for w in workers
+                        if not w.get("dispatched")]
+        if idle_workers:
+            failures.append(f"slice(s) {idle_workers} never dispatched — "
+                            f"the scheduler is not spreading load")
+
+        # -- phase 2: post-warm affinity ------------------------------------
+        v_aff = run_load(sock, requests=8, concurrency=4, buckets=2,
+                         deadline_s=0.0, resume=False,
+                         tenant_mix=parse_tenant_mix("heavy:3,light:1"),
+                         collect=all_results)
+        if v_aff["ok"] != 8:
+            failures.append(f"affinity burst: {v_aff['ok']}/8 ok")
+        _pool2, sched_aff = _pool_sched(sock)
+        d_hits = sched_aff.get("affinity_hits", 0) \
+            - sched_warm.get("affinity_hits", 0)
+        d_miss = sched_aff.get("affinity_misses", 0) \
+            - sched_warm.get("affinity_misses", 0)
+        # optimistic warmth bounds TOTAL misses at buckets x workers: a
+        # (slice, bucket) pair phase 1 never happened to exercise pays its
+        # one first-sight miss whenever it first dispatches — allow those
+        # residual cold bookings, then everything else must route warm
+        total_miss = sched_aff.get("affinity_misses", 0)
+        bound = 2 * args.pool_workers
+        if total_miss > bound:
+            failures.append(f"{total_miss} affinity misses ever > the "
+                            f"optimistic-warmth bound {bound} (buckets x "
+                            f"workers) — warmth is not sticking")
+        allowed_cold = max(0, bound - sched_warm.get("affinity_misses", 0))
+        adj_miss = max(0, d_miss - allowed_cold)
+        routed = d_hits + adj_miss
+        rate = (d_hits / routed) if routed else 0.0
+        verdict["affinity_hit_rate"] = round(rate, 3)
+        if routed and rate < 0.9:
+            failures.append(f"post-warm affinity hit rate {rate:.0%} "
+                            f"({d_hits}/{routed} beyond first-sight) < 90% "
+                            f"— bucket-warm routing is not sticking")
+        if not routed:
+            failures.append("affinity burst dispatched nothing through "
+                            "the pool scheduler")
+
+        # -- phase 3: weighted-fair QoS under saturation --------------------
+        # open loop, arrivals ~instant: a real backlog forms, so dequeue
+        # order IS the stride scheduler's. heavy (w=3) must front-load
+        # its completions ~3:1 while light's backlog waits.
+        qos_results: List[Dict] = []
+        v_qos = run_load(sock, requests=32, concurrency=4, buckets=2,
+                         deadline_s=0.0, resume=False,
+                         tenant_mix=parse_tenant_mix("heavy:1,light:1"),
+                         rate=200.0, collect=qos_results)
+        all_results.extend(qos_results)
+        if v_qos["ok"] != 32:
+            failures.append(f"QoS burst: {v_qos['ok']}/32 ok")
+        # completion order: tag lg-%04d maps back to the arrival index,
+        # the [heavy, light] cycle maps index -> tenant
+        heavy_first_half = 0
+        order = [r for r in qos_results if r.get("status") == "ok"]
+        for r in order[:16]:
+            tag = str(r.get("tag") or "")
+            try:
+                idx = int(tag.rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if idx % 2 == 0:
+                heavy_first_half += 1
+        verdict["qos_heavy_first_half"] = heavy_first_half
+        # 3:1 target = 12 of 16; -25% floor = 9. An unweighted scheduler
+        # completes the alternating arrivals ~8/16.
+        if heavy_first_half < 9:
+            failures.append(
+                f"QoS: only {heavy_first_half}/16 of the first-half "
+                f"completions were heavy's (3:1 weight demands >= 9) — "
+                f"weighted-fair dequeue is not honoring weights")
+
+        # -- phase 4: admission quota ---------------------------------------
+        # 12 simultaneous requests for capped (quota 2): the slices'
+        # feed + in-flight slots absorb the first few, the next two
+        # queue (filling the quota), the rest MUST answer the typed
+        # quota reject while admitted work still completes.
+        quota_terms: List[Dict] = []
+        qlock = threading.Lock()
+
+        def _capped(i: int) -> None:
+            kw = dict(BUCKET_SPECS[i % 2][1])
+            with ServeClient(sock, timeout_s=600.0) as client:
+                term, _st, _lat = client.run_scene(
+                    BUCKET_SPECS[i % 2][0], synthetic=kw,
+                    tag=f"cap-{i:02d}", tenant="capped")
+            with qlock:
+                quota_terms.append(term)
+
+        qthreads = []
+        for i in range(12):
+            t = threading.Thread(target=_capped, args=(i,), daemon=True)
+            qthreads.append(t)
+            t.start()
+        for t in qthreads:
+            t.join(600.0)
+        q_rejects = [t for t in quota_terms if t.get("kind") == "reject"
+                     and t.get("reason") == "quota"]
+        q_ok = [t for t in quota_terms if t.get("status") == "ok"]
+        verdict["quota_rejects"] = len(q_rejects)
+        if not q_rejects:
+            failures.append("quota: 12 simultaneous requests over a "
+                            "2-slot admission quota produced no typed "
+                            "'quota' reject")
+        elif not (q_rejects[0].get("detail") or ""):
+            failures.append("quota: the reject carries no detail naming "
+                            "the limit")
+        if not q_ok:
+            failures.append("quota: no capped request was admitted at "
+                            "all — the quota gate is rejecting below the "
+                            "limit")
+
+        # -- phase 5: SIGKILL worker 0 mid-request --------------------------
+        pool3, _ = _pool_sched(sock)
+        pids = {w["worker_id"]: w.get("pid")
+                for w in pool3.get("workers") or []}
+        victim_pid = pids.get(0)
+        crash_results: List[Dict] = []
+        crash_box: Dict[str, Dict] = {}
+
+        def _crash_burst() -> None:
+            crash_box["verdict"] = run_load(
+                sock, requests=6, concurrency=3, buckets=2,
+                deadline_s=0.0, resume=False,
+                tenant_mix=parse_tenant_mix("heavy:3,light:1"),
+                collect=crash_results)
+
+        burst_t = threading.Thread(target=_crash_burst, daemon=True)
+        burst_t.start()
+        # kill only once worker 0 is actually under a request — the drill
+        # is crash containment mid-flight, not an idle-respawn exercise
+        killed = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and victim_pid:
+            try:
+                pool_now, _ = _pool_sched(sock)
+                w0 = next((w for w in pool_now.get("workers") or []
+                           if w.get("worker_id") == 0), {})
+                if w0.get("inflight"):
+                    # let the child's receive-time flight delta reach the
+                    # parent before the kill (the black-box assertion needs
+                    # the victim's child-side rows; a request runs seconds,
+                    # so this still lands mid-flight)
+                    time.sleep(0.5)
+                    os.kill(int(victim_pid), signal.SIGKILL)
+                    killed = True
+                    log(f"pool-drill: SIGKILLed worker 0 child "
+                        f"(pid {victim_pid}) mid-request")
+                    break
+            except (OSError, ProcessLookupError):
+                break
+            time.sleep(0.05)
+        burst_t.join(600.0)
+        v_crash = crash_box.get("verdict") or {}
+        if not killed:
+            failures.append("crash: worker 0 never held an in-flight "
+                            "request to kill (or its pid was missing "
+                            "from stats)")
+        if v_crash.get("ok") != 6:
+            failures.append(f"crash burst: {v_crash.get('ok')}/6 ok — a "
+                            f"neighbor's request was NOT unaffected, or "
+                            f"the victim never finished")
+        if killed and v_crash.get("worker_crash_events", 0) < 1:
+            failures.append("crash: no client saw a typed worker_crash "
+                            "status event")
+        verdict["worker_crash_events"] = v_crash.get("worker_crash_events")
+        all_results.extend(crash_results)
+
+        # -- drain + final digest -------------------------------------------
+        digest = _drain_daemon(proc, failures, "pool drill")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    if digest is None:
+        failures.append("no final digest to assert the pool plane on")
+    else:
+        retrace = digest.get("retrace") or {}
+        verdict["retrace_post_freeze"] = retrace.get("post_freeze")
+        if retrace.get("post_freeze"):
+            failures.append(f"{retrace['post_freeze']} post-warm "
+                            f"compile(s) across the pool — the serve-many "
+                            f"contract broke under multi-worker")
+        if not retrace.get("frozen"):
+            failures.append("retrace sanitizer never froze on some slice")
+        per_worker = retrace.get("workers") or {}
+        if len(per_worker) != args.pool_workers:
+            failures.append(f"final digest carries retrace for "
+                            f"{sorted(per_worker)} — expected all "
+                            f"{args.pool_workers} slices")
+        for wid, dg in sorted(per_worker.items()):
+            if dg.get("post_freeze"):
+                failures.append(f"worker {wid}: {dg['post_freeze']} "
+                                f"post-warm compile(s)")
+        # the respawned slice must have warm-started: its (fresh) child's
+        # digest shows zero compiles, delivered by the shared AOT cache
+        if killed and (per_worker.get("0") or {}).get("compiles", 0) != 0:
+            failures.append(
+                f"respawned worker 0 booked "
+                f"{(per_worker.get('0') or {}).get('compiles')} "
+                f"compile(s) — the AOT warm respawn did not deliver")
+        worker = digest.get("worker") or {}
+        verdict["worker_crashes"] = worker.get("crashes")
+        verdict["worker_respawns"] = worker.get("respawns")
+        if killed and not worker.get("crashes"):
+            failures.append("crash: the pool digest recorded no crash")
+        if killed and not worker.get("respawns"):
+            failures.append("crash: worker 0 never respawned")
+        dpool = digest.get("pool") or {}
+        dsched = dpool.get("scheduler") or {}
+        verdict["pool_dispatched"] = dsched.get("dispatched")
+        tenants = dpool.get("tenants") or {}
+        for t in ("heavy", "light", "capped"):
+            if t not in tenants:
+                failures.append(f"pool digest carries no QoS row for "
+                                f"tenant {t!r}")
+
+    # cross-slice byte identity: every scene's artifact digest must be
+    # unanimous no matter which worker (or respawn generation) served it
+    by_scene: Dict[str, set] = {}
+    for r in all_results:
+        if r.get("status") == "ok":
+            by_scene.setdefault(str(r.get("scene")), set()).add(
+                (r.get("digest") or {}).get("artifact"))
+    for scene in sorted(by_scene):
+        if len(by_scene[scene]) != 1 or None in by_scene[scene]:
+            failures.append(
+                f"artifact digests for {scene} not unanimous across "
+                f"slices: {sorted(map(str, by_scene[scene]))}")
+    if not by_scene:
+        failures.append("no ok results carried artifact digests")
+
+    # concurrency overlap: device phases on DIFFERENT workers must have
+    # run simultaneously (the single-device CI form of the 2-worker
+    # throughput claim; on real multi-chip hosts wall-clock also shows it)
+    overlaps, tagged = _pool_span_overlap(events)
+    verdict["span_overlaps"] = overlaps
+    verdict["worker_tagged_spans"] = tagged
+    if not tagged:
+        failures.append("no serve.request span carries a worker_id tag — "
+                        "per-worker attribution is dark")
+    elif not overlaps:
+        failures.append("no two spans from different workers ever "
+                        "overlapped — the pool never actually served "
+                        "concurrently")
+
+    if killed:
+        check_blackbox(flight_dir, events, journal_dir, verdict, failures)
+
+    if failures:
+        verdict["error"] = "; ".join(failures)
+    print(json.dumps(verdict, sort_keys=True), flush=True)
+    if not args.no_ledger:
+        append_ledger_row(verdict, args.ledger)
+    if failures:
+        for f in failures:
+            log(f"pool-drill: FAIL — {f}")
+        return 1
+    log(f"pool-drill: PASS — {args.pool_workers} slices, affinity "
+        f"{verdict['affinity_hit_rate']:.0%}, heavy front-loaded "
+        f"{verdict['qos_heavy_first_half']}/16, {verdict['quota_rejects']} "
+        f"typed quota reject(s), crash contained "
+        f"({verdict['worker_crashes']} crash / {verdict['worker_respawns']} "
+        f"respawn), {overlaps} cross-worker span overlap(s), zero "
+        f"post-warm compiles on every slice")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # mct-sentinel: the audited goldens regeneration + the canary drill
 # ---------------------------------------------------------------------------
 
@@ -1279,6 +1685,18 @@ def main(argv=None) -> int:
     parser.add_argument("--pack-linger", type=float, default=0.3,
                         help="pack drill: serve_batch_linger_s for the "
                              "packing daemon (default 0.3)")
+    parser.add_argument("--pool-drill", action="store_true",
+                        help="the multi-worker serving CI gate: a real "
+                             "2x1-carved CPU pool must route >= 90% "
+                             "bucket-warm, honor 3:1 weighted-fair "
+                             "dequeue and admission quotas, contain a "
+                             "mid-request SIGKILL of worker 0 (neighbor "
+                             "untouched, victim requeued, warm respawn), "
+                             "serve byte-identical artifacts on every "
+                             "slice, and overlap device phases across "
+                             "workers — with zero post-warm compiles")
+    parser.add_argument("--pool-workers", type=int, default=2,
+                        help="pool drill: slice count (default 2)")
     parser.add_argument("--write-goldens", nargs="?", const=DEFAULT_GOLDENS,
                         default=None, metavar="PATH",
                         help="regenerate canary_goldens.json (flag alone: "
@@ -1304,6 +1722,8 @@ def main(argv=None) -> int:
         return run_write_goldens(args)
     if args.canary_drill:
         return run_canary_drill(args)
+    if args.pool_drill:
+        return run_pool_drill(args)
     if args.pack_drill:
         return run_pack_drill(args)
     if args.smoke:

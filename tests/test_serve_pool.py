@@ -1,0 +1,617 @@
+"""Multi-worker serving (serve/pool.py): carve grammar + validation,
+weighted-fair tenant QoS, quota rejects, bucket-affine routing, crash
+reroute to a warm neighbor, stream pinning/loss and live recarve — all on
+the jax-free worker stub (tests/worker_stub.py), so the whole scheduler
+plane runs in milliseconds. The real-subprocess pool acceptance is the
+slow-marked test at the bottom; ci.sh gates the same contract end to end
+via the rc-12 pool drill.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from maskclustering_tpu.config import (load_config, parse_carve_spec,
+                                       parse_tenant_spec)
+from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.serve.admission import AdmissionQueue
+from maskclustering_tpu.serve.pool import (QuotaReject, WorkerPool,
+                                           check_carve)
+from maskclustering_tpu.serve.router import Router
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB = os.path.join(REPO_ROOT, "tests", "worker_stub.py")
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(data_root=str(tmp_path), config_name="pool", step=1,
+                distance_threshold=0.05, mask_pad_multiple=32,
+                worker_heartbeat_s=1.0, retry_backoff_s=0.05)
+    base.update(kw)
+    return load_config("scannet").replace(**base)
+
+
+class _Client:
+    def __init__(self):
+        self.events = []
+        self.done = threading.Event()
+
+    def send(self, ev):
+        self.events.append(ev)
+        if ev.get("kind") in ("result", "reject"):
+            self.done.set()
+
+    @property
+    def terminal(self):
+        return self.events[-1] if self.events else None
+
+    def states(self):
+        return [e.get("state") for e in self.events
+                if e.get("kind") == "status"]
+
+
+def _admit(pool, scene, i, *, op="scene", tenant="", **kw):
+    client = _Client()
+    doc = {"op": op, "scene": scene, **kw}
+    if tenant:
+        doc["tenant"] = tenant
+    req = protocol.build_request(doc, f"p-{i:06d}")
+    req.send = client.send
+    pool.admit(req)
+    return client
+
+
+def _make_pool(tmp_path, queue=None, **cfg_kw):
+    cfg = _cfg(tmp_path, **cfg_kw)
+    queue = queue or AdmissionQueue(32)
+    pool = WorkerPool(cfg, queue, Router(cfg),
+                      journal_dir=str(tmp_path / "journals"),
+                      child_argv=[sys.executable, STUB],
+                      start_timeout_s=15.0, poll_s=0.05)
+    return pool, queue
+
+
+@pytest.fixture()
+def stub_pool(tmp_path, monkeypatch):
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    pool, queue = _make_pool(tmp_path, serve_workers=2)
+    pool.start()
+    yield pool, queue
+    pool.stop(timeout_s=15.0)
+
+
+# ---------------------------------------------------------------------------
+# carve / tenant grammar + typed config validation
+# ---------------------------------------------------------------------------
+
+
+def test_parse_carve_spec_grammar():
+    assert parse_carve_spec("4x2") == (4, 2)
+    assert parse_carve_spec("1x8") == (1, 8)
+    for bad in ("", "x", "4x", "x2", "4x2x1", "ax2", "4xb"):
+        with pytest.raises(ValueError):
+            parse_carve_spec(bad)
+    for bad in ("0x2", "4x0", "-1x2"):
+        with pytest.raises(ValueError):
+            parse_carve_spec(bad)
+
+
+def test_parse_tenant_spec_grammar():
+    spec = parse_tenant_spec("heavy:3,light:1:4")
+    assert spec == {"heavy": (3.0, None), "light": (1.0, 4)}
+    assert parse_tenant_spec("a:0.5") == {"a": (0.5, None)}
+    for bad in ("a", "a:1:2:3", ":1", "a:x", "a:0", "a:-1", "a:1:0",
+                "a:1:1.5", "a:1,a:2", "a/b:1"):
+        with pytest.raises(ValueError):
+            parse_tenant_spec(bad)
+
+
+def test_config_validates_pool_knobs(tmp_path):
+    with pytest.raises(ValueError, match="serve_workers"):
+        _cfg(tmp_path, serve_workers=0)
+    with pytest.raises(ValueError, match="must equal serve_workers"):
+        _cfg(tmp_path, serve_workers=2, serve_carve="3x2")
+    with pytest.raises(ValueError):
+        _cfg(tmp_path, serve_tenants="a:1:2:3")
+    cfg = _cfg(tmp_path, serve_workers=2, serve_carve="2x4",
+               serve_tenants="heavy:3,light:1:4")
+    assert cfg.serve_workers == 2
+
+
+def test_check_carve_divides_device_product():
+    check_carve(2, 4, 8)          # 2x4 on 8 chips: exact
+    check_carve(2, 2, 8)          # 2x2 on 8: divides
+    check_carve(2, 0, 8)          # no carve: every slice whole-backend
+    check_carve(2, 4, None)       # backend not inspectable: skip
+    with pytest.raises(ValueError, match="divide"):
+        check_carve(2, 8, 8)      # 16 > 8
+    with pytest.raises(ValueError, match="divide"):
+        check_carve(3, 2, 8)      # 6 does not divide 8
+
+
+# ---------------------------------------------------------------------------
+# the scheduler plane, on the stub pool
+# ---------------------------------------------------------------------------
+
+
+def test_pool_serves_on_both_workers(stub_pool):
+    pool, _ = stub_pool
+    clients = [_admit(pool, "stub-ok", i) for i in range(6)]
+    for c in clients:
+        assert c.done.wait(15.0) and c.terminal["status"] == "ok"
+    assert pool.wait_idle(10.0)
+    st = pool.stats()
+    assert st["counts"]["ok"] == 6
+    assert st["pool"]["scheduler"]["dispatched"] == 6
+    assert len(st["pool"]["workers"]) == 2
+    assert st["worker"]["pool"] == 2 and st["worker"]["alive"] == 2
+    # both slices took work (least-loaded routing spreads an idle pool)
+    assert sum(w["dispatched"] for w in st["pool"]["workers"]) == 6
+
+
+def test_weighted_fair_three_to_one_dispatch_order(tmp_path, monkeypatch):
+    """Under saturation a 3:1 weight ratio dequeues 3:1 by virtual-time
+    stride scheduling — asserted on the dispatch ORDER (deterministic),
+    not on wall-clock completion races."""
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    pool, _ = _make_pool(tmp_path, serve_workers=2,
+                         serve_tenants="heavy:3,light:1")
+    order = []
+    book = pool._book_dispatch
+    pool._book_dispatch = lambda req, wid: (order.append(req.tenant),
+                                            book(req, wid))[1]
+    pool._pause.set()  # hold dispatch until the whole burst is queued
+    pool.start()
+    try:
+        clients = []
+        for i in range(12):
+            clients.append(_admit(pool, "stub-ok", i, tenant="heavy"))
+        for i in range(12, 24):
+            clients.append(_admit(pool, "stub-ok", i, tenant="light"))
+        pool._pause.clear()
+        for c in clients:
+            assert c.done.wait(30.0) and c.terminal["status"] == "ok"
+        # stride scheduling: every 4-dispatch window is 3 heavy + 1 light
+        # until the heavy queue drains
+        assert order[:4].count("heavy") == 3
+        assert order[:8].count("heavy") == 6
+        assert order[:12].count("heavy") == 9
+        st = pool.stats()["pool"]["tenants"]
+        assert st["heavy"]["dispatched"] == 12
+        assert st["heavy"]["weight"] == 3.0
+        assert st["light"]["dispatched"] == 12
+    finally:
+        pool.stop(timeout_s=15.0)
+
+
+def test_quota_exhaustion_rejects_typed(tmp_path, monkeypatch):
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    pool, _ = _make_pool(tmp_path, serve_workers=2,
+                         serve_tenants="capped:1:2")
+    pool._pause.set()  # keep the queued count at its admitted level
+    pool.start()
+    try:
+        c1 = _admit(pool, "stub-ok", 1, tenant="capped")
+        c2 = _admit(pool, "stub-ok", 2, tenant="capped")
+        with pytest.raises(QuotaReject) as ei:
+            _admit(pool, "stub-ok", 3, tenant="capped")
+        assert ei.value.tenant == "capped"
+        assert ei.value.limit == 2 and ei.value.queued == 2
+        # an unknown tenant has no quota: admission proceeds
+        c4 = _admit(pool, "stub-ok", 4, tenant="other")
+        pool._pause.clear()
+        for c in (c1, c2, c4):
+            assert c.done.wait(15.0) and c.terminal["status"] == "ok"
+        # dispatch released the quota slots: the tenant admits again
+        assert pool.wait_idle(10.0)
+        c5 = _admit(pool, "stub-ok", 5, tenant="capped")
+        assert c5.done.wait(15.0) and c5.terminal["status"] == "ok"
+    finally:
+        pool.stop(timeout_s=15.0)
+
+
+def test_affinity_warm_bucket_routes_to_warm_slice(stub_pool):
+    pool, _ = stub_pool
+    assert pool.wait_idle(10.0)
+    bucket = (63, 32, 16384)
+    pool.router.remember("warm-scene", bucket)
+    pool._warm[1].add(bucket)
+    req = protocol.build_request({"op": "scene", "scene": "warm-scene"},
+                                 "r-route-1")
+    verdict, wid = pool._route(req)
+    assert (verdict, wid) == ("dispatch", 1)
+    # a cold bucket falls back to least-loaded (tie -> lowest id), and
+    # dispatch marks the slice warm for its successors
+    pool.router.remember("cold-scene", (7, 8, 1024))
+    cold = protocol.build_request({"op": "scene", "scene": "cold-scene"},
+                                  "r-route-2")
+    verdict, wid = pool._route(cold)
+    assert verdict == "dispatch" and wid == 0
+    c = _admit(pool, "cold-scene", 990)
+    assert c.done.wait(15.0)
+    assert any((7, 8, 1024) in w for w in pool._warm)
+    hits = pool.stats()["pool"]["scheduler"]
+    assert hits["affinity_misses"] >= 1
+
+
+def test_pool_streams_pin_to_owner_slice(stub_pool):
+    pool, _ = stub_pool
+    c1 = _admit(pool, "stream-a", 1, op="stream_chunk")
+    assert c1.done.wait(15.0) and c1.terminal["status"] == "ok"
+    assert c1.terminal["done"] is False
+    owner = pool._stream_owner["stream-a"]
+    req = protocol.build_request({"op": "stream_chunk", "scene": "stream-a"},
+                                 "r-pin-2")
+    verdict, wid = pool._route(req)
+    assert (verdict, wid) == ("dispatch", owner)
+    c2 = _admit(pool, "stream-a", 2, op="stream_end")
+    assert c2.done.wait(15.0) and c2.terminal["status"] == "ok"
+    assert c2.terminal["done"] is True
+
+
+def test_pool_stream_on_retired_owner_answers_stream_lost(stub_pool):
+    pool, _ = stub_pool
+    c1 = _admit(pool, "stream-b", 1, op="stream_chunk")
+    assert c1.done.wait(15.0) and c1.terminal["status"] == "ok"
+    owner = pool._stream_owner["stream-b"]
+    with pool._lock:
+        pool._dead.add(owner)  # simulate a retired slice
+    try:
+        c2 = _admit(pool, "stream-b", 2, op="stream_chunk")
+        assert c2.done.wait(15.0)
+        assert "stream_lost" in c2.states()
+        assert c2.terminal["status"] == "failed"
+        assert c2.terminal["error_class"] == "stream_lost"
+        assert "stream-b" not in pool._stream_owner
+        # a restarted stream opens FRESH on a surviving slice
+        c3 = _admit(pool, "stream-b", 3, op="stream_chunk")
+        assert c3.done.wait(15.0) and c3.terminal["status"] == "ok"
+        assert pool._stream_owner["stream-b"] != owner
+    finally:
+        with pool._lock:
+            pool._dead.discard(owner)
+
+
+@pytest.mark.slow  # ~2.5s of stub subprocess lifecycles; ci.sh's exit-12
+# pool drill gates the same reroute contract on REAL workers out of tier-1
+def test_crash_reroutes_victim_to_neighbor(tmp_path, monkeypatch):
+    """A SIGKILL mid-request on slice 0: the victim reroutes to slice 1
+    (warm neighbor) instead of waiting out the respawn; the neighbor's
+    own work is untouched."""
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    pool, _ = _make_pool(tmp_path, serve_workers=2)
+    pool.start()
+    try:
+        assert pool.wait_idle(10.0)
+        crash = _admit(pool, "stub-crash", 1)
+        neighbor = _admit(pool, "stub-ok", 2)
+        assert crash.done.wait(30.0), "crash victim never answered"
+        assert neighbor.done.wait(30.0)
+        assert "worker_crash" in crash.states()
+        assert crash.terminal["status"] == "ok"
+        assert neighbor.terminal["status"] == "ok"
+        st = pool.stats()
+        # exactly one slice crashed; the victim's heal came from the pool
+        assert st["worker"]["crashes"] == 1
+        assert (st["pool"]["scheduler"]["crash_reroutes"] >= 1
+                or st["counts"]["ok"] == 2)
+    finally:
+        pool.stop(timeout_s=15.0)
+
+
+def test_recarve_with_inflight_drains_cleanly(tmp_path, monkeypatch):
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    pool, _ = _make_pool(tmp_path, serve_workers=2)
+    pool.start()
+    try:
+        slow = _admit(pool, "stub-slow", 1)
+        time.sleep(0.3)  # let it dispatch
+        out = pool.recarve(workers=1, timeout_s=30.0)
+        # the in-flight request drained BEFORE the old slices stopped
+        assert slow.done.wait(5.0) and slow.terminal["status"] == "ok"
+        assert out["ok"] is True and out["workers"] == 1
+        assert pool.workers == 1 and len(pool._sups) == 1
+        assert pool.stats()["pool"]["scheduler"]["recarves"] == 1
+        # the recarved pool serves
+        c = _admit(pool, "stub-ok", 2)
+        assert c.done.wait(15.0) and c.terminal["status"] == "ok"
+        # pre-recarve history survives the carve (retired-slice baseline);
+        # parent-side count booking trails the client answer, so poll
+        deadline = time.monotonic() + 5.0
+        while (pool.stats()["counts"]["ok"] < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert pool.stats()["counts"]["ok"] >= 2
+        with pytest.raises(ValueError, match="contradicts"):
+            pool.recarve(workers=2, carve="3x1")
+        with pytest.raises(ValueError, match="recarve needs"):
+            pool.recarve()
+    finally:
+        pool.stop(timeout_s=15.0)
+
+
+def test_pool_merged_retrace_and_canary(stub_pool):
+    pool, _ = stub_pool
+    c = _admit(pool, "stub-ok", 1)
+    assert c.done.wait(15.0)
+    digest = pool.child_retrace()
+    assert digest.get("compiles") == 0  # sum of zeros across slices
+    assert set(digest.get("workers", {})) == {"0", "1"}
+    probes = pool.run_canary(timeout_s=10.0)
+    assert probes and probes[0]["digest"]["plane"] == "aaaaaaaa"
+
+
+# ---------------------------------------------------------------------------
+# stream loss across a worker crash (supervisor-level, stub)
+# ---------------------------------------------------------------------------
+
+
+def _submit_q(queue, scene, i, *, op="scene", **kw):
+    client = _Client()
+    req = protocol.build_request({"op": op, "scene": scene, **kw},
+                                 f"s-{i:06d}")
+    req.send = client.send
+    queue.submit(req)
+    return client
+
+
+def test_supervisor_stream_lost_on_crash(tmp_path, monkeypatch):
+    """An open stream session dies with its worker: the next op answers a
+    TYPED stream_lost (status + failed result) instead of silently
+    reopening at chunk 0 — and a restarted stream serves fresh."""
+    from maskclustering_tpu.serve.supervisor import WorkerSupervisor
+
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    cfg = _cfg(tmp_path)
+    queue = AdmissionQueue(8)
+    sup = WorkerSupervisor(cfg, queue, Router(cfg),
+                           journal_dir=str(tmp_path / "journals"),
+                           child_argv=[sys.executable, STUB],
+                           start_timeout_s=15.0, poll_s=0.05)
+    sup.start()
+    try:
+        opened = _submit_q(queue, "stream-x", 1, op="stream_chunk")
+        assert opened.done.wait(15.0) and opened.terminal["status"] == "ok"
+        assert sup.stats()["worker"]["open_streams"] == 1
+        # the crash takes the child (and the device-resident session)
+        crash = _submit_q(queue, "stub-crash", 2)
+        assert crash.done.wait(30.0) and crash.terminal["status"] == "ok"
+        assert sup.stats()["worker"]["lost_streams"] == 1
+        lost = _submit_q(queue, "stream-x", 3, op="stream_chunk")
+        assert lost.done.wait(15.0)
+        assert "stream_lost" in lost.states()
+        assert lost.terminal["status"] == "failed"
+        assert lost.terminal["error_class"] == "stream_lost"
+        # answered = cleared: the client restarts the stream from scratch
+        fresh = _submit_q(queue, "stream-x", 4, op="stream_chunk")
+        assert fresh.done.wait(15.0) and fresh.terminal["status"] == "ok"
+        assert sup.stats()["worker"]["lost_streams"] == 0
+    finally:
+        sup.stop(timeout_s=10.0)
+
+
+@pytest.mark.slow  # ~2.4s of stub subprocess lifecycles; the tier-1 twin
+# (test_supervisor_stream_lost_on_crash) keeps the stream_lost contract hot
+def test_stream_crash_mid_op_answers_stream_lost(tmp_path, monkeypatch):
+    """The crash lands ON the stream op itself: never requeued across the
+    crash (the wire chunk parameter is frames-per-chunk, not a cursor —
+    a silent replay would corrupt the session), answered stream_lost."""
+    from maskclustering_tpu.serve.supervisor import WorkerSupervisor
+
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    cfg = _cfg(tmp_path)
+    queue = AdmissionQueue(8)
+    sup = WorkerSupervisor(cfg, queue, Router(cfg),
+                           journal_dir=str(tmp_path / "journals"),
+                           child_argv=[sys.executable, STUB],
+                           start_timeout_s=15.0, poll_s=0.05)
+    sup.start()
+    try:
+        c = _submit_q(queue, "stub-crash", 1, op="stream_chunk")
+        assert c.done.wait(30.0)
+        assert "stream_lost" in c.states()
+        assert c.terminal["status"] == "failed"
+        assert c.terminal["error_class"] == "stream_lost"
+        # the supervisor healed: the next request serves
+        ok = _submit_q(queue, "stub-ok", 2)
+        assert ok.done.wait(20.0) and ok.terminal["status"] == "ok"
+    finally:
+        sup.stop(timeout_s=10.0)
+
+
+# ---------------------------------------------------------------------------
+# observability plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_window_rows_carry_worker_map():
+    from maskclustering_tpu.obs.telemetry import WindowAggregator
+
+    agg = WindowAggregator(window_s=60.0)
+    agg.record_request((63, 32, 16384), 0.05, tenant="a", worker=0)
+    agg.record_request((63, 32, 16384), 0.06, tenant="a", worker=1)
+    agg.record_request((63, 32, 16384), 0.07, tenant="b", worker=1)
+    row = agg.roll()
+    assert row["workers"] == {"0": 1, "1": 2}
+    # single-worker daemons (worker=None) never grow the key
+    agg.record_request((63, 32, 16384), 0.05)
+    assert "workers" not in agg.roll()
+
+
+def test_fold_telem_tags_spans_with_worker_id():
+    from maskclustering_tpu import obs
+    from maskclustering_tpu.obs.telemetry import fold_telem
+
+    events = []
+    orig = obs.record_span
+
+    def capture(name, dur_s, **kw):
+        events.append(kw)
+        return orig(name, dur_s, **kw)
+
+    obs.record_span, saved = capture, orig
+    try:
+        fold_telem({"kind": "telem", "v": 1, "seq": 1,
+                    "metrics": {"counters": {}, "gauges": {}},
+                    "spans": [{"name": "serve.request", "dur_s": 0.05,
+                               "sync_s": 0.0, "depth": 0,
+                               "ts": time.time(), "attrs": {"request": "r1"}}]},
+                   worker_id=3)
+    finally:
+        obs.record_span = saved
+    assert events and events[0]["worker_id"] == 3
+
+
+def test_report_renders_pool_lines():
+    from maskclustering_tpu.obs.report import render_pool
+
+    class _Run:
+        _counters = {"serve.pool.dispatched": 10,
+                     "serve.pool.affinity_hits": 9,
+                     "serve.pool.affinity_misses": 1,
+                     "serve.pool.crash_reroutes": 1}
+        telemetry_rows = [
+            {"workers": {"0": 4, "1": 6},
+             "tenants": {"heavy": {"requests": 7}, "light": {"requests": 3}}},
+        ]
+
+    lines = render_pool(_Run())
+    text = "\n".join(lines)
+    assert "affinity 9/10 warm (90%)" in text
+    assert "worker 0: completions 4 (40%)" in text
+    assert "worker 1: completions 6 (60%)" in text
+    assert "heavy 7 (70%)" in text and "light 3 (30%)" in text
+
+    class _Empty:
+        _counters = {}
+        telemetry_rows = []
+
+    assert render_pool(_Empty()) == []  # single-worker reports unchanged
+
+
+def test_top_renders_pool_panel():
+    from maskclustering_tpu.obs.top import render_top
+
+    stats = {
+        "config": "pool", "uptime_s": 12.0,
+        "queue": {"depth": 0, "capacity": 8},
+        "worker": {"isolated": True, "pool": 2, "alive": 2, "spawns": 2,
+                   "respawns": 0, "crashes": 0, "inflight_width": 0},
+        "pool": {
+            "carve": "2x4",
+            "workers": [
+                {"worker_id": 0, "pid": 11, "hb_age_s": 0.1, "retired": False,
+                 "feed_depth": 0, "dispatched": 4, "warm_buckets": 3,
+                 "consecutive_respawns": 0, "open_streams": 1,
+                 "lost_streams": 0},
+                {"worker_id": 1, "pid": 12, "hb_age_s": 0.2, "retired": True,
+                 "feed_depth": 0, "dispatched": 6, "warm_buckets": 3,
+                 "consecutive_respawns": 2, "open_streams": 0,
+                 "lost_streams": 1}],
+            "scheduler": {"dispatched": 10, "affinity_hits": 9,
+                          "affinity_misses": 1, "crash_reroutes": 1,
+                          "recarves": 0},
+            "tenants": {"heavy": {"dispatched": 7, "weight": 3.0},
+                        "light": {"dispatched": 3, "weight": 1.0,
+                                  "quota": 4, "queued": 0}}},
+    }
+    out = render_top(stats)
+    assert "pool: carve 2x4 | alive 2/2" in out
+    assert "worker 0: up" in out and "worker 1: RETIRED" in out
+    assert "affinity 9/10 warm (90%)" in out
+    assert "dequeue share: heavy 7 (w=3.0) | light 3 (w=1.0, quota 4)" in out
+    # an empty pool never reaches the panel (single-worker daemons)
+    solo = dict(stats)
+    solo.pop("pool")
+    assert "pool: carve" not in render_top(solo)
+
+
+def test_protocol_recarve_grammar():
+    assert protocol.parse_line(
+        '{"op": "recarve", "workers": 2, "carve": "2x4"}')["op"] == "recarve"
+    with pytest.raises(protocol.ProtocolError):
+        protocol.parse_line('{"op": "recarve", "workers": "two"}')
+    with pytest.raises(protocol.ProtocolError):
+        protocol.parse_line('{"op": "recarve", "carve": 4}')
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a real 2-worker pool, end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # two real subprocess warm-ups; ci.sh gates the same
+# contract end to end via the rc-12 pool drill
+def test_real_two_worker_pool_serves_warm_and_byte_identical(tmp_path):
+    """The pool acceptance on real worker subprocesses: a 2-slice CPU
+    carve serves a mixed-bucket, weighted-tenant burst with BOTH slices
+    dispatching, artifact digests unanimous per scene across slices,
+    zero post-warm compiles on every worker's digest, and the pool
+    stats/scheduler plane populated."""
+    from maskclustering_tpu.analysis import retrace_sanitizer
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    write_scannet_layout)
+
+    root = str(tmp_path / "data")
+    scenes = {
+        "pl-a": dict(num_boxes=3, num_frames=6, image_hw=(48, 64),
+                     spacing=0.08, seed=11),
+        "pl-b": dict(num_boxes=4, num_frames=6, image_hw=(48, 64),
+                     spacing=0.07, seed=12),
+    }
+    for name, spec in scenes.items():
+        write_scannet_layout(make_scene(**spec), root, name)
+
+    cfg = _cfg(tmp_path, data_root=root, serve_workers=2,
+               serve_tenants="heavy:3,light:1",
+               aot_cache_dir=str(tmp_path / "aot"),
+               worker_heartbeat_s=30.0, retry_backoff_s=0.1)
+    prev_armed = retrace_sanitizer.enabled()
+    retrace_sanitizer.arm(True)  # children inherit --retrace-sanitizer
+    queue = AdmissionQueue(32)
+    pool = WorkerPool(cfg, queue, Router(cfg),
+                      journal_dir=str(tmp_path / "journals"),
+                      warm_scenes=tuple(scenes), freeze_after_warm=True,
+                      start_timeout_s=600.0, poll_s=0.1)
+    try:
+        pool.start()
+        names = sorted(scenes)
+        clients = [
+            _admit(pool, names[i % 2], i,
+                   tenant="heavy" if i % 4 else "light")
+            for i in range(8)]
+        for c in clients:
+            assert c.done.wait(600.0), "request never answered"
+            assert c.terminal["status"] == "ok", c.terminal
+        # byte-identity across slices: whichever worker (and however
+        # many times) served a scene, its artifact digest is unanimous
+        by_scene = {}
+        for i, c in enumerate(clients):
+            dg = (c.terminal.get("digest") or {}).get("artifact")
+            by_scene.setdefault(names[i % 2], set()).add(dg)
+        for scene, digests in by_scene.items():
+            assert len(digests) == 1 and None not in digests, (scene,
+                                                               digests)
+        stats = pool.stats()
+        workers = stats["pool"]["workers"]
+        assert len(workers) == 2
+        assert all(w["alive"] for w in workers)
+        assert all(w["dispatched"] for w in workers), \
+            "a slice never dispatched — the scheduler is not spreading"
+        sched = stats["pool"]["scheduler"]
+        assert sched["dispatched"] >= 8
+        assert stats["pool"]["tenants"]["heavy"]["dispatched"] == 6
+        # zero post-warm compiles on EVERY worker's own digest
+        retrace = pool.child_retrace()
+        assert retrace.get("frozen") is True
+        assert retrace.get("post_freeze", 0) == 0, retrace
+        per = retrace.get("workers") or {}
+        assert sorted(per) == ["0", "1"]
+        for wid, dg in per.items():
+            assert dg.get("post_freeze", 0) == 0, (wid, dg)
+    finally:
+        pool.stop(timeout_s=60.0)
+        retrace_sanitizer.arm(prev_armed)

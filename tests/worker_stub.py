@@ -73,6 +73,22 @@ def main():
                             "count_dtype": "bf16", "plane": "aaaaaaaa",
                             "artifact": "bbbbbbbb", "nan_inf": 0}}]})
             continue
+        if doc.get("op") in ("stream_chunk", "stream_end"):
+            # live-scan session, in miniature: every chunk answers ok /
+            # not-done (the supervisor's open-stream tracker latches),
+            # stream_end closes it. The crash scenes behave as for
+            # "scene" ops, so stream-loss-on-crash is testable here.
+            rid, scene = doc["id"], doc["scene"]
+            emit({"kind": "status", "id": rid, "state": "running",
+                  "scene": scene})
+            if scene == "stub-crash" and once("crash"):
+                os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(0.05)
+            done = doc["op"] == "stream_end"
+            emit({"kind": "result", "id": rid, "status": "ok",
+                  "seconds": 0.05, "done": done, "partial_instances": 1,
+                  "frames_seen": 2})
+            continue
         if doc.get("op") != "scene":
             continue
         rid, scene = doc["id"], doc["scene"]
