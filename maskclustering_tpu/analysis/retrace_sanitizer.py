@@ -167,6 +167,21 @@ class _State:
             self.pending.key = None
             if hit and key is not None:
                 self.cache_hits[key] = self.cache_hits.get(key, 0) + 1
+                # a hit means the "compile" was a persistent-cache
+                # deserialize (a warm restart replaying a prior process's
+                # executable — the WAL-recovery path depends on this), so
+                # the post_freeze violation on_compile provisionally
+                # recorded for this build is retracted; repeat violations
+                # stay — a rebuilt key still means the in-process jit
+                # cache was dropped, however the bytes were produced
+                fn, digest, context = key
+                for i in range(len(self.violations) - 1, -1, -1):
+                    v = self.violations[i]
+                    if (v["kind"] == "post_freeze" and v["fn"] == fn
+                            and v["sig"] == digest
+                            and v["context"] == context):
+                        del self.violations[i]
+                        break
 
 
 def _rung_sanctioned(fn: str, context: str) -> bool:

@@ -315,6 +315,18 @@ class PipelineConfig:
     # weight 1 with no quota; "" = no QoS (FIFO)
     serve_tenants: str = ""
 
+    # --- serving durability (serve/wal.py) ---
+    # per-request journal / stream-snapshot retention: keep at most this
+    # many settled files in journal_dir/ and stream_state/ (0 = keep
+    # all), and delete anything older than serve_journal_max_age_s
+    # seconds (0 = no age bound). Pruning runs at daemon start and every
+    # serve_prune_interval_s on a timer, counted as
+    # serve.journals_pruned; the admission WAL itself and files younger
+    # than the live-state floor are never pruned
+    serve_journal_keep: int = 512
+    serve_journal_max_age_s: float = 0.0
+    serve_prune_interval_s: float = 300.0
+
     # --- persistent AOT executable cache (utils/aot_cache.py) ---
     # "" = off (unless $MCT_AOT_CACHE arms it), "auto" = aot_cache/ next
     # to the perf ledger, any other value = explicit directory. Armed, the
@@ -408,7 +420,8 @@ class PipelineConfig:
                 f"scene_retries must be >= 0, got {self.scene_retries}")
         for knob in ("retry_backoff_s", "watchdog_load_s",
                      "watchdog_device_s", "watchdog_host_s",
-                     "worker_heartbeat_s"):
+                     "worker_heartbeat_s", "serve_journal_keep",
+                     "serve_journal_max_age_s", "serve_prune_interval_s"):
             if getattr(self, knob) < 0:
                 raise ValueError(
                     f"{knob} must be >= 0, got {getattr(self, knob)}")

@@ -206,7 +206,12 @@ def serve_row(verdict: Dict, **extra) -> Dict:
               # (batch_dimension below, occupancy advisory in
               # check_regression)
               "batch_occupancy", "batch_dispatches", "batch_max",
-              "batch_hist"):
+              "batch_hist",
+              # mct-durable: failover/replay evidence from the chaos
+              # drill — a row measured under injected worker/daemon death
+              # is its own dimension (durability_dimension below)
+              "streams_resumed", "wal_replayed", "wal_deduped",
+              "journals_pruned"):
         if verdict.get(k) is not None:
             row[k] = verdict[k]
     row.update(extra)
@@ -248,6 +253,20 @@ def batch_dimension(row: Optional[Dict]) -> bool:
     attributed as advisory lines in ``check_regression`` instead.
     """
     return (row or {}).get("batch_occupancy") is not None
+
+
+def durability_dimension(row: Optional[Dict]) -> bool:
+    """True when a ledger row was measured under failover/replay — a
+    stream resumed from a snapshot or a WAL replay answered requests
+    (the chaos drill's rows).
+
+    Re-run chunks and daemon restarts inflate per-request latency for
+    reasons that are the DRILL's, not code drift's, so --regress fences
+    the dimension BOTH ways (obs/report.py), like ``batch_dimension``: a
+    failover row never gates against a clean baseline, and vice versa.
+    """
+    row = row or {}
+    return bool(row.get("streams_resumed")) or bool(row.get("wal_replayed"))
 
 
 def tier1_row(wall_s: float, passed: int, **extra) -> Dict:

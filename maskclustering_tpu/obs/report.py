@@ -1000,6 +1000,13 @@ def _regress_eval(ledger_path: str, baseline_path: str,
     # advisory attribution lines inside check_regression)
     packed = led.batch_dimension(baseline or {})
     rows = [r for r in rows if led.batch_dimension(r) == packed]
+    # durability fence, both ways (mct-durable): a row measured under
+    # failover/replay (streams resumed from snapshots, WAL replay after a
+    # daemon kill) pays re-run chunks and restart walls that are the
+    # chaos drill's, not code drift's — it only gates against a baseline
+    # measured under failover too, and never fences a clean row
+    failover = led.durability_dimension(baseline or {})
+    rows = [r for r in rows if led.durability_dimension(r) == failover]
     # gate comparable rows: a run-row median must not be compared against a
     # bench baseline just because it is the newest numeric row
     current = None

@@ -77,7 +77,21 @@ def main(argv=None) -> int:
                              "(<dir>/<request id>.jsonl; default: "
                              "<data_root>/serve_journals)")
     parser.add_argument("--no-journal", action="store_true",
-                        help="disable per-request journals")
+                        help="disable per-request journals (also disables "
+                             "the admission WAL, which lives beside them)")
+    parser.add_argument("--no-wal", action="store_true",
+                        help="disable the admission WAL (serve/wal.py): no "
+                             "crash-replay of admitted requests, no "
+                             "idempotency-key dedupe")
+    parser.add_argument("--stream-state", default=None, metavar="DIR",
+                        help="shared per-chunk stream snapshot directory "
+                             "(models/streaming save_state): a crashed "
+                             "stream's session re-opens from the latest "
+                             "snapshot instead of answering stream_lost "
+                             "(default: <data_root>/stream_state)")
+    parser.add_argument("--no-stream-state", action="store_true",
+                        help="disable stream snapshots/failover (crashed "
+                             "streams answer the typed stream_lost)")
     parser.add_argument("--warm", default=None,
                         help="+-joined scene names to run end-to-end "
                              "(exports included) before accepting requests")
@@ -222,6 +236,10 @@ def main(argv=None) -> int:
     if not args.no_journal:
         journal_dir = args.journal_dir or os.path.join(cfg.data_root,
                                                        "serve_journals")
+    stream_state_dir = None
+    if not args.no_stream_state:
+        stream_state_dir = args.stream_state or os.path.join(
+            cfg.data_root, "stream_state")
 
     from maskclustering_tpu.serve.daemon import ServeDaemon
 
@@ -231,6 +249,8 @@ def main(argv=None) -> int:
         host=args.host, port=args.port,
         capacity=args.capacity,
         journal_dir=journal_dir,
+        stream_state_dir=stream_state_dir,
+        wal=not args.no_wal,
         prediction_root=args.prediction_root,
         warm_scenes=tuple(s for s in (args.warm or "").split("+") if s),
         warm_baseline=args.warm_baseline,

@@ -67,8 +67,14 @@ class ServeClient:
 
     def request_scene(self, scene: str, *, synthetic: Optional[Dict] = None,
                       deadline_s: float = 0.0, resume: bool = False,
-                      tag: str = "", tenant: str = "") -> Dict:
-        """Submit one scene request; returns the ack or reject event."""
+                      tag: str = "", tenant: str = "",
+                      idem: str = "") -> Dict:
+        """Submit one scene request; returns the ack or reject event.
+
+        ``idem`` (optional) arms the daemon's WAL dedupe contract: a
+        resubmit with the same key after a reconnect re-attaches to the
+        running request or replays the cached terminal (``deduped``).
+        """
         doc: Dict = {"op": "scene", "scene": scene}
         if synthetic is not None:
             doc["synthetic"] = synthetic
@@ -80,6 +86,8 @@ class ServeClient:
             doc["tag"] = tag
         if tenant:
             doc["tenant"] = tenant
+        if idem:
+            doc["idem"] = idem
         self.send(doc)
         return self.recv_event()
 
@@ -110,7 +118,8 @@ class ServeClient:
 
     def stream_chunk(self, scene: str, *, chunk: int = 0,
                      synthetic: Optional[Dict] = None, deadline_s: float = 0.0,
-                     tag: str = "", tenant: str = "") -> Tuple[Dict, List[Dict]]:
+                     tag: str = "", tenant: str = "",
+                     idem: str = "") -> Tuple[Dict, List[Dict]]:
         """Accumulate the scene's next frame chunk on the daemon.
 
         Returns ``(terminal event, status events)`` — the terminal result
@@ -129,6 +138,8 @@ class ServeClient:
             doc["tag"] = tag
         if tenant:
             doc["tenant"] = tenant
+        if idem:
+            doc["idem"] = idem
         self.send(doc)
         first = self.recv_event()
         if first.get("kind") == "reject":

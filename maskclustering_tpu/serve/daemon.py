@@ -48,6 +48,7 @@ from maskclustering_tpu.obs import flight as _flight
 from maskclustering_tpu.obs import slo as _slo
 from maskclustering_tpu.obs import telemetry
 from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.serve import wal as _wal
 from maskclustering_tpu.serve.admission import AdmissionQueue, QueueFullReject
 from maskclustering_tpu.serve.pool import QuotaReject
 from maskclustering_tpu.serve.router import Router
@@ -82,6 +83,37 @@ def _make_sender(conn: socket.socket):
     return send
 
 
+class _WalSend:
+    """A WAL-tracked request's ``send``: records the dispatch row (first
+    status event) and the terminal row in the admission WAL, then forwards
+    to the currently attached client connection. ``client`` is the one
+    mutable cell — a reconnect-and-resubmit with the same idempotency key
+    swaps it live (re-attach), and a request replayed from the WAL starts
+    detached (``client`` None: the work runs and journals, the terminal
+    waits in the dedupe cache for the client's resubmit)."""
+
+    def __init__(self, daemon: "ServeDaemon", rid: str, idem: str,
+                 client=None):
+        self._daemon = daemon
+        self.rid = rid
+        self.idem = idem
+        self.client = client
+        self._dispatched = False
+
+    def __call__(self, event: Dict) -> None:
+        kind = event.get("kind")
+        if kind == "status" and not self._dispatched:
+            # benign race on the flag: at worst a duplicate advisory
+            # dispatch row, never a lost one
+            self._dispatched = True
+            self._daemon._wal_dispatch(self.rid)
+        if kind in ("result", "reject"):
+            self._daemon._wal_terminal(self.rid, self.idem, event)
+        client = self.client
+        if client is not None:
+            client(event)
+
+
 class ServeDaemon:
     """One serving process: admission + router + worker + socket front."""
 
@@ -91,6 +123,8 @@ class ServeDaemon:
                  capacity: int = DEFAULT_CAPACITY,
                  journal_dir: Optional[str] = None,
                  prediction_root: Optional[str] = None,
+                 stream_state_dir: Optional[str] = None,
+                 wal: bool = True,
                  warm_scenes: Tuple[str, ...] = (),
                  warm_baseline: Optional[str] = None,
                  freeze_after_warm: bool = True,
@@ -112,6 +146,13 @@ class ServeDaemon:
         self.freeze_after_warm = freeze_after_warm
         self.warm_scenes = tuple(warm_scenes)
         self.isolate_worker = bool(isolate_worker)
+        self.journal_dir = journal_dir
+        # shared per-chunk stream snapshots (models/streaming save_state):
+        # the worker ships them here and a crashed stream's session
+        # re-opens from the latest one instead of answering stream_lost
+        self.stream_state_dir = stream_state_dir
+        if stream_state_dir:
+            os.makedirs(stream_state_dir, exist_ok=True)
         self.queue = AdmissionQueue(capacity)
         self.router = Router(cfg, baseline_path=warm_baseline)
         pool_size = max(int(cfg.serve_workers), 1)
@@ -130,6 +171,7 @@ class ServeDaemon:
                 cfg, self.queue, self.router,
                 journal_dir=journal_dir,
                 prediction_root=prediction_root,
+                stream_state_dir=stream_state_dir,
                 warm_scenes=self.warm_scenes,
                 warm_baseline=warm_baseline,
                 freeze_after_warm=freeze_after_warm,
@@ -146,6 +188,7 @@ class ServeDaemon:
                 cfg, self.queue, self.router,
                 journal_dir=journal_dir,
                 prediction_root=prediction_root,
+                stream_state_dir=stream_state_dir,
                 warm_scenes=self.warm_scenes,
                 warm_baseline=warm_baseline,
                 freeze_after_warm=freeze_after_warm,
@@ -154,9 +197,28 @@ class ServeDaemon:
         else:
             self.worker = ServeWorker(cfg, self.queue, self.router,
                                       journal_dir=journal_dir,
-                                      prediction_root=prediction_root)
+                                      prediction_root=prediction_root,
+                                      stream_state_dir=stream_state_dir)
         self._lock = mct_lock("serve.ServeDaemon._lock")
         self._ids = 0
+        # admission WAL (serve/wal.py): armed whenever journaling is on —
+        # journal_dir holds the per-request journals AND the daemon's one
+        # crash-safe admission ledger. The sink opens in start() (after
+        # recovery compacts the predecessor's file)
+        self._wal: Optional[_wal.AdmissionWal] = None
+        self._wal_path = ""
+        if wal and journal_dir:
+            os.makedirs(journal_dir, exist_ok=True)
+            self._wal_path = os.path.join(journal_dir, _wal.WAL_FILENAME)
+        # idem dedupe planes: key -> cached terminal event (answered) and
+        # key -> the live in-flight request (running; re-attach target)
+        self._wal_answered: Dict[str, Dict] = {}
+        self._wal_running: Dict[str, protocol.SceneRequest] = {}
+        self._wal_replayed = 0
+        self._wal_deduped = 0
+        self._wal_reattached = 0
+        self._journals_pruned = 0
+        self._pruner: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._draining = threading.Event()
         # connections outlive the stop flag: in-flight results and the
@@ -220,6 +282,13 @@ class ServeDaemon:
             aot_cache.warm_start(self.cfg)
             self._prewarm()
             self.worker.start()
+        # durability plane: recover the predecessor's admission WAL (seed
+        # the id counter, warm the dedupe cache, replay journaled-but-
+        # unanswered requests into the queue), then the retention pass —
+        # both BEFORE the acceptor so recovery races no live admission
+        self._recover_wal()
+        self._prune_retention()
+        self._start_pruner()
         # install + tick AFTER warm-up, with the delta baseline re-anchored
         # to NOW: windows meter serving, and without the rebase window 0
         # would charge the whole warm-up wall + its counter deltas (AOT
@@ -408,6 +477,12 @@ class ServeDaemon:
             handlers = list(self._handlers)
         for t in handlers:
             t.join(2.0)
+        if self._pruner is not None:
+            self._pruner.join(2.0)  # _stop is set: the wait returns now
+        if self._wal is not None:
+            # after the drain: every terminal (incl. the draining rejects
+            # above, which route through the _WalSend wrappers) is on disk
+            self._wal.close()
         # cooperative-drain black box (the SIGTERM handler itself is
         # flag-only — CONC.SIGNAL): armed runs keep the daemon's final
         # admission/span history next to any worker-crash dumps
@@ -415,6 +490,155 @@ class ServeDaemon:
                        clean=drained_clean)
         _flight.dump("sigterm" if faults.stop_requested() else "shutdown")
         log.info("mct-serve: shutdown complete (%s)", self.stats()["counts"])
+
+    # -- durability (serve/wal.py) ------------------------------------------
+
+    def _recover_wal(self) -> None:
+        """Fold the predecessor daemon's WAL into this one: id-counter
+        seed, idem dedupe cache, and the replay of every journaled-but-
+        unanswered request back into the admission queue (detached — the
+        client re-attaches by resubmitting its idempotency key)."""
+        if not self._wal_path:
+            return
+        state = _wal.read_wal(self._wal_path)
+        with self._lock:
+            self._ids = max(self._ids, state.max_id)
+            self._wal_answered.update(state.answered)
+        if state.stats.torn or state.stats.unknown_version:
+            log.warning("mct-serve: WAL recovery skipped %d torn / %d "
+                        "unknown-version row(s)", state.stats.torn,
+                        state.stats.unknown_version)
+        if state.rows:
+            _wal.compact(self._wal_path, state)
+        self._wal = _wal.AdmissionWal(self._wal_path)
+        submit = getattr(self.worker, "admit", self.queue.submit)
+        replayed = 0
+        for rid, doc, idem in state.pending:
+            try:
+                req = protocol.build_request(dict(doc), rid)
+            except (protocol.ProtocolError, KeyError, TypeError,
+                    ValueError) as e:
+                # a poisoned row must settle, not resurrect every restart
+                self._wal.terminal(rid, protocol.reject(
+                    "bad_request", detail=f"unreplayable WAL row: {e}"),
+                    idem=idem)
+                continue
+            req.send = _WalSend(self, rid, idem, client=None)
+            if idem:
+                with self._lock:
+                    self._wal_running[idem] = req
+            try:
+                submit(req)
+            except (QuotaReject, QueueFullReject) as e:
+                reason = ("queue_full" if isinstance(e, QueueFullReject)
+                          else "quota")
+                self._wal_terminal(rid, idem, protocol.reject(
+                    reason, detail=f"WAL replay re-admission failed: {e}"))
+                continue
+            replayed += 1
+            obs.count("serve.wal.replayed")
+        self._wal_replayed = replayed
+        if replayed:
+            log.warning("mct-serve: replayed %d journaled-but-unanswered "
+                        "request(s) from the admission WAL", replayed)
+
+    def _wal_dispatch(self, rid: str) -> None:
+        wal = self._wal
+        if wal is not None:
+            wal.dispatch(rid)
+
+    def _wal_terminal(self, rid: str, idem: str, event: Dict) -> None:
+        wal = self._wal
+        if wal is not None:
+            wal.terminal(rid, event, idem=idem)
+        if idem:
+            with self._lock:
+                self._wal_answered[idem] = dict(event)
+                self._wal_running.pop(idem, None)
+
+    def _wal_resubmit(self, req: protocol.SceneRequest, send) -> bool:
+        """The idempotency contract: a resubmitted key that already
+        answered replays the cached terminal (stamped ``deduped``); one
+        still running re-attaches THIS connection to its event stream.
+        False = a fresh key, admit normally."""
+        with self._lock:
+            cached = self._wal_answered.get(req.idem)
+            running = self._wal_running.get(req.idem)
+        if cached is not None:
+            self._wal_deduped += 1
+            obs.count("serve.wal.deduped")
+            ev = dict(cached)
+            ev["deduped"] = True
+            if req.tag:
+                ev["tag"] = req.tag
+            with send.lock:
+                send.raw(protocol.ack(req, queue_depth=self.queue.depth()))
+                send.raw(ev)
+            return True
+        if running is not None:
+            wrapper = running.send
+            if isinstance(wrapper, _WalSend):
+                wrapper.client = send
+            self._wal_reattached += 1
+            obs.count("serve.wal.reattached")
+            # the running request may have answered between the lookup
+            # and the re-attach: replay the terminal to this connection
+            # (a racing duplicate line is harmless — the client stops at
+            # its first terminal)
+            with self._lock:
+                cached = self._wal_answered.get(req.idem)
+            with send.lock:
+                send.raw(protocol.ack(running,
+                                      queue_depth=self.queue.depth()))
+                if cached is not None:
+                    ev = dict(cached)
+                    ev["deduped"] = True
+                    send.raw(ev)
+            return True
+        return False
+
+    def _wal_abort(self, req: Optional[protocol.SceneRequest],
+                   event: Dict) -> None:
+        """An admission that WAL-journaled but failed to enqueue (quota /
+        queue_full raised at submit) settles with the reject as its
+        terminal row — replay must not resurrect it."""
+        if req is not None and isinstance(req.send, _WalSend):
+            self._wal_terminal(req.id, req.idem, event)
+
+    def _prune_retention(self) -> None:
+        """Retention pass: settled per-request journals and finished
+        streams' snapshots age out under the serve_journal_keep /
+        serve_journal_max_age_s knobs (the WAL itself is skipped by
+        name; prune_dir's freshness floor protects live state)."""
+        keep = int(self.cfg.serve_journal_keep or 0)
+        age = float(self.cfg.serve_journal_max_age_s or 0.0)
+        removed = 0
+        if self.journal_dir:
+            removed += _wal.prune_dir(self.journal_dir, keep=keep,
+                                      max_age_s=age, suffixes=(".jsonl",))
+        if self.stream_state_dir:
+            removed += _wal.prune_dir(self.stream_state_dir, keep=keep,
+                                      max_age_s=age,
+                                      suffixes=(".stream.npz",))
+        if removed:
+            with self._lock:
+                self._journals_pruned += removed
+            obs.count("serve.journals_pruned", removed)
+            log.info("mct-serve: retention pruned %d journal/snapshot "
+                     "file(s)", removed)
+
+    def _start_pruner(self) -> None:
+        interval = float(self.cfg.serve_prune_interval_s or 0.0)
+        if interval <= 0 or not (self.journal_dir or self.stream_state_dir):
+            return
+
+        def _loop() -> None:
+            while not self._stop.wait(interval):
+                self._prune_retention()
+
+        self._pruner = threading.Thread(  # mct-thread: abandon(daemon-lifetime retention timer, bounded-joined in shutdown(); the spawn/join pair spans methods, which the scope-local check cannot see)
+            target=_loop, daemon=True, name="serve-pruner")
+        self._pruner.start()
 
     # -- socket front -------------------------------------------------------
 
@@ -481,6 +705,7 @@ class ServeDaemon:
         if not line.strip():
             return
         tag = ""
+        req: Optional[protocol.SceneRequest] = None
         try:
             doc = protocol.parse_line(line)
             tag = str(doc.get("tag", ""))
@@ -539,6 +764,21 @@ class ServeDaemon:
                 doc["deadline_s"] = self.default_deadline_s
             req = protocol.build_request(doc, self._next_id())
             req.send = send
+            if self._wal is not None:
+                if req.idem and self._wal_resubmit(req, send):
+                    return  # answered from cache, or re-attached live
+                # crash-safe admission: the admit row hits disk BEFORE
+                # the queue, so a daemon killed between them resurrects
+                # (never loses) the request at the next start()
+                req.send = _WalSend(self, req.id, req.idem, client=send)
+                if req.idem:
+                    with self._lock:
+                        self._wal_running[req.idem] = req
+                self._wal.admit(req.id, doc, idem=req.idem)
+            # the chaos drill's daemon-death seam: a `die` FaultPlan entry
+            # SIGKILLs THIS process here — after the WAL admit, before
+            # the queue — the worst torn state recovery must survive
+            faults.inject("admission", req.scene)
             # submit + ack under the connection's send lock: the worker's
             # first event for this request serializes AFTER the ack. A
             # pool worker gates admission through its tenant quotas
@@ -553,10 +793,12 @@ class ServeDaemon:
             return
         except QuotaReject as e:
             telemetry.record_reject(str(doc.get("tenant", "")))
-            send(protocol.reject(
+            ev = protocol.reject(
                 "quota", tag=tag,
                 detail=f"tenant {e.tenant!r} at its queued-request quota "
-                       f"({e.queued}/{e.limit}); retry after completions"))
+                       f"({e.queued}/{e.limit}); retry after completions")
+            self._wal_abort(req, ev)
+            send(ev)
         except QueueFullReject as e:
             telemetry.record_reject(str(doc.get("tenant", "")))
             if not self._capacity_dumped.is_set():
@@ -565,9 +807,11 @@ class ServeDaemon:
                 # queue_full rejects are ordinary backpressure, not news)
                 self._capacity_dumped.set()
                 _flight.dump("capacity")
-            send(protocol.reject(
+            ev = protocol.reject(
                 "queue_full", tag=tag,
-                detail=f"{e.depth}/{e.capacity} queued; retry with backoff"))
+                detail=f"{e.depth}/{e.capacity} queued; retry with backoff")
+            self._wal_abort(req, ev)
+            send(ev)
 
     # -- introspection ------------------------------------------------------
 
@@ -607,6 +851,14 @@ class ServeDaemon:
                         "drift_total": self.sentinel.stats()["drift_total"]}
                        if self.sentinel is not None else None),
             "draining": self._draining.is_set(),
+            # the durability plane (serve/wal.py): WAL replay/dedupe and
+            # retention evidence — the chaos drill's verdict reads these
+            "durable": {"wal": self._wal is not None
+                        or bool(self._wal_path),
+                        "wal_replayed": self._wal_replayed,
+                        "wal_deduped": self._wal_deduped,
+                        "wal_reattached": self._wal_reattached,
+                        "journals_pruned": self._journals_pruned},
             # the packing scheduler's occupancy digest (in-thread worker
             # only; under --isolate-worker the CHILD packs and its
             # serve.batch.* counters relay up via telemetry instead)

@@ -32,10 +32,14 @@ Crash containment composes rather than changes: a slice crash requeues
 its victims through ``_FeedQueue.requeue`` back into the POOL, which
 reroutes them to a bucket-warm NEIGHBOR (warm respawn still happens,
 but the victim does not wait for it). Stream sessions are slice-local,
-so stream ops pin to their owner slice (``_stream_owner``); a crash
-answers the supervisor's typed ``stream_lost``. ``recarve`` drains every
-slice and respawns under a new carve while admission keeps queueing —
-the shared AOT cache makes the new slices warm.
+so stream ops pin to their owner slice (``_stream_owner``) — but when a
+shared ``stream_state_dir`` holds a per-chunk snapshot (the worker ships
+them on the ``stream_journal_every`` cadence), a stream whose owner died
+re-opens on a SURVIVING warm slice from the snapshot instead of
+answering the typed ``stream_lost`` (which remains the fallback when no
+snapshot exists). ``recarve`` drains every slice and respawns under a
+new carve while admission keeps queueing — the shared AOT cache makes
+the new slices warm, and snapshotted streams migrate the same way.
 
 The pool exposes the ServeWorker/WorkerSupervisor surface (start/stop/
 wait_idle/stats/latency_quantiles/run_canary/child_retrace/busy) so
@@ -120,6 +124,7 @@ class WorkerPool:
     def __init__(self, cfg, queue: AdmissionQueue, router: Router, *,
                  journal_dir: Optional[str] = None,
                  prediction_root: Optional[str] = None,
+                 stream_state_dir: Optional[str] = None,
                  warm_scenes: Tuple[str, ...] = (),
                  warm_baseline: Optional[str] = None,
                  freeze_after_warm: bool = True,
@@ -133,6 +138,7 @@ class WorkerPool:
         self.router = router
         self.journal_dir = journal_dir
         self.prediction_root = prediction_root
+        self.stream_state_dir = stream_state_dir
         self.warm_scenes = tuple(warm_scenes)
         self.warm_baseline = warm_baseline
         self.freeze_after_warm = freeze_after_warm
@@ -180,7 +186,8 @@ class WorkerPool:
         # recarve retires whole slices: their request/crash history folds
         # into these baselines so the daemon's counts survive the carve
         self._retired_counts: Dict[str, int] = {}
-        self._retired_worker = {"spawns": 0, "respawns": 0, "crashes": 0}
+        self._retired_worker = {"spawns": 0, "respawns": 0, "crashes": 0,
+                                "streams_resumed": 0}
         self._retired_latencies: List[float] = []
 
     # -- carve plumbing ------------------------------------------------------
@@ -232,6 +239,7 @@ class WorkerPool:
                 self.cfg, self._feeds[i], self.router,
                 journal_dir=self.journal_dir,
                 prediction_root=self.prediction_root,
+                stream_state_dir=self.stream_state_dir,
                 warm_scenes=self.warm_scenes,
                 warm_baseline=self.warm_baseline,
                 freeze_after_warm=self.freeze_after_warm,
@@ -444,6 +452,17 @@ class WorkerPool:
             if owner is not None:
                 with self._lock:
                     owner_dead = owner in self._dead
+                if (owner_dead or owner == exclude) \
+                        and self._stream_resumable(req.scene):
+                    # snapshot failover: the owner slice died (retired,
+                    # or is the crashed slice this reroute excludes) but
+                    # a per-chunk snapshot exists — re-open the session
+                    # on a surviving warm slice; its child resumes the
+                    # accumulator from disk (_book_dispatch re-pins)
+                    room = [i for i in alive if self._has_room(i)]
+                    if not room:
+                        return ("no_room", None)
+                    return ("dispatch", min(room, key=self._load))
                 if owner_dead:
                     return ("lost", owner)
                 if self._has_room(owner):
@@ -466,6 +485,18 @@ class WorkerPool:
 
     def _has_room(self, i: int) -> bool:
         return self._feeds[i].depth() < self._feeds[i].capacity
+
+    def _stream_resumable(self, scene: str) -> bool:
+        """A per-chunk snapshot exists for this scene's stream: the
+        session can re-open on another slice from disk."""
+        if not self.stream_state_dir:
+            return False
+        from maskclustering_tpu.models.streaming import stream_state_path
+        try:
+            return os.path.exists(
+                stream_state_path(self.stream_state_dir, scene))
+        except OSError:
+            return False
 
     def _try_dispatch(self, req: protocol.SceneRequest) -> str:
         verdict, wid = self._route(req)
@@ -567,7 +598,7 @@ class WorkerPool:
                   "exhausted); %d/%d slices remain", worker_id,
                   len(self._sups) - dead, len(self._sups))
         for req in self._feeds[worker_id].drain():
-            if req.op in STREAM_OPS:
+            if req.op in STREAM_OPS and not self._stream_resumable(req.scene):
                 self._answer_retired_stream(req, worker_id)
             elif not self.queue.requeue(req):
                 obs.count("serve.requests_failed")
@@ -629,7 +660,10 @@ class WorkerPool:
             self._start_slices()
             with self._lock:
                 self._recarves += 1
-                self._stream_owner.clear()  # sessions died with the old
+                # sessions died with the old slices; an owner-less stream
+                # op routes as a new stream and the fresh child resumes
+                # from its per-chunk snapshot when one exists
+                self._stream_owner.clear()
         finally:
             self._pause.clear()
         obs.count("serve.pool.recarves")
@@ -744,6 +778,9 @@ class WorkerPool:
                        + sum(p["worker"]["respawns"] for p in per),
                        "crashes": self._retired_worker["crashes"]
                        + sum(p["worker"]["crashes"] for p in per),
+                       "streams_resumed":
+                       self._retired_worker["streams_resumed"]
+                       + sum(p["worker"]["streams_resumed"] for p in per),
                        "inflight_width": sum(p["worker"]["inflight_width"]
                                              for p in per)},
             "pool": {

@@ -70,8 +70,21 @@ respawned worker would reopen the stream at chunk 0 and corrupt it).
 In-flight and queued stream ops for the lost scene answer a ``status``
 with ``state: "stream_lost"`` then a ``failed`` result with
 ``error_class: "stream_lost"``; the session is dropped so the client can
-restart the stream from its own source. (The ROADMAP-named worker-side
-stream-session journaling seam will turn this into a resume later.)
+restart the stream from its own source. When the daemon runs with a
+shared ``stream_state/`` directory (serve/wal.py durability plane), a
+per-chunk accumulator snapshot usually exists and the stream instead
+RESUMES on the respawned worker (or a surviving pool slice): the client
+sees a ``worker_crash`` status with ``requeued: true`` and the chunk
+answers ``ok`` as if nothing died — ``stream_lost`` remains the typed
+terminal fallback when no snapshot exists or the resumed replay exhausts
+``MAX_REQUEST_CRASHES``.
+
+``idem`` (optional, scene-naming ops): a client-chosen idempotency key.
+The daemon journals it in the admission WAL; a reconnect-and-resubmit
+with the same key dedupes instead of re-running — an already-answered
+key replays the cached terminal event (stamped ``deduped: true``), an
+in-flight key re-attaches the new connection to the live request's
+status stream.
 
 The same shapes ride the supervisor<->worker pipe (see
 ``forward_request``), plus three pipe-only kinds: ``hb`` (heartbeat),
@@ -98,6 +111,10 @@ PROTOCOL_VERSION = 1
 # labels in obs.top — bound their length so a hostile client cannot bloat
 # every window row
 TENANT_MAX_LEN = 64
+
+# idempotency keys are dict keys in the daemon's dedupe map and ride WAL
+# rows verbatim — same bounded-identity rule as tenants
+IDEM_MAX_LEN = 128
 
 OPS = ("scene", "stream_chunk", "stream_end", "status", "shutdown",
        "recarve")
@@ -138,6 +155,7 @@ class SceneRequest:
     resume: bool = False
     tag: str = ""
     tenant: str = ""  # optional accounting identity ("" = untenanted)
+    idem: str = ""  # optional idempotency key ("" = no dedupe contract)
     admitted_at: float = 0.0       # time.monotonic() at admission
     deadline_at: float = math.inf  # monotonic deadline (inf = none)
     # how many device workers this request has crashed (the isolated
@@ -218,6 +236,16 @@ def parse_line(line: str) -> Dict:
             if os_sep_like(tenant):
                 raise ProtocolError(f"tenant {tenant!r} must not contain "
                                     "path separators")
+        if "idem" in doc:
+            idem = doc["idem"]
+            if not isinstance(idem, str) or not idem:
+                raise ProtocolError("'idem' must be a non-empty string")
+            if len(idem) > IDEM_MAX_LEN:
+                raise ProtocolError(f"'idem' longer than {IDEM_MAX_LEN} "
+                                    "chars")
+            if os_sep_like(idem):
+                raise ProtocolError(f"idem key {idem!r} must not contain "
+                                    "path separators")
         if "crashes" in doc:
             # supervisor-internal (the pipe carries it via forward_request,
             # which bypasses parse_line): a client must not pre-degrade its
@@ -245,6 +273,7 @@ def build_request(doc: Dict, request_id: str) -> SceneRequest:
         resume=bool(doc.get("resume", False)),
         tag=str(doc.get("tag", "")),
         tenant=str(doc.get("tenant", "")),
+        idem=str(doc.get("idem", "")),
         admitted_at=now,
         deadline_at=(now + deadline) if deadline > 0 else math.inf,
         crashes=int(doc.get("crashes", 0) or 0),

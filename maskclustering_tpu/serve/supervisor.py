@@ -88,6 +88,7 @@ class WorkerSupervisor:
     def __init__(self, cfg, queue: AdmissionQueue, router: Router, *,
                  journal_dir: Optional[str] = None,
                  prediction_root: Optional[str] = None,
+                 stream_state_dir: Optional[str] = None,
                  warm_scenes: Tuple[str, ...] = (),
                  warm_baseline: Optional[str] = None,
                  freeze_after_warm: bool = True,
@@ -113,6 +114,12 @@ class WorkerSupervisor:
         self.child_env = dict(child_env) if child_env else None
         self.journal_dir = journal_dir
         self.prediction_root = prediction_root
+        # shared snapshot directory for stream failover: the child ships
+        # per-chunk accumulator snapshots here (models/streaming
+        # save_state, stream_journal_every cadence), and a crashed
+        # stream requeues onto the next child instead of answering
+        # stream_lost whenever a snapshot exists to resume from
+        self.stream_state_dir = stream_state_dir
         self.warm_scenes = tuple(warm_scenes)
         self.warm_baseline = warm_baseline
         self.freeze_after_warm = freeze_after_warm
@@ -178,6 +185,9 @@ class WorkerSupervisor:
         # restart the stream from its own source.
         self._open_streams: set = set()
         self._lost_streams: set = set()
+        # failover bookkeeping: streams this supervisor requeued onto a
+        # fresh child from a snapshot instead of answering stream_lost
+        self._streams_resumed = 0
         self._cfg_path = self._write_cfg()
 
     # -- child plumbing ------------------------------------------------------
@@ -204,6 +214,8 @@ class WorkerSupervisor:
             cmd += ["--journal-dir", self.journal_dir]
         if self.prediction_root:
             cmd += ["--prediction-root", self.prediction_root]
+        if self.stream_state_dir:
+            cmd += ["--stream-state", self.stream_state_dir]
         if self.warm_scenes:
             cmd += ["--warm", "+".join(self.warm_scenes)]
         if self.warm_baseline:
@@ -572,17 +584,38 @@ class WorkerSupervisor:
             with self._lock:
                 lost = req.scene in self._lost_streams
                 self._lost_streams.discard(req.scene)
+            if lost and self._stream_resumable(req.scene):
+                # the session died with a worker but the child shipped a
+                # snapshot: forward normally — the respawned child's
+                # _open_stream resumes the accumulator from it
+                with self._lock:
+                    self._streams_resumed += 1
+                return True
             if lost:
-                # the session this op was continuing died with a worker;
-                # answer typed, clear the mark so a restarted stream
-                # (fresh chunk 1) serves normally. serve.requests books
-                # parent-side: the child never sees this op
+                # the session this op was continuing died with a worker
+                # and no snapshot exists to resume from; answer typed,
+                # clear the mark so a restarted stream (fresh chunk 1)
+                # serves normally. serve.requests books parent-side: the
+                # child never sees this op
                 obs.count("serve.requests")
                 self._answer_stream_lost(
                     req, "stream session lost to a worker crash before "
                          "this op dispatched")
                 return False
         return True
+
+    def _stream_resumable(self, scene: str) -> bool:
+        """A snapshot exists for this scene's stream: the crashed session
+        can re-open on a fresh (or surviving pool) child from disk instead
+        of answering the typed stream_lost fallback."""
+        if not self.stream_state_dir:
+            return False
+        from maskclustering_tpu.models.streaming import stream_state_path
+        try:
+            return os.path.exists(
+                stream_state_path(self.stream_state_dir, scene))
+        except OSError:
+            return False
 
     def _answer_stream_lost(self, req: protocol.SceneRequest,
                             detail: str) -> None:
@@ -742,17 +775,37 @@ class WorkerSupervisor:
         telemetry.record_crash(req.tenant)
         req.crashes += 1
         err = faults.WorkerCrashError(req.scene, detail)
-        self._journal_crash(req, err)
         if req.op in ("stream_chunk", "stream_end"):
-            # a stream op NEVER requeues across a crash: its session's
-            # accumulator state died with the child, and frames-per-chunk
-            # wire semantics mean a respawned child would silently reopen
-            # the stream at chunk 0 — typed loss instead (satellite 1;
-            # the journaling/resume seam lands in a later PR)
+            resumable = self._stream_resumable(req.scene)
             with self._lock:
                 self._lost_streams.discard(req.scene)
+            if resumable and req.crashes < MAX_REQUEST_CRASHES \
+                    and not self._stop.is_set():
+                # the session's device accumulator died with the child,
+                # but the child shipped per-chunk snapshots: requeue the
+                # op — the next child's _open_stream resumes from disk
+                # (coordinate-checked load_state) and the already-pushed
+                # replay chunk dedupes worker-side. Failover is stamped
+                # on the journal (stream_resumed) and the worker_crash
+                # status carries resuming=True for the obs.trace timeline.
+                req.admitted_at = time.monotonic()
+                if self.queue.requeue(req):
+                    self._journal_crash(req, err,
+                                        error_class="stream_resumed")
+                    with self._lock:
+                        self._streams_resumed += 1
+                    obs.count("serve.requests_requeued")
+                    _send(req, protocol.status(
+                        req, "worker_crash", requeued=True, resuming=True,
+                        crashes=req.crashes, detail=detail))
+                    return
+            # no snapshot (or retries exhausted / draining): typed loss —
+            # frames-per-chunk wire semantics mean a respawned child
+            # would silently reopen the stream at chunk 0
+            self._journal_crash(req, err)
             self._answer_stream_lost(req, detail)
             return
+        self._journal_crash(req, err)
         # re-admission stamp: the SECOND queue-wait segment measures from
         # the requeue, not the original ack (the first attempt's wall is
         # its own trace segment, not queue time); deadline_at is absolute
@@ -786,11 +839,13 @@ class WorkerSupervisor:
                           "pid": child_pid, "doc": telem})
         _flight.dump("worker_crash", extra_rows=extra)
 
-    def _journal_crash(self, req: protocol.SceneRequest,
-                       err: Exception) -> None:
+    def _journal_crash(self, req: protocol.SceneRequest, err: Exception,
+                       error_class: str = "device") -> None:
         """Crash-stamp the request's journal: an ``interrupted`` outcome
         row next to the child's orphaned attempt row, so replay shows
-        exactly which attempt the worker died under."""
+        exactly which attempt the worker died under. ``stream_resumed``
+        stamps a stream failover (requeued onto a fresh child from a
+        snapshot) instead of plain device loss."""
         if not self.journal_dir:
             return
         try:
@@ -798,7 +853,7 @@ class WorkerSupervisor:
             j = faults.RunJournal(path, self.cfg.config_name,
                                   request_id=req.id)
             j.outcome(req.scene, "interrupted", attempt=req.crashes,
-                      error_class="device", error=str(err))
+                      error_class=error_class, error=str(err))
             j.close()
         except Exception:  # noqa: BLE001 — attribution must not sink recovery
             log.exception("worker supervisor: crash journal row failed")
@@ -888,6 +943,14 @@ class WorkerSupervisor:
             inflight_width = len(inflight)
             inflight_crashes = max((e["req"].crashes for e in inflight),
                                    default=0)
+            # deterministic drill evidence: how many in-flight requests
+            # the child has ACKNOWLEDGED via its relayed flight ring — a
+            # kill drill waits for this instead of sleeping (load_gen)
+            flight_ids = {row.get("request") for row in self._child_flight
+                          if row.get("kind") == _flight.KIND_REQUEST}
+            inflight_ids = [e["req"].id for e in inflight]
+            streams_resumed = self._streams_resumed
+        inflight_logged = sum(1 for r in inflight_ids if r in flight_ids)
         child = self._child
         alive = child is not None and child.poll() is None
         return {"counts": counts,
@@ -900,6 +963,8 @@ class WorkerSupervisor:
                            "worker_id": self.worker_id,
                            "open_streams": len(self._open_streams),
                            "lost_streams": len(self._lost_streams),
+                           "streams_resumed": streams_resumed,
+                           "inflight_logged": inflight_logged,
                            "spawns": self.spawns,
                            "respawns": self.respawns,
                            "consecutive_respawns": self.consecutive_respawns,

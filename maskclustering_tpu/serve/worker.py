@@ -116,11 +116,20 @@ class ServeWorker:
     def __init__(self, cfg, queue: AdmissionQueue, router: Router, *,
                  journal_dir: Optional[str] = None,
                  prediction_root: Optional[str] = None,
+                 stream_state_dir: Optional[str] = None,
                  poll_s: float = 0.25):
         self.cfg = cfg
         self.queue = queue
         self.router = router
         self.journal_dir = journal_dir
+        # shared per-chunk accumulator snapshot directory (stream-session
+        # failover): every accumulated chunk lands an atomic snapshot here
+        # on the stream_journal_every cadence, and _open_stream resumes
+        # from it — so a stream survives its worker's death (a surviving
+        # pool slice or the respawned worker re-opens mid-scan) instead
+        # of answering the typed stream_lost. None = sessions are
+        # process-lifetime only (the pre-durability contract)
+        self.stream_state_dir = stream_state_dir
         self.prediction_root = (prediction_root
                                 or os.path.join(cfg.data_root, "prediction"))
         self.poll_s = poll_s
@@ -680,6 +689,16 @@ class ServeWorker:
             num_points=tensors.num_points,
             k_max=bucket_k_max(max_seg_id(tensors.segmentations)),
             seq_name=req.scene)
+        state_path = self._stream_state_path(req.scene)
+        if state_path and acc.load_state(state_path):
+            # a previous worker's snapshot exists and its coordinates
+            # match: resume mid-scan instead of restarting at chunk 0 —
+            # the failover contract (the cursor self-derives from the
+            # restored chunks_done)
+            obs.count("serve.streams_resumed")
+            log.warning("serve: stream %r resumed from snapshot at chunk "
+                        "%d (%d/%d frames)", req.scene, acc.chunks_done,
+                        acc.frames_done, acc.total_frames)
         while len(self._streams) >= self.max_stream_sessions:
             victim = min(self._streams, key=lambda s:
                          self._streams[s].last_used)
@@ -689,6 +708,15 @@ class ServeWorker:
             obs.count("serve.streams_evicted")
             del self._streams[victim]
         return _StreamSession(tensors, acc)
+
+    def _stream_state_path(self, scene: str) -> Optional[str]:
+        """The scene's shared snapshot path (None = failover disarmed)."""
+        if not self.stream_state_dir:
+            return None
+        from maskclustering_tpu.models.streaming import stream_state_path
+
+        os.makedirs(self.stream_state_dir, exist_ok=True)
+        return stream_state_path(self.stream_state_dir, scene)
 
     def _serve_stream(self, req: protocol.SceneRequest) -> None:
         """One stream op: accumulate the scene's next chunk, or finalize.
@@ -728,6 +756,14 @@ class ServeWorker:
                 # failed export/finalize keeps the accumulated stream so
                 # the client can simply resend stream_end
                 self._streams.pop(req.scene, None)
+                state_path = self._stream_state_path(req.scene)
+                if state_path and os.path.exists(state_path):
+                    # the stream is settled — its snapshot must not
+                    # resurrect a finished scan on the next open
+                    try:
+                        os.remove(state_path)
+                    except OSError:
+                        pass
                 fields = {"num_objects": len(result.objects.point_ids_list),
                           "frames": sess.acc.frames_done,
                           "chunks": sess.acc.chunks_done}
@@ -741,9 +777,28 @@ class ServeWorker:
                 sess.last_used = time.monotonic()
                 acc = sess.acc
                 if sess.done:
+                    if req.crashes:
+                        # crash-requeued chunk whose push was already
+                        # absorbed before the worker died (the snapshot
+                        # includes it): answer the anytime fields instead
+                        # of double-pushing or failing the replay
+                        obs.count("serve.stream_chunks_rerun")
+                        fields = {"chunk": max(acc.chunks_done - 1, 0),
+                                  "frames_done": acc.frames_done,
+                                  "total_frames": acc.total_frames,
+                                  "partial_instances": acc.partial_instances,
+                                  "done": True}
+                        self._finish_request(
+                            req, "ok", time.monotonic() - t0,
+                            op=req.op, **fields)
+                        return
                     raise ValueError(
                         f"stream {req.scene!r} already consumed all "
                         f"{acc.total_frames} frames (send stream_end)")
+                if req.crashes:
+                    # the chunk in flight when the previous worker died,
+                    # replayed against the resumed accumulator
+                    obs.count("serve.stream_chunks_rerun")
                 _send(req, protocol.status(
                     req, "running", scene=req.scene,
                     stream="chunk", chunk_index=acc.chunks_done))
@@ -760,6 +815,16 @@ class ServeWorker:
                             slice_scene_frames(sess.tensors, start, stop)),
                         self._deadline_cfg(req).watchdog_device_s,
                         seam="device", scene=req.scene)
+                state_path = self._stream_state_path(req.scene)
+                if state_path and self.cfg.stream_journal_every > 0 and (
+                        digest["done"] or acc.chunks_done
+                        % self.cfg.stream_journal_every == 0):
+                    # ship the accumulator snapshot to the SHARED state
+                    # dir (atomic tmp+rename in save_state): the failover
+                    # plane a surviving slice resumes from. The final
+                    # chunk always snapshots — stream_end is a separate
+                    # request and the worker may die in between
+                    acc.save_state(state_path)
                 # the per-chunk anytime signal: partial-instance count on
                 # a status event BEFORE the terminal result (live
                 # dashboards and the client's streaming helper read it)

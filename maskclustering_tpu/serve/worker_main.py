@@ -70,6 +70,12 @@ def main(argv=None) -> int:
                              "no re-derivation drift")
     parser.add_argument("--journal-dir", default=None)
     parser.add_argument("--prediction-root", default=None)
+    parser.add_argument("--stream-state", default=None,
+                        help="SHARED per-chunk stream snapshot directory "
+                             "(stream-session failover): every accumulated "
+                             "chunk lands an atomic accumulator snapshot "
+                             "here, and a respawned/neighbor worker resumes "
+                             "the stream from it instead of stream_lost")
     parser.add_argument("--warm", default=None,
                         help="+-joined scene names to run end-to-end before "
                              "answering ready")
@@ -231,7 +237,8 @@ def main(argv=None) -> int:
         metered=False)
     worker = ServeWorker(cfg, queue, router,
                          journal_dir=args.journal_dir,
-                         prediction_root=args.prediction_root)
+                         prediction_root=args.prediction_root,
+                         stream_state_dir=args.stream_state)
 
     # warm-up mirrors the daemon's _prewarm: drills are suspended so they
     # land on the serving path, then (armed runs) the sanitizer freezes —

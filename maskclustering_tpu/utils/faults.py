@@ -61,7 +61,11 @@ log = logging.getLogger("maskclustering_tpu")
 # ladder's host-postprocess rung — and "chunk" fires at the top of every
 # streaming accumulation chunk, the seam whose faults retry the CHUNK
 # (accumulator intact), not the scene
-SEAMS = ("load", "device", "host", "export", "pull", "post", "chunk")
+# "admission" fires in the DAEMON process at the head of request
+# admission (serve/daemon.py) — the parent-side seam the daemon-death
+# drills script (the "die" kind) without shelling out a kill
+SEAMS = ("load", "device", "host", "export", "pull", "post", "chunk",
+         "admission")
 
 # error_class vocabulary stamped on SceneStatus / journal rows:
 #   retryable — transient by default (IO, unknown runtime errors)
@@ -463,6 +467,13 @@ _KIND_DEFAULTS = {
     # seam UNBOUNDED, so only the supervisor's SIGKILL ends it
     "crash": ("device", 1),
     "wedge": ("device", 1),
+    # daemon-death drill (serve/daemon.py, scripts/load_gen.py
+    # --chaos-drill): "die" SIGKILLs the process executing the seam,
+    # exactly like "crash", but defaults to the PARENT-side admission
+    # seam — arming it in the daemon scripts whole-daemon death
+    # deterministically (WAL replay territory), where "crash" at a
+    # worker seam kills only the contained subprocess
+    "die": ("admission", 1),
     # silent-data-corruption drill (obs/digest.py, obs/canary.py):
     # "corrupt" deterministically bit-flips a pulled claim/graph stat at
     # the seam INSTEAD of raising — the retry policy and degradation
@@ -490,6 +501,7 @@ class FaultPlan:
         crash:scene7.device   # one real SIGKILL to the executing process
         wedge:scene8.device   # heartbeat-silent unbounded hang (SIGKILL cures)
         corrupt:scene9.host   # silent bit-flip of a pulled stat (digest drift)
+        die:sceneA.admission  # one real SIGKILL of the DAEMON at admission
 
     ``stall`` sleeps ``stall_s`` at the seam — under an armed watchdog the
     caller sees ``DeviceStallError`` within its budget; without one the
@@ -565,12 +577,14 @@ class FaultPlan:
                 time.sleep(self.stall_s)
             elif e.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
-            elif e.kind == "crash":
-                # the hard-failure drill: SIGKILL the process executing
+            elif e.kind in ("crash", "die"):
+                # the hard-failure drills: SIGKILL the process executing
                 # this seam (no handler, no cleanup — the observed XLA
-                # segfault/OOM-kill class). Under the isolated serving
-                # worker this kills the SUBPROCESS; the supervisor
-                # respawns and requeues.
+                # segfault/OOM-kill class). "crash" under the isolated
+                # serving worker kills the SUBPROCESS (the supervisor
+                # respawns and requeues); "die" at the admission seam
+                # kills the DAEMON itself (WAL replay recovers on the
+                # next start).
                 os.kill(os.getpid(), signal.SIGKILL)
             elif e.kind == "wedge":
                 hook = wedge_hook()

@@ -125,6 +125,24 @@
 #    FATAL. The scheduler/carve unit matrix lives in
 #    tests/test_serve_pool.py.
 #
+# 3j. runs the durability chaos drill (distinct exit code 13): three
+#    daemon generations over ONE shared admission WAL + AOT cache +
+#    stream_state directory. Generation 1 SIGKILLs a pool child with a
+#    live-scan session open — the session must RE-OPEN from its
+#    per-chunk snapshot on a warm slice (serve.streams_resumed) and
+#    finish, not answer stream_lost. Generation 2 dies by a scripted
+#    die:*.admission FaultPlan SIGKILL of the WHOLE daemon between the
+#    WAL admit row and the queue — the worst torn state — while
+#    idempotency-keyed requests are mid-flight. Generation 3 restarts
+#    over the same journal dir: the WAL replays every journaled-but-
+#    unanswered request, resubmits of ALL keys answer ok (cached
+#    terminal stamped `deduped`, live re-attach, or fresh run), the
+#    stream re-runs end to end, artifact CRCs are byte-identical to
+#    the pre-death baseline, and the restarted daemon books ZERO
+#    compiles (shared AOT cache -> warm restart) — the durability
+#    contract, end to end (MCT_CHAOS_DRILL=0 skips). FATAL. The WAL /
+#    failover unit matrix lives in tests/test_durable.py.
+#
 # BASELINE defaults to BENCH_builder_r05.json (the newest committed bench
 # verdict with a numeric headline; any JSON doc with a `value` or a ledger
 # JSONL works). LEDGER defaults to PERF_LEDGER.jsonl / $MCT_PERF_LEDGER.
@@ -133,10 +151,11 @@
 # (5), a retrace-family finding (6), a serve-smoke failure (7), a
 # crash-respawn smoke failure (8), a streaming-smoke failure (9), a
 # canary-drill failure (10), a pack-drill failure (11), a pool-drill
-# failure (12), or a perf regression (2), so it gates correctness, fault
-# tolerance, the invariants, thread safety, the compile surface, the
-# serving layer, crash containment, the streaming contract, correctness
-# observability, the packing scheduler, multi-worker serving AND the
+# failure (12), a chaos-drill failure (13), or a perf regression (2), so
+# it gates correctness, fault tolerance, the invariants, thread safety,
+# the compile surface, the serving layer, crash containment, the
+# streaming contract, correctness observability, the packing scheduler,
+# multi-worker serving, durability across process death AND the
 # trajectory.
 # Every gate still RUNS after a failure, but the exit code is the FIRST
 # failing gate's — triage by exit code points at the right gate.
@@ -322,6 +341,26 @@ if [ "${MCT_POOL_DRILL:-1}" != "0" ]; then
              "quota broke, the crash leaked past its slice, or a worker" \
              "recompiled post-warm)" >&2
         fail 12
+    fi
+fi
+
+if [ "${MCT_CHAOS_DRILL:-1}" != "0" ]; then
+    echo "== ci: durability chaos drill (killed worker mid-stream + killed daemon mid-queue, <600s) =="
+    # the durability gate: a SIGKILLed pool child must NOT lose its open
+    # live-scan session (snapshot failover, serve.streams_resumed >= 1),
+    # a SIGKILLed daemon must NOT lose its admitted queue (WAL replay on
+    # restart), idempotent resubmits of every key must answer ok
+    # (deduped / re-attached / fresh), artifacts must stay byte-identical
+    # across both deaths, and the restarted daemon must book ZERO
+    # compiles off the shared AOT cache — eventual completion through
+    # process death, end to end
+    if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+            python scripts/load_gen.py --chaos-drill --no-ledger; then
+        echo "ci: chaos drill FAILED (a killed worker lost its stream, a" \
+             "killed daemon lost journaled requests, a resubmit did not" \
+             "dedupe, artifacts diverged across the death, or the warm" \
+             "restart recompiled)" >&2
+        fail 13
     fi
 fi
 

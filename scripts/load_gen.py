@@ -1181,12 +1181,14 @@ def run_pool_drill(args) -> int:
                 pool_now, _ = _pool_sched(sock)
                 w0 = next((w for w in pool_now.get("workers") or []
                            if w.get("worker_id") == 0), {})
-                if w0.get("inflight"):
-                    # let the child's receive-time flight delta reach the
-                    # parent before the kill (the black-box assertion needs
-                    # the victim's child-side rows; a request runs seconds,
-                    # so this still lands mid-flight)
-                    time.sleep(0.5)
+                if w0.get("inflight") and w0.get("inflight_logged"):
+                    # the victim's receive-time flight delta has reached
+                    # the parent — `inflight_logged` counts exactly the
+                    # in-flight ids the child's relayed ring acknowledged
+                    # — so the black-box assertion's child-side rows are
+                    # on the parent and the kill can land NOW (an explicit
+                    # gate where a fixed post-inflight sleep raced the
+                    # relay)
                     os.kill(int(victim_pid), signal.SIGKILL)
                     killed = True
                     log(f"pool-drill: SIGKILLed worker 0 child "
@@ -1308,6 +1310,382 @@ def run_pool_drill(args) -> int:
         f"({verdict['worker_crashes']} crash / {verdict['worker_respawns']} "
         f"respawn), {overlaps} cross-worker span overlap(s), zero "
         f"post-warm compiles on every slice")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# mct-durable: the chaos drill — a killed worker mid-stream, a killed
+# daemon mid-queue, and a byte-identical warm recovery through the WAL
+# ---------------------------------------------------------------------------
+
+# the streamed scene rides bucket A's shapes (same executables, so the
+# classic warm vocabulary covers it) with its own content seed: stream-
+# path artifacts land under their own scene directory and never collide
+# with classic-path bytes on disk, so CRCs compare stream-to-stream and
+# classic-to-classic across daemon generations
+CHAOS_STREAM_SPEC: Tuple[str, Dict] = (
+    "lg-s", {"num_boxes": 3, "num_frames": 10, "image_hw": [60, 80],
+             "spacing": 0.06, "seed": 41})
+CHAOS_IDEM_KEYS = 6
+
+
+def _chaos_daemon(tmp: str, sock: str, *, events: str, retrace: bool,
+                  fault_plan: Optional[str], workers: int,
+                  warm_names: List[str]):
+    """One chaos-drill daemon generation over the SHARED tmp state
+    (data_root, AOT cache, journal dir + WAL, stream_state): only the
+    socket and events file are per-generation. ``retrace=False`` is the
+    cold capture pass (the stream path pays its compiles once, into the
+    shared caches); armed generations must book zero."""
+    cmd = [sys.executable, "-m", "maskclustering_tpu.serve",
+           "--config", "scannet", "--socket", sock, "--data_root", tmp,
+           "--capacity", "64",
+           "--aot-cache", os.path.join(tmp, "aot"),
+           "--obs_events", events, "--warm", "+".join(warm_names),
+           "--telemetry-window", "1.0",
+           "--flight-dir", os.path.join(tmp, "flight"),
+           "--journal-dir", os.path.join(tmp, "journals"),
+           "--stream-state", os.path.join(tmp, "stream_state"),
+           "--isolate-worker", "--workers", str(workers),
+           "--carve", f"{workers}x1",
+           "--set", "worker_heartbeat_s=30"]
+    if retrace:
+        cmd.insert(cmd.index("--capacity"), "--retrace-sanitizer")
+    if fault_plan:
+        cmd += ["--fault-plan", fault_plan]
+    for kv in SMOKE_CONFIG_SETS:
+        cmd += ["--set", kv]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    log(f"chaos-drill: starting daemon: {' '.join(cmd)}")
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE, cwd=REPO_ROOT,
+                            env=env, text=True)
+
+
+def _chaos_counter(sock: str, name: str, want: int,
+                   timeout_s: float = 30.0) -> int:
+    """Poll the cumulative telemetry counter ``name`` until >= want (the
+    child books it; the cross-process relay delivers it on its own
+    cadence, so a single immediate read would race)."""
+    from maskclustering_tpu.serve.client import ServeClient
+
+    seen = 0
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with ServeClient(sock, timeout_s=30.0) as client:
+                tel = client.telemetry().get("telemetry") or {}
+            counters = (tel.get("cumulative") or {}).get("counters") or {}
+            seen = int(counters.get(name, 0))
+        except OSError:
+            pass
+        if seen >= want:
+            return seen
+        time.sleep(0.25)
+    return seen
+
+
+def run_chaos_drill(args) -> int:
+    """The mct-durable CI gate (exit 0 pass / 1 fail), three phases over
+    ONE shared data_root + AOT cache + admission WAL + stream_state:
+
+    1. cold capture + worker death mid-stream — a 2x1 pool serves a
+       classic burst, then a live-scan stream; the stream owner's child
+       is SIGKILLed with the session open. The session must RE-OPEN from
+       its per-chunk snapshot (``serve.streams_resumed``) instead of
+       answering ``stream_lost``, and the whole stream finishes ok.
+    2. daemon death mid-queue — a fresh daemon under a scripted
+       ``die:*.admission`` fault: idempotency-keyed requests are
+       submitted until the FaultPlan SIGKILLs the whole daemon between
+       the WAL admit row and the queue — the worst torn state.
+    3. warm recovery — a restarted daemon over the same journal dir
+       replays every journaled-but-unanswered request from the WAL;
+       clients resubmit ALL keys and every one must answer ok (cached
+       terminal stamped ``deduped``, live re-attach, or a fresh run),
+       the stream re-runs end to end, the final digest books ZERO
+       compiles (shared AOT cache -> restarted daemon warm), and every
+       artifact CRC is byte-identical to the pre-death baseline.
+
+    The verdict row stamps ``streams_resumed`` / ``wal_replayed`` /
+    ``wal_deduped`` so ``obs.report --regress`` fences failover rows
+    from plain serving rows (obs/ledger.durability_dimension).
+    """
+    from maskclustering_tpu.serve.client import ServeClient
+    from maskclustering_tpu.utils.synthetic import (make_scene,
+                                                    write_scannet_layout)
+
+    tmp = tempfile.mkdtemp(prefix="mct_chaos_drill_")
+    warm_names = []
+    for name, params in BUCKET_SPECS:
+        kw = dict(params)
+        kw["image_hw"] = tuple(kw["image_hw"])
+        write_scannet_layout(make_scene(**kw), tmp, name)
+        warm_names.append(name)
+    sname, sparams = CHAOS_STREAM_SPEC
+    skw = dict(sparams)
+    skw["image_hw"] = tuple(skw["image_hw"])
+    write_scannet_layout(make_scene(**skw), tmp, sname)
+
+    failures: List[str] = []
+    verdict: Dict = {"metric": "serve s/request (chaos drill p50)",
+                     "value": None, "unit": "s/request",
+                     "chaos_drill": True}
+    klock = threading.Lock()
+
+    def keyed_round(sockpath: str, suffix: str, outcomes: List) -> None:
+        """CHAOS_IDEM_KEYS concurrent keyed submissions; daemon death
+        mid-round is the script, so transport errors record as dropped."""
+
+        def one(i: int) -> None:
+            # keys 0-2 ride lg-b, keys 3-5 lg-a: submitted in index order
+            # under the phase-2 die:lg-a plan, the lg-b keys are already
+            # WAL-journaled (queued/running) when key 3's admission
+            # SIGKILLs the daemon — the mapping must stay FIXED across
+            # rounds (an idempotent resubmit is the same work item)
+            name, params = BUCKET_SPECS[1 if i < 3 else 0]
+            try:
+                with ServeClient(sockpath, timeout_s=600.0) as client:
+                    term, _st, lat = client.run_scene(
+                        name, synthetic=dict(params),
+                        tag=f"chaos-{i:02d}{suffix}", idem=f"chaos-{i:02d}")
+            except Exception as e:  # noqa: BLE001 — the daemon dying IS the drill
+                term, lat = {"kind": "dropped", "error": str(e)[:160]}, None
+            with klock:
+                outcomes.append((i, term, lat))
+
+        threads = []
+        for i in range(CHAOS_IDEM_KEYS):
+            t = threading.Thread(target=one, args=(i,), daemon=True,
+                                 name=f"chaos-key-{i}{suffix}")
+            threads.append(t)
+            t.start()
+            time.sleep(0.2)  # admission-order stagger, not a correctness gate
+        for t in threads:
+            t.join(600.0)
+
+    # -- phase 1: cold capture + SIGKILL the stream owner mid-stream --------
+    sock1 = os.path.join(tmp, "mct1.sock")
+    events1 = os.path.join(tmp, "serve_events_1.jsonl")
+    proc = _chaos_daemon(tmp, sock1, events=events1, retrace=False,
+                         fault_plan=None, workers=args.pool_workers,
+                         warm_names=warm_names)
+    streams_resumed = 0
+    digest1 = None
+    try:
+        if not _wait_for_socket(sock1, proc, timeout_s=args.smoke_startup_s):
+            log("chaos-drill: FAIL — phase-1 daemon never became reachable")
+            proc.kill()
+            return 1
+        v_base = run_load(sock1, requests=6, concurrency=3, buckets=2,
+                          deadline_s=0.0, resume=False)
+        verdict["value"] = v_base.get("value")
+        verdict["p95_s"] = v_base.get("p95_s")
+        verdict["requests"] = v_base.get("requests")
+        verdict["concurrency"] = v_base.get("concurrency")
+        if v_base.get("ok") != 6:
+            failures.append(f"baseline burst: {v_base.get('ok')}/6 ok")
+        with ServeClient(sock1, timeout_s=600.0) as sc:
+            ev1, _st = sc.stream_chunk(sname, chunk=5, synthetic=dict(skw))
+            if ev1.get("status") != "ok" or ev1.get("done"):
+                failures.append(f"stream chunk 1 answered "
+                                f"{ev1.get('kind')}/{ev1.get('status')} "
+                                f"done={ev1.get('done')} (want ok, not done)")
+            owner_pid = None
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and owner_pid is None:
+                pool_now, _sched = _pool_sched(sock1)
+                for w in pool_now.get("workers") or []:
+                    if w.get("open_streams") and w.get("pid"):
+                        owner_pid = int(w["pid"])
+                        break
+                if owner_pid is None:
+                    time.sleep(0.1)
+            if owner_pid is None:
+                failures.append("stream owner slice never showed an open "
+                                "session in stats — nothing to kill")
+            else:
+                os.kill(owner_pid, signal.SIGKILL)
+                log(f"chaos-drill: SIGKILLed stream owner child "
+                    f"(pid {owner_pid}) with the session open")
+            # the continuation op: the snapshot (stream_journal_every
+            # cadence) must re-open the session on a warm slice — a
+            # stream_lost reject here is the pre-WAL behavior regressing
+            ev2, _st2 = sc.stream_chunk(sname, chunk=5, synthetic=dict(skw))
+            if ev2.get("status") != "ok" or not ev2.get("done"):
+                failures.append(
+                    f"post-kill stream chunk answered "
+                    f"{ev2.get('kind')}/{ev2.get('status') or ev2.get('reason')}"
+                    f" — the session did not fail over from its snapshot")
+            fin, _stf = sc.stream_end(sname)
+            if fin.get("status") != "ok":
+                failures.append(f"stream_end after failover answered "
+                                f"{fin.get('kind')}/{fin.get('status')}")
+        streams_resumed = _chaos_counter(sock1, "serve.streams_resumed", 1)
+        if streams_resumed < 1:
+            failures.append("serve.streams_resumed never booked — the "
+                            "session was rebuilt from scratch (or lost), "
+                            "not resumed from its snapshot")
+        digest1 = _drain_daemon(proc, failures, "chaos phase 1")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    if digest1 is not None:
+        worker1 = digest1.get("worker") or {}
+        if not worker1.get("crashes"):
+            failures.append("phase 1: the pool digest recorded no worker "
+                            "crash for the SIGKILLed stream owner")
+        if not worker1.get("respawns"):
+            failures.append("phase 1: the killed slice never respawned")
+    crc_base = _artifact_crcs(os.path.join(tmp, "prediction"))
+    if not crc_base:
+        failures.append("phase 1 exported no artifacts to baseline")
+
+    # -- phase 2: a scripted daemon SIGKILL mid-queue -----------------------
+    sock2 = os.path.join(tmp, "mct2.sock")
+    events2 = os.path.join(tmp, "serve_events_2.jsonl")
+    # the die fires at the FIRST lg-a admission (count = firings, and one
+    # SIGKILL is terminal): the staggered lg-b keys before it are WAL-
+    # journaled but unanswered, the lg-a key itself is journaled (admit
+    # flushes BEFORE the inject seam), later keys never reach admission
+    # at all — every torn state the restart must reconcile
+    proc = _chaos_daemon(tmp, sock2, events=events2, retrace=True,
+                         fault_plan="die:lg-a.admission:1",
+                         workers=args.pool_workers, warm_names=warm_names)
+    outcomes2: List[Tuple[int, Dict, Optional[float]]] = []
+    child_pids: List[int] = []
+    try:
+        if not _wait_for_socket(sock2, proc, timeout_s=args.smoke_startup_s):
+            log("chaos-drill: FAIL — phase-2 daemon never became reachable")
+            proc.kill()
+            return 1
+        pool2, _sched2 = _pool_sched(sock2)
+        child_pids = [int(w["pid"]) for w in pool2.get("workers") or []
+                      if w.get("pid")]
+        keyed_round(sock2, "", outcomes2)
+        try:
+            proc.wait(timeout=60.0)
+        except subprocess.TimeoutExpired:
+            failures.append("phase 2: the die FaultPlan never killed the "
+                            "daemon (still alive after the keyed burst)")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30.0)
+    if proc.returncode != -signal.SIGKILL:
+        failures.append(f"phase 2: daemon exit {proc.returncode} (expected "
+                        f"-{int(signal.SIGKILL)} — the scripted admission-"
+                        f"seam SIGKILL)")
+    for pid in child_pids:
+        # the daemon died uncleanly by design; its orphaned slice children
+        # exit on pipe EOF, but the drill must not race that against
+        # phase 3's artifact writes — reap them explicitly
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass
+    dropped = sum(1 for _i, t, _l in outcomes2 if t.get("kind") == "dropped")
+    verdict["chaos_dropped"] = dropped
+    if not dropped:
+        failures.append("phase 2: every keyed request answered before the "
+                        "daemon died — nothing was left mid-queue for the "
+                        "WAL to prove")
+
+    # -- phase 3: warm restart, WAL replay, keyed resubmit, byte identity ---
+    sock3 = os.path.join(tmp, "mct3.sock")
+    events3 = os.path.join(tmp, "serve_events_3.jsonl")
+    proc = _chaos_daemon(tmp, sock3, events=events3, retrace=True,
+                         fault_plan=None, workers=args.pool_workers,
+                         warm_names=warm_names)
+    outcomes3: List[Tuple[int, Dict, Optional[float]]] = []
+    digest3 = None
+    try:
+        if not _wait_for_socket(sock3, proc, timeout_s=args.smoke_startup_s):
+            log("chaos-drill: FAIL — restarted daemon never became reachable")
+            proc.kill()
+            return 1
+        with ServeClient(sock3, timeout_s=30.0) as client:
+            durable = client.stats().get("durable") or {}
+        if not durable.get("wal_replayed"):
+            failures.append(f"restart replayed nothing from the WAL "
+                            f"(durable panel: {durable}) — the journaled "
+                            f"mid-queue requests were lost")
+        keyed_round(sock3, "-r2", outcomes3)
+        ok3 = sum(1 for _i, t, _l in outcomes3 if t.get("status") == "ok")
+        deduped3 = sum(1 for _i, t, _l in outcomes3 if t.get("deduped"))
+        verdict["chaos_resubmit_ok"] = ok3
+        verdict["chaos_deduped_terminals"] = deduped3
+        if ok3 != CHAOS_IDEM_KEYS:
+            bad = [(i, t.get("kind"), t.get("status") or t.get("reason")
+                    or t.get("error")) for i, t, _l in sorted(outcomes3)
+                   if t.get("status") != "ok"]
+            failures.append(f"resubmit round: {ok3}/{CHAOS_IDEM_KEYS} keys "
+                            f"answered ok ({bad}) — eventual completion "
+                            f"across the daemon death does not hold")
+        # the stream re-runs end to end on the restarted daemon (fresh
+        # session: phase 1's stream_end deleted its settled snapshot)
+        with ServeClient(sock3, timeout_s=600.0) as sc:
+            final, chunk_events = sc.stream_scene(sname, chunk=5,
+                                                  synthetic=dict(skw))
+        if final.get("status") != "ok" or any(
+                e.get("status") != "ok" for e in chunk_events):
+            failures.append(f"restarted-daemon stream answered "
+                            f"{final.get('kind')}/{final.get('status')} — "
+                            f"the warm restart does not serve streams")
+        digest3 = _drain_daemon(proc, failures, "chaos phase 3")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    if digest3 is None:
+        failures.append("no phase-3 digest to assert durability on")
+    else:
+        durable3 = digest3.get("durable") or {}
+        verdict["wal_replayed"] = durable3.get("wal_replayed")
+        verdict["wal_deduped"] = durable3.get("wal_deduped")
+        verdict["journals_pruned"] = durable3.get("journals_pruned")
+        if not durable3.get("wal_replayed"):
+            failures.append("phase 3 digest books wal_replayed=0")
+        if not (durable3.get("wal_deduped", 0)
+                or durable3.get("wal_reattached", 0)):
+            failures.append("no resubmitted key deduped or re-attached — "
+                            "the idempotency contract never engaged")
+        retrace3 = digest3.get("retrace") or {}
+        verdict["retrace_compiles"] = retrace3.get("compiles")
+        if retrace3.get("compiles", 0) != 0:
+            failures.append(
+                f"restarted daemon booked {retrace3.get('compiles')} "
+                f"compile(s) — the shared AOT cache did not deliver a "
+                f"zero-compile recovery")
+        if retrace3.get("post_freeze"):
+            failures.append(f"{retrace3['post_freeze']} post-warm "
+                            f"compile(s) on the restarted daemon")
+    verdict["streams_resumed"] = max(
+        streams_resumed,
+        int(((digest1 or {}).get("worker") or {}).get("streams_resumed")
+            or 0))
+
+    crc_final = _artifact_crcs(os.path.join(tmp, "prediction"))
+    if crc_base and crc_final != crc_base:
+        diff = sorted(k for k in set(crc_base) | set(crc_final)
+                      if crc_base.get(k) != crc_final.get(k))
+        failures.append(f"artifact CRCs diverged across the daemon death: "
+                        f"{diff[:8]}{'...' if len(diff) > 8 else ''}")
+    verdict["crc_entries"] = len(crc_final)
+
+    if failures:
+        verdict["error"] = "; ".join(failures)
+    print(json.dumps(verdict, sort_keys=True), flush=True)
+    if not args.no_ledger:
+        append_ledger_row(verdict, args.ledger)
+    if failures:
+        for f in failures:
+            log(f"chaos-drill: FAIL — {f}")
+        return 1
+    log(f"chaos-drill: PASS — stream failed over "
+        f"({verdict['streams_resumed']} resume(s)), daemon death replayed "
+        f"{verdict['wal_replayed']} request(s) from the WAL "
+        f"({verdict['chaos_deduped_terminals']} deduped terminal(s)), "
+        f"{verdict['crc_entries']} artifact CRCs byte-identical, zero "
+        f"compiles on the restarted daemon")
     return 0
 
 
@@ -1697,6 +2075,16 @@ def main(argv=None) -> int:
                              "workers — with zero post-warm compiles")
     parser.add_argument("--pool-workers", type=int, default=2,
                         help="pool drill: slice count (default 2)")
+    parser.add_argument("--chaos-drill", action="store_true",
+                        help="the mct-durable CI gate, three daemon "
+                             "generations over one shared WAL + AOT cache "
+                             "+ stream_state: SIGKILL a pool child mid-"
+                             "stream (session must resume from its "
+                             "snapshot), SIGKILL the whole daemon mid-"
+                             "queue via a die:*.admission FaultPlan, then "
+                             "restart — WAL replay + idempotent resubmit "
+                             "must answer EVERY key ok with byte-identical "
+                             "artifacts and zero compiles")
     parser.add_argument("--write-goldens", nargs="?", const=DEFAULT_GOLDENS,
                         default=None, metavar="PATH",
                         help="regenerate canary_goldens.json (flag alone: "
@@ -1722,6 +2110,8 @@ def main(argv=None) -> int:
         return run_write_goldens(args)
     if args.canary_drill:
         return run_canary_drill(args)
+    if args.chaos_drill:
+        return run_chaos_drill(args)
     if args.pool_drill:
         return run_pool_drill(args)
     if args.pack_drill:

@@ -1,0 +1,557 @@
+"""mct-durable: the durability plane (ISSUE-20 acceptance).
+
+Unit tier: the admission WAL's round-trip / torn-tail / first-admit-wins
+/ compaction contract (serve/wal.py), idempotency-key protocol
+validation, the ``die`` FaultPlan kind (daemon-level SIGKILL at the
+admission seam), journal + stream-snapshot retention pruning, the
+durability config knobs, and the perf-ledger durability fence.
+
+Stub tier (jax-free worker stub, milliseconds): stream-session failover
+— a crashed/retired/recarved slice with a per-chunk snapshot on disk
+RE-OPENS the session on a surviving slice instead of answering the typed
+``stream_lost`` (which remains the contract when no snapshot exists —
+pinned by tests/test_serve_pool.py).
+
+Integration tier (real in-process worker over the suite's shared tiny
+shape bucket): WAL dedupe on a live daemon, then a restart over the same
+journal dir — with a torn WAL tail — that must replay the
+journaled-but-unanswered request and settle a keyed resubmit ok. The
+real-subprocess daemon-death acceptance is ci.sh's rc-13 chaos drill
+(scripts/load_gen.py --chaos-drill).
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from maskclustering_tpu.config import load_config
+from maskclustering_tpu.serve import protocol
+from maskclustering_tpu.serve import wal
+from maskclustering_tpu.serve.admission import AdmissionQueue
+from maskclustering_tpu.serve.client import ServeClient
+from maskclustering_tpu.serve.daemon import ServeDaemon
+from maskclustering_tpu.serve.pool import WorkerPool
+from maskclustering_tpu.serve.router import Router
+from maskclustering_tpu.serve.supervisor import WorkerSupervisor
+from maskclustering_tpu.utils import faults
+from maskclustering_tpu.utils.synthetic import make_scene, write_scannet_layout
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB = os.path.join(REPO_ROOT, "tests", "worker_stub.py")
+
+# the suite's shared tiny bucket (byte-identical to test_serve SPEC_A /
+# test_executor scene0: in a full run its programs are process-warm)
+SPEC_A = {"num_boxes": 3, "num_frames": 10, "image_hw": (60, 80),
+          "spacing": 0.06, "seed": 40}
+SCENE_A = "scene0000_00"
+
+
+def _cfg(data_root, **kw):
+    base = dict(data_root=str(data_root), config_name="durable", step=1,
+                distance_threshold=0.05, mask_pad_multiple=32,
+                worker_heartbeat_s=1.0, retry_backoff_s=0.05)
+    base.update(kw)
+    return load_config("scannet").replace(**base)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.set_plan(None)
+    faults.clear_stop()
+    yield
+    faults.set_plan(None)
+    faults.clear_stop()
+
+
+# ---------------------------------------------------------------------------
+# units: the admission WAL file contract
+# ---------------------------------------------------------------------------
+
+
+def _doc(scene="s1", idem=""):
+    d = {"op": "scene", "scene": scene}
+    if idem:
+        d["idem"] = idem
+    return d
+
+
+def test_wal_round_trip_pending_answered_max_id(tmp_path):
+    path = str(tmp_path / wal.WAL_FILENAME)
+    w = wal.AdmissionWal(path)
+    w.admit("r-000003", _doc("a", "k-a"), idem="k-a")
+    w.admit("r-000007", _doc("b"))
+    w.admit("r-000010", _doc("c", "k-c"), idem="k-c")
+    w.dispatch("r-000003")
+    w.terminal("r-000003", {"kind": "result", "id": "r-000003",
+                            "status": "ok"}, idem="k-a")
+    w.close()
+
+    state = wal.read_wal(path)
+    # admission order preserved; the settled request is NOT pending
+    assert [(rid, d["scene"], idem) for rid, d, idem in state.pending] == \
+        [("r-000007", "b", ""), ("r-000010", "c", "k-c")]
+    # keyed terminals populate the dedupe cache; unkeyed admits never do
+    assert set(state.answered) == {"k-a"}
+    assert state.answered["k-a"]["status"] == "ok"
+    assert state.max_id == 10
+    assert state.rows == 5 and state.stats.torn == 0
+    # a missing file is an EMPTY state, never an error
+    empty = wal.read_wal(str(tmp_path / "nope.jsonl"))
+    assert empty.pending == [] and empty.max_id == 0
+
+
+def test_wal_torn_tail_and_first_admit_wins(tmp_path):
+    path = str(tmp_path / wal.WAL_FILENAME)
+    w = wal.AdmissionWal(path)
+    w.admit("r-000001", _doc("a", "k1"), idem="k1")
+    w.admit("r-000001", _doc("DUPE"))  # duplicate rid: first admit wins
+    w.admit("r-000002", _doc("b"))
+    w.close()
+    # the crash-torn tail: a half-written line with no newline terminator
+    with open(path, "ab") as f:
+        f.write(b'{"v": 1, "kind": "wal.admit", "request": "r-0000')
+
+    state = wal.read_wal(path)
+    assert state.stats.torn == 1
+    assert [(rid, d["scene"]) for rid, d, _ in state.pending] == \
+        [("r-000001", "a"), ("r-000002", "b")]
+    assert state.pending[0][2] == "k1"
+
+
+def test_wal_compact_rewrites_to_recovered_state(tmp_path):
+    path = str(tmp_path / wal.WAL_FILENAME)
+    w = wal.AdmissionWal(path)
+    for i in range(6):
+        w.admit(f"r-{i:06d}", _doc(f"s{i}", f"k{i}"), idem=f"k{i}")
+        w.terminal(f"r-{i:06d}", {"kind": "result", "id": f"r-{i:06d}",
+                                  "status": "ok"}, idem=f"k{i}")
+    w.admit("r-000099", _doc("live", "k-live"), idem="k-live")
+    w.close()
+    before = wal.read_wal(path)
+    assert len(before.pending) == 1 and len(before.answered) == 6
+
+    wal.compact(path, before)
+    # compaction is lossless for recovery: same pending, same cache, and
+    # the settled requests' admit+terminal pairs collapsed to one row each
+    after = wal.read_wal(path)
+    assert after.pending == before.pending
+    assert after.answered == before.answered
+    assert after.max_id == before.max_id
+    assert after.rows == 7 < 13
+
+
+# ---------------------------------------------------------------------------
+# units: idempotency keys on the wire
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_idem_validation_and_build():
+    doc = protocol.parse_line(json.dumps(
+        {"op": "scene", "scene": "s1", "idem": "client-42"}))
+    req = protocol.build_request(doc, "r-000001")
+    assert req.idem == "client-42"
+    # no key -> empty string, never None
+    bare = protocol.build_request(protocol.parse_line(
+        '{"op": "scene", "scene": "s2"}'), "r-000002")
+    assert bare.idem == ""
+
+    for bad in ({"op": "scene", "scene": "a", "idem": 7},
+                {"op": "scene", "scene": "a", "idem": ""},
+                {"op": "scene", "scene": "a", "idem": "x/y"},
+                {"op": "scene", "scene": "a",
+                 "idem": "k" * (protocol.IDEM_MAX_LEN + 1)}):
+        with pytest.raises(protocol.ProtocolError):
+            protocol.parse_line(json.dumps(bad))
+    # the boundary itself is legal
+    ok = protocol.parse_line(json.dumps(
+        {"op": "scene", "scene": "a", "idem": "k" * protocol.IDEM_MAX_LEN}))
+    assert len(ok["idem"]) == protocol.IDEM_MAX_LEN
+
+    # supervisor forwarding never propagates the key: dedupe is a DAEMON
+    # contract, a worker resubmit must not re-enter the cache
+    fwd = protocol.forward_request(protocol.build_request(
+        protocol.parse_line(json.dumps(
+            {"op": "scene", "scene": "a", "idem": "k1"})), "r-000003"))
+    assert "idem" not in fwd
+
+
+# ---------------------------------------------------------------------------
+# units: post-freeze cache deserializes are not compile violations
+# ---------------------------------------------------------------------------
+
+
+def test_sanitizer_retracts_post_freeze_on_persistent_cache_hit():
+    """A restarted daemon replaying WAL work traces its programs again,
+    but the persistent compilation cache serves the bytes — the sanitizer
+    must read that as a warm restart (zero compiles), not a post_freeze
+    violation. A post-freeze cache MISS stays a violation."""
+    from maskclustering_tpu.analysis import retrace_sanitizer as rs
+
+    rs.reset()
+    try:
+        st = rs._STATE
+        st.frozen = True
+        st.on_compile("stream_probe", "f32[3]")
+        assert [v["kind"] for v in st.violations] == ["post_freeze"]
+        st.on_cache_event(True)  # persistent-cache deserialize
+        assert st.violations == []
+        s = rs.summary()
+        assert (s["post_freeze"], s["compiles"], s["cache_hits"]) \
+            == (0, 0, 1)
+        # a miss (a genuinely new build after freeze) is still flagged
+        st.on_compile("stream_probe", "f32[4]")
+        st.on_cache_event(False)
+        assert rs.summary()["post_freeze"] == 1
+    finally:
+        rs.reset()
+
+
+# ---------------------------------------------------------------------------
+# units: the `die` FaultPlan kind (the chaos drill's daemon-death seam)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_die_parses_with_admission_defaults():
+    plan = faults.FaultPlan.from_spec("die:sceneA")
+    (e,) = plan.entries
+    assert (e.kind, e.seam, e.scene, e.remaining) == \
+        ("die", "admission", "sceneA", 1)
+    e2 = faults.FaultPlan.from_spec("die:sceneB.admission:2").entries[0]
+    assert (e2.seam, e2.remaining) == ("admission", 2)
+
+
+def test_fault_plan_die_sigkills_self_at_admission_seam(monkeypatch):
+    import signal as _signal
+
+    kills = []
+    monkeypatch.setattr(faults.os, "kill",
+                        lambda pid, sig: kills.append((pid, sig)))
+    faults.set_plan(faults.FaultPlan.from_spec("die:sceneA.admission:1"))
+    faults.inject("admission", "other-scene")  # scene mismatch: no fire
+    assert kills == []
+    faults.inject("admission", "sceneA")
+    assert kills == [(os.getpid(), _signal.SIGKILL)]
+    faults.inject("admission", "sceneA")  # count 1: exhausted
+    assert len(kills) == 1
+
+
+# ---------------------------------------------------------------------------
+# units: retention pruning
+# ---------------------------------------------------------------------------
+
+
+def test_prune_dir_keep_age_floor_and_wal_skip(tmp_path):
+    d = str(tmp_path)
+    now = time.time()
+
+    def put(name, age_s):
+        p = os.path.join(d, name)
+        with open(p, "w") as f:
+            f.write("x")
+        os.utime(p, (now - age_s, now - age_s))
+        return p
+
+    old = [put(f"r-{i:06d}.jsonl", 3600 + i) for i in range(4)]
+    fresh = put("r-000099.jsonl", 1.0)       # under MIN_PRUNE_AGE_S
+    walfile = put(wal.WAL_FILENAME, 7200)    # skipped by NAME, always
+    other = put("snapshot.stream.npz", 7200)  # suffix-filtered out
+
+    # keep-N: the 2 oldest .jsonl beyond keep=2 go; the fresh file is
+    # exempt from counting AND from deletion (the live-state floor)
+    removed = wal.prune_dir(d, keep=2, max_age_s=0.0, suffixes=(".jsonl",),
+                            now=now)
+    assert removed == 2
+    # oldest-first: old[3] and old[2] (the two oldest .jsonl) are pruned
+    assert not os.path.exists(old[3]) and not os.path.exists(old[2])
+    assert os.path.exists(old[0]) and os.path.exists(fresh)
+    assert os.path.exists(walfile) and os.path.exists(other)
+
+    # age policy on the snapshot suffix
+    assert wal.prune_dir(d, keep=0, max_age_s=600.0,
+                         suffixes=(".stream.npz",), now=now) == 1
+    assert not os.path.exists(other)
+
+    # both policies disabled -> no scan, no deletions
+    assert wal.prune_dir(d, keep=0, max_age_s=0.0,
+                         suffixes=(".jsonl",), now=now) == 0
+    assert wal.prune_dir(str(tmp_path / "missing"), keep=1, max_age_s=1.0,
+                         suffixes=(".jsonl",)) == 0
+
+
+def test_config_validates_durability_knobs(tmp_path):
+    cfg = _cfg(tmp_path, serve_journal_keep=8, serve_journal_max_age_s=60.0,
+               serve_prune_interval_s=5.0)
+    assert cfg.serve_journal_keep == 8
+    for bad in (dict(serve_journal_keep=-1),
+                dict(serve_journal_max_age_s=-0.5),
+                dict(serve_prune_interval_s=-1.0),
+                dict(stream_journal_every=-1)):
+        with pytest.raises(ValueError):
+            _cfg(tmp_path, **bad)
+
+
+# ---------------------------------------------------------------------------
+# units: the perf-ledger durability fence
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_serve_row_carries_durability_and_fences():
+    from maskclustering_tpu.obs import ledger as led
+
+    row = led.serve_row({"metric": "m", "value": 1.0, "unit": "s/request",
+                         "streams_resumed": 2, "wal_replayed": 3,
+                         "wal_deduped": 4, "journals_pruned": 5})
+    assert (row["streams_resumed"], row["wal_replayed"],
+            row["wal_deduped"], row["journals_pruned"]) == (2, 3, 4, 5)
+    assert led.durability_dimension(row)
+    assert led.durability_dimension({"wal_replayed": 1})
+    assert not led.durability_dimension({"wal_replayed": 0})
+    assert not led.durability_dimension({"value": 1.0})
+    assert not led.durability_dimension(None)
+
+
+# ---------------------------------------------------------------------------
+# stream-session failover on the jax-free stub (supervisor + pool)
+# ---------------------------------------------------------------------------
+
+
+class _Client:
+    def __init__(self):
+        import threading
+
+        self.events = []
+        self.done = threading.Event()
+
+    def send(self, ev):
+        self.events.append(ev)
+        if ev.get("kind") in ("result", "reject"):
+            self.done.set()
+
+    @property
+    def terminal(self):
+        return self.events[-1] if self.events else None
+
+    def states(self):
+        return [e.get("state") for e in self.events
+                if e.get("kind") == "status"]
+
+
+def _submit(target, scene, i, *, op="scene", **kw):
+    client = _Client()
+    req = protocol.build_request({"op": op, "scene": scene, **kw},
+                                 f"d-{i:06d}")
+    req.send = client.send
+    target.submit(req) if isinstance(target, AdmissionQueue) \
+        else target.admit(req)
+    return client
+
+
+def _touch_snapshot(state_dir, scene):
+    from maskclustering_tpu.models.streaming import stream_state_path
+
+    os.makedirs(state_dir, exist_ok=True)
+    path = stream_state_path(state_dir, scene)
+    with open(path, "wb") as f:
+        f.write(b"\x00")  # existence is the parent-side resumability test
+    return path
+
+
+def test_supervisor_stream_resumes_from_snapshot(tmp_path, monkeypatch):
+    """A worker crash with an open stream AND a per-chunk snapshot on
+    disk: the next op RE-OPENS the session on the respawned child
+    (streams_resumed books) instead of answering stream_lost — the
+    no-snapshot twin (tests/test_serve_pool.py) keeps stream_lost as the
+    typed fallback."""
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    state_dir = str(tmp_path / "stream_state")
+    queue = AdmissionQueue(8)
+    sup = WorkerSupervisor(_cfg(tmp_path), queue, Router(_cfg(tmp_path)),
+                           journal_dir=str(tmp_path / "journals"),
+                           stream_state_dir=state_dir,
+                           child_argv=[sys.executable, STUB],
+                           start_timeout_s=15.0, poll_s=0.05)
+    sup.start()
+    try:
+        opened = _submit(queue, "stream-x", 1, op="stream_chunk")
+        assert opened.done.wait(15.0) and opened.terminal["status"] == "ok"
+        assert sup.stats()["worker"]["open_streams"] == 1
+        _touch_snapshot(state_dir, "stream-x")
+        crash = _submit(queue, "stub-crash", 2)
+        assert crash.done.wait(30.0) and crash.terminal["status"] == "ok"
+        resumed = _submit(queue, "stream-x", 3, op="stream_chunk")
+        assert resumed.done.wait(15.0)
+        assert resumed.terminal["status"] == "ok"
+        assert "stream_lost" not in resumed.states()
+        st = sup.stats()["worker"]
+        assert st["streams_resumed"] == 1
+        assert st["lost_streams"] == 0
+        fin = _submit(queue, "stream-x", 4, op="stream_end")
+        assert fin.done.wait(15.0) and fin.terminal["done"] is True
+    finally:
+        sup.stop(timeout_s=10.0)
+
+
+def test_pool_stream_fails_over_to_surviving_slice(tmp_path, monkeypatch):
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    state_dir = str(tmp_path / "stream_state")
+    pool = WorkerPool(_cfg(tmp_path, serve_workers=2), AdmissionQueue(32),
+                      Router(_cfg(tmp_path)),
+                      journal_dir=str(tmp_path / "journals"),
+                      stream_state_dir=state_dir,
+                      child_argv=[sys.executable, STUB],
+                      start_timeout_s=15.0, poll_s=0.05)
+    pool.start()
+    try:
+        c1 = _submit(pool, "stream-f", 1, op="stream_chunk")
+        assert c1.done.wait(15.0) and c1.terminal["status"] == "ok"
+        owner = pool._stream_owner["stream-f"]
+        _touch_snapshot(state_dir, "stream-f")
+        with pool._lock:
+            pool._dead.add(owner)  # simulate a retired owner slice
+        try:
+            c2 = _submit(pool, "stream-f", 2, op="stream_chunk")
+            assert c2.done.wait(15.0)
+            assert c2.terminal["status"] == "ok"
+            assert "stream_lost" not in c2.states()
+            # the session re-pinned to a SURVIVING slice
+            assert pool._stream_owner["stream-f"] != owner
+        finally:
+            with pool._lock:
+                pool._dead.discard(owner)
+    finally:
+        pool.stop(timeout_s=15.0)
+
+
+def test_recarve_during_live_stream_resumes_from_snapshot(tmp_path,
+                                                          monkeypatch):
+    """The recarve contract for live streams, pinned: sessions die with
+    the old slices (`_stream_owner` cleared), and the next op on a scene
+    WITH a snapshot routes as a new stream whose fresh child resumes from
+    disk — answered ok, never stream_lost."""
+    monkeypatch.setenv("STUB_DIR", str(tmp_path))
+    state_dir = str(tmp_path / "stream_state")
+    pool = WorkerPool(_cfg(tmp_path, serve_workers=2), AdmissionQueue(32),
+                      Router(_cfg(tmp_path)),
+                      journal_dir=str(tmp_path / "journals"),
+                      stream_state_dir=state_dir,
+                      child_argv=[sys.executable, STUB],
+                      start_timeout_s=15.0, poll_s=0.05)
+    pool.start()
+    try:
+        c1 = _submit(pool, "stream-r", 1, op="stream_chunk")
+        assert c1.done.wait(15.0) and c1.terminal["status"] == "ok"
+        _touch_snapshot(state_dir, "stream-r")
+        out = pool.recarve(workers=1, timeout_s=30.0)
+        assert out["ok"] is True
+        assert "stream-r" not in pool._stream_owner
+        c2 = _submit(pool, "stream-r", 2, op="stream_chunk")
+        assert c2.done.wait(15.0)
+        assert c2.terminal["status"] == "ok"
+        assert "stream_lost" not in c2.states()
+        assert pool._stream_owner["stream-r"] == 0
+        fin = _submit(pool, "stream-r", 3, op="stream_end")
+        assert fin.done.wait(15.0) and fin.terminal["done"] is True
+    finally:
+        pool.stop(timeout_s=15.0)
+
+
+# ---------------------------------------------------------------------------
+# integration: WAL dedupe live + replay across a daemon restart
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_wal_dedupe_and_restart_replay(tmp_path):
+    """The WAL contract end to end on the real in-process worker: a keyed
+    resubmit on a live daemon answers from cache (`deduped`), a restarted
+    daemon over the same journal dir — with a crash-torn WAL tail —
+    replays the journaled-but-unanswered request and settles a keyed
+    resubmit ok. The real-subprocess SIGKILL version is the rc-13 chaos
+    drill."""
+    root = str(tmp_path / "data")
+    write_scannet_layout(make_scene(**SPEC_A), root, SCENE_A)
+    journals = str(tmp_path / "journals")
+    syn = dict(SPEC_A, image_hw=list(SPEC_A["image_hw"]))
+    sock1 = str(tmp_path / "mct1.sock")
+
+    d1 = ServeDaemon(_cfg(root, config_name="durable1"), socket_path=sock1,
+                     capacity=8, journal_dir=journals,
+                     freeze_after_warm=False)
+    d1.start()
+    try:
+        with ServeClient(sock1, timeout_s=300.0) as c:
+            first, _st, _lat = c.run_scene(SCENE_A, synthetic=syn,
+                                           idem="key-1", tag="t1")
+            assert first["status"] == "ok" and "deduped" not in first
+            again, _st, lat = c.run_scene(SCENE_A, synthetic=syn,
+                                          idem="key-1", tag="t2")
+            # answered from the WAL cache: no re-run, the resubmit's tag,
+            # and the cached terminal's payload intact
+            assert again["deduped"] is True and again["tag"] == "t2"
+            assert again["status"] == "ok"
+            assert again["id"] == first["id"]
+            stats = c.stats()
+            assert stats["durable"]["wal"] is True
+            assert stats["durable"]["wal_deduped"] == 1
+    finally:
+        d1.request_stop()
+        d1.shutdown()
+
+    # the predecessor "died" with one journaled-but-unanswered keyed
+    # request (appended post-shutdown = admitted, never answered) and a
+    # torn final line — the worst recoverable WAL
+    wal_path = os.path.join(journals, wal.WAL_FILENAME)
+    assert os.path.exists(wal_path)
+    w = wal.AdmissionWal(wal_path)
+    w.admit("r-000097", {"op": "scene", "scene": SCENE_A, "synthetic": syn,
+                         "idem": "key-2"}, idem="key-2")
+    w.close()
+    with open(wal_path, "ab") as f:
+        f.write(b'{"v": 1, "kind": "wal.admit", "request": "r-0')
+
+    # it also left settled per-request journals behind: retention at the
+    # successor's start keeps serve_journal_keep newest, skips the WAL
+    now = time.time()
+    for i in range(6):
+        p = os.path.join(journals, f"r-{i:06d}.jsonl")
+        with open(p, "w") as f:
+            f.write("{}\n")
+        os.utime(p, (now - 3600 - i, now - 3600 - i))
+
+    sock2 = str(tmp_path / "mct2.sock")
+    d2 = ServeDaemon(_cfg(root, config_name="durable2",
+                          serve_journal_keep=2),
+                     socket_path=sock2, capacity=8, journal_dir=journals,
+                     freeze_after_warm=False)
+    d2.start()
+    try:
+        assert d2._ids >= 97  # id counter seeded past the replayed rid
+        left = sorted(os.listdir(journals))
+        assert wal.WAL_FILENAME in left
+        # oldest-first: r-000005..r-000002 pruned, the 2 youngest stay
+        assert os.path.exists(os.path.join(journals, "r-000000.jsonl"))
+        assert os.path.exists(os.path.join(journals, "r-000001.jsonl"))
+        assert not os.path.exists(os.path.join(journals, "r-000002.jsonl"))
+        assert d2.stats()["durable"]["journals_pruned"] == 4
+        with ServeClient(sock2, timeout_s=300.0) as c:
+            stats = c.stats()
+            assert stats["durable"]["wal_replayed"] == 1
+            # the reconnecting client resubmits its key: re-attach to the
+            # live replay or dedupe its cached terminal — either way the
+            # SAME request id answers ok, exactly once
+            term, _st, _lat = c.run_scene(SCENE_A, synthetic=syn,
+                                          idem="key-2", tag="t3")
+            assert term["status"] == "ok"
+            assert term["id"] == "r-000097"
+            stats = c.stats()
+            assert stats["durable"]["wal_deduped"] \
+                + stats["durable"]["wal_reattached"] >= 1
+            # key-1's cache survived the restart (and the compaction)
+            old, _st, _lat = c.run_scene(SCENE_A, synthetic=syn,
+                                         idem="key-1")
+            assert old["deduped"] is True and old["status"] == "ok"
+    finally:
+        d2.request_stop()
+        d2.shutdown()
